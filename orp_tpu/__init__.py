@@ -1,0 +1,20 @@
+"""orp_tpu — TPU-native Monte-Carlo deep-hedging framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``ithakis/Option-Replicating-Portfolio-with-Neural-Networks`` (see SURVEY.md):
+scrambled-Sobol QMC simulation of financial/actuarial risk factors and neural
+replicating-portfolio hedging by backward induction, built path-parallel over a
+``jax.sharding.Mesh`` for TPU pods.
+
+Subpackages (layer map mirrors SURVEY.md §1):
+- ``qmc``      L1  scrambled Sobol + Phi^{-1} (pure JAX bit kernels)
+- ``sde``      L2  GBM / CIR-vol / mortality / binomial-population scan kernels
+- ``models``   L4  hedge MLPs (phi, psi heads) as plain pytrees
+- ``train``    L4/L5 losses, LR schedule, early-stopped fit, backward induction
+- ``risk``     L6  VaR / quantile analytics, ledgers, reporting
+- ``calib``    side  CIR parameter calibration (OLS closed form)
+- ``parallel``     mesh / sharding / distributed-quantile utilities
+- ``api``      L7  config-driven entry points (``replicating_portfolio`` etc.)
+"""
+
+__version__ = "0.1.0"

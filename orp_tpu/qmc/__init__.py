@@ -1,0 +1,19 @@
+"""Quasi-Monte-Carlo core: scrambled Sobol + inverse-normal transform (L1)."""
+
+from orp_tpu.qmc.sobol import (
+    direction_numbers,
+    digital_shift,
+    owen_scramble,
+    sobol_normal,
+    sobol_normal_matrix,
+    sobol_uniform,
+)
+
+__all__ = [
+    "direction_numbers",
+    "digital_shift",
+    "owen_scramble",
+    "sobol_normal",
+    "sobol_normal_matrix",
+    "sobol_uniform",
+]

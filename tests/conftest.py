@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initialises.
+
+Multi-chip sharding tests (SURVEY.md §4 item 5) run on a virtual CPU mesh so no TPU
+pod is needed; numeric oracles also run CPU-side for determinism.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,104 @@
+"""Oracles for the L1 QMC core (SURVEY.md §7 step 1).
+
+- bit-exact set equality of unscrambled points vs scipy's compiled Sobol;
+- moment / distribution checks of scrambled normals (the reference's implicit
+  contract for ``sobol_norm``, Replicating_Portfolio.py:54-57);
+- QMC convergence beats plain MC on a smooth integrand;
+- shard-offset generation == monolithic generation (communication-free sharding).
+"""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+import scipy.stats.qmc as qmc
+
+import jax.numpy as jnp
+
+from orp_tpu import qmc as oqmc
+
+
+def test_unscrambled_matches_scipy_point_set():
+    m, d = 9, 7
+    idx = jnp.arange(2**m, dtype=jnp.uint32)
+    mine = np.asarray(
+        oqmc.sobol_uniform(idx, jnp.arange(d), scramble="none", dtype=jnp.float64)
+    )
+    ref = qmc.Sobol(d, scramble=False).random_base2(m)
+    # scipy walks the sequence in Gray-code order; the 2^m-point *set* is identical.
+    # Our floats sit mid-bucket (offset 2^-25 after 24-bit truncation).
+    assert np.allclose(np.sort(mine, axis=0), np.sort(ref, axis=0), atol=2**-24)
+
+
+def test_scrambled_uniform_in_unit_interval_and_balanced():
+    m, d = 12, 16
+    idx = jnp.arange(2**m, dtype=jnp.uint32)
+    u = np.asarray(oqmc.sobol_uniform(idx, jnp.arange(d), seed=1234))
+    assert u.min() > 0.0 and u.max() < 1.0
+    # scrambled Sobol with n=2^m keeps strata balance: mean very close to 1/2
+    assert np.abs(u.mean(axis=0) - 0.5).max() < 5e-3
+
+
+def test_normal_moments_and_ks():
+    m = 13
+    idx = jnp.arange(2**m, dtype=jnp.uint32)
+    z = np.asarray(oqmc.sobol_normal(idx, jnp.arange(4), seed=7, dtype=jnp.float64))
+    assert np.abs(z.mean(axis=0)).max() < 2e-2
+    assert np.abs(z.std(axis=0) - 1.0).max() < 2e-2
+    for j in range(z.shape[1]):
+        ks = st.kstest(z[:, j], "norm")
+        assert ks.pvalue > 1e-4, (j, ks)
+
+
+def test_different_dims_decorrelated():
+    m = 13
+    idx = jnp.arange(2**m, dtype=jnp.uint32)
+    z = np.asarray(oqmc.sobol_normal(idx, jnp.arange(8), seed=3))
+    c = np.corrcoef(z.T)
+    off = c - np.eye(8)
+    assert np.abs(off).max() < 5e-2
+
+
+def test_qmc_beats_mc_on_smooth_integrand():
+    # E[prod_j (1 + (u_j - .5))] = 1 exactly; QMC error should be far below MC error.
+    d, m = 6, 12
+    idx = jnp.arange(2**m, dtype=jnp.uint32)
+    u = np.asarray(oqmc.sobol_uniform(idx, jnp.arange(d), seed=11, dtype=jnp.float64))
+    qmc_err = abs(np.prod(1 + (u - 0.5), axis=1).mean() - 1.0)
+    rng = np.random.default_rng(0)
+    mc_errs = [
+        abs(np.prod(1 + (rng.random((2**m, d)) - 0.5), axis=1).mean() - 1.0)
+        for _ in range(8)
+    ]
+    assert qmc_err < np.median(mc_errs)
+
+
+def test_shard_offset_equals_monolithic():
+    n, d = 1024, 5
+    full = oqmc.sobol_normal(jnp.arange(n, dtype=jnp.uint32), jnp.arange(d), seed=42)
+    parts = [
+        oqmc.sobol_normal(
+            jnp.arange(k * 256, (k + 1) * 256, dtype=jnp.uint32), jnp.arange(d), seed=42
+        )
+        for k in range(4)
+    ]
+    assert np.array_equal(np.asarray(full), np.concatenate([np.asarray(p) for p in parts]))
+
+
+def test_dimension_slices_consistent():
+    idx = jnp.arange(512, dtype=jnp.uint32)
+    full = np.asarray(oqmc.sobol_normal(idx, jnp.arange(10), seed=5))
+    sl = np.asarray(oqmc.sobol_normal(idx, jnp.arange(4, 8), seed=5))
+    assert np.array_equal(full[:, 4:8], sl)
+
+
+def test_seed_changes_points_but_not_law():
+    idx = jnp.arange(4096, dtype=jnp.uint32)
+    a = np.asarray(oqmc.sobol_normal(idx, jnp.arange(2), seed=1))
+    b = np.asarray(oqmc.sobol_normal(idx, jnp.arange(2), seed=2))
+    assert not np.allclose(a, b)
+    assert abs(a.mean() - b.mean()) < 5e-2
+
+
+def test_reference_signature_shape():
+    z = oqmc.sobol_normal_matrix(10, 3, seed=1234)
+    assert z.shape == (1024, 3)

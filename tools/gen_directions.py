@@ -1,0 +1,59 @@
+"""Generate packed Sobol direction-number matrices from the public Joe–Kuo d(6) table.
+
+The Joe–Kuo "new-joe-kuo-6.21201" table (primitive polynomials + initial direction
+numbers, public domain, https://web.maths.unsw.edu.au/~fkuo/sobol/) is shipped inside
+scipy; we read it from there and run the standard Bratley–Fox / Joe–Kuo recursion to
+produce the full 32-bit direction-number matrix ``V[d, 32]`` used by the JAX Sobol
+kernel in ``orp_tpu/qmc/sobol.py``.
+
+Reference parity target: the reference draws scrambled Sobol points of dimension up to
+3651 (``Replicating_Portfolio.py:54-57`` via ``scipy.stats.qmc.Sobol``); we generate
+8192 dimensions so every reference configuration fits with headroom.
+
+Run:  python tools/gen_directions.py
+Out:  orp_tpu/qmc/_data/joe_kuo_8192x32.npy  (uint32, shape (8192, 32), ~1 MB)
+"""
+
+import numpy as np
+
+N_DIMS = 8192
+N_BITS = 32
+
+
+def joe_kuo_directions(n_dims: int = N_DIMS, n_bits: int = N_BITS) -> np.ndarray:
+    import scipy.stats._sobol as _sobol
+
+    poly = _sobol.get_poly_vinit("poly", np.uint32)
+    vinit = _sobol.get_poly_vinit("vinit", np.uint32)
+    assert n_dims <= poly.shape[0], "Joe-Kuo table exhausted"
+
+    v = np.zeros((n_dims, n_bits), dtype=np.uint64)
+
+    # Dimension 0: van der Corput in base 2 -> v_k = 2^(n_bits-1-k).
+    for k in range(n_bits):
+        v[0, k] = 1 << (n_bits - 1 - k)
+
+    for j in range(1, n_dims):
+        p = int(poly[j])
+        m = p.bit_length() - 1  # degree of the primitive polynomial
+        # a-coefficients of the polynomial (excluding leading/trailing 1s)
+        include = [(p >> (m - 1 - i)) & 1 for i in range(m - 1)]
+        for k in range(m):
+            v[j, k] = np.uint64(vinit[j, k]) << np.uint64(n_bits - 1 - k)
+        for k in range(m, n_bits):
+            newv = v[j, k - m] ^ (v[j, k - m] >> np.uint64(m))
+            for i in range(m - 1):
+                if include[i]:
+                    newv ^= v[j, k - 1 - i]
+            v[j, k] = newv
+    return v.astype(np.uint32)
+
+
+if __name__ == "__main__":
+    import pathlib
+
+    out = pathlib.Path(__file__).resolve().parent.parent / "orp_tpu/qmc/_data"
+    out.mkdir(parents=True, exist_ok=True)
+    dirs = joe_kuo_directions()
+    np.save(out / f"joe_kuo_{N_DIMS}x{N_BITS}.npy", dirs)
+    print("wrote", out / f"joe_kuo_{N_DIMS}x{N_BITS}.npy", dirs.shape, dirs.dtype)
