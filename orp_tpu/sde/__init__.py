@@ -1,0 +1,25 @@
+"""L2/L3: SDE scan kernels, grids, payoffs."""
+
+from orp_tpu.sde.grid import TimeGrid, bond_curve, reduce_grid
+from orp_tpu.sde.kernels import (
+    scan_sde,
+    simulate_gbm_arithmetic,
+    simulate_gbm_basket,
+    simulate_gbm_log,
+    simulate_heston_log,
+    simulate_pension,
+)
+from orp_tpu.sde import payoffs
+
+__all__ = [
+    "TimeGrid",
+    "bond_curve",
+    "reduce_grid",
+    "scan_sde",
+    "simulate_gbm_arithmetic",
+    "simulate_gbm_basket",
+    "simulate_gbm_log",
+    "simulate_heston_log",
+    "simulate_pension",
+    "payoffs",
+]

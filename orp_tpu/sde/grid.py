@@ -1,0 +1,77 @@
+"""Time grids, bond curve, and rebalance-grid reduction (L3 support).
+
+Reference semantics being re-designed here:
+- ``n_time_steps = ceil(T/dt) + 1`` grid columns including t=0
+  (``Replicating_Portfolio.py:51``);
+- bond/bank account ``B(t) = exp(r t)`` broadcast over paths
+  (``Replicating_Portfolio.py:67-69``);
+- rebalance-grid reduction: stride-slice the fine simulation grid down to the
+  rebalance dates and rescale ``dt`` (``Replicating_Portfolio.py:92-96``,
+  ``European Options.ipynb#7``).
+
+The TPU design differs in one important way: the SDE scans can *store* directly on the
+coarse grid (``store_every`` in ``orp_tpu.sde.kernels``), so at 1M+ paths the fine-grid
+matrix never materialises in HBM. ``reduce_grid`` is still provided for the
+simulate-fine-store-fine path and for parity tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeGrid:
+    """Uniform simulation grid on [0, T] with ``n_steps`` steps (n_steps+1 knots)."""
+
+    T: float
+    n_steps: int
+
+    @property
+    def dt(self) -> float:
+        return self.T / self.n_steps
+
+    @property
+    def n_knots(self) -> int:
+        return self.n_steps + 1
+
+    def times(self, dtype=jnp.float32) -> jax.Array:
+        return jnp.linspace(0.0, self.T, self.n_knots, dtype=dtype)
+
+    def reduced(self, every: int) -> "TimeGrid":
+        """Coarse grid keeping every ``every``-th knot (must divide n_steps)."""
+        if self.n_steps % every != 0:
+            raise ValueError(f"store stride {every} must divide n_steps={self.n_steps}")
+        return TimeGrid(self.T, self.n_steps // every)
+
+    @staticmethod
+    def from_dt(T: float, dt: float) -> "TimeGrid":
+        """Reference-style constructor: ``n_time_steps = ceil(T/dt)+1`` knots
+        (``Replicating_Portfolio.py:51``)."""
+        return TimeGrid(T, math.ceil(T / dt))
+
+
+def bond_curve(grid: TimeGrid, r: float, dtype=jnp.float32) -> jax.Array:
+    """Deterministic bank account ``B(t)=e^{rt}`` on the grid knots, shape ``(n_knots,)``.
+
+    The reference broadcasts this to ``(n_paths, n_knots)`` (RP.py:68-69); here it stays
+    a vector and broadcasting happens lazily inside jit (XLA fuses it for free).
+    """
+    return jnp.exp(jnp.asarray(r, dtype) * grid.times(dtype))
+
+
+def reduce_grid(paths: jax.Array, every: int) -> jax.Array:
+    """Stride-slice ``(n_paths, n_knots)`` down to the rebalance knots.
+
+    Equivalent to the reference's ``Y[:, ::every]`` subsampling
+    (``Replicating_Portfolio.py:92-96``). Keeps both endpoints; requires
+    ``(n_knots-1) % every == 0``.
+    """
+    n_knots = paths.shape[-1]
+    if (n_knots - 1) % every != 0:
+        raise ValueError(f"reduction {every} must divide n_steps={n_knots - 1}")
+    return paths[..., ::every]

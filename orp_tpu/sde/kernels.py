@@ -1,0 +1,402 @@
+"""L2 SDE simulation kernels: TPU-native `lax.scan` recurrences over path vectors.
+
+Re-design (not a translation) of the reference's Python-loop Euler simulators:
+
+- arithmetic-Euler GBM pension fund      ``Replicating_Portfolio.py:60-65``
+- exact log-Euler GBM (European options) ``European Options.ipynb#6``
+- CIR stochastic vol + log-GBM coupling  ``Replicating_Portfolio.py:280-289``
+- mortality intensity                    ``Replicating_Portfolio.py:71-76``
+- binomial population thinning           ``Replicating_Portfolio.py:78-84``
+
+Design choices (TPU-first):
+- Time is a ``lax.scan`` (the recurrence is inherently sequential); paths are a flat
+  vector axis that shards over the mesh with zero communication — Sobol draws are
+  index-addressed per shard (see ``orp_tpu.qmc.sobol``).
+- Sobol dimensions stream per step: step ``t`` (1-based) consumes dimensions
+  ``(t-1)*n_factors + f``. The full ``(n_paths, n_steps)`` increment matrix never
+  materialises — O(paths) memory however long the horizon ("sequence scaling",
+  SURVEY.md §5).
+- ``store_every`` fuses the reference's simulate-fine-then-subsample
+  (``Replicating_Portfolio.py:92-96``) into the scan: only rebalance-grid knots are
+  stored, so 1M paths x 3650 fine steps needs coarse-grid HBM only.
+- All kernels are pure functions of (indices, seed) -> bitwise-reproducible on a fixed
+  topology; the reference's global-mutable-seed discipline (RP.py:27,:83) is replaced
+  by folded keys / dimension-hashed scrambling.
+
+Sharding contract: every function here is elementwise over the path axis; call them
+inside ``jit`` with ``indices`` sharded over a 1-D ``("paths",)`` mesh and XLA inserts
+no collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from orp_tpu.qmc.sobol import _N_DIMS, sobol_normal
+from orp_tpu.sde.grid import TimeGrid
+
+# step_fn(state, z, t, dt) -> new_state; z is (n, n_factors), t is the 1-based
+# global step index (traced int32).
+StepFn = Callable[[Any, jax.Array, jax.Array, float], Any]
+
+
+def scan_sde(
+    step_fn: StepFn,
+    state0: Any,
+    out_fn: Callable[[Any], Any],
+    indices: jax.Array,
+    grid: TimeGrid,
+    n_factors: int,
+    seed: int,
+    *,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+):
+    """Generic SDE driver: scan ``step_fn`` over the grid, storing every ``store_every``.
+
+    Returns ``(final_state, trajectory)`` where ``trajectory`` is the pytree of
+    ``out_fn(state)`` with a leading path axis and a coarse-time axis appended:
+    each leaf has shape ``(n_paths, n_steps//store_every + 1, ...)`` and column 0 is
+    the initial condition.
+    """
+    if grid.n_steps % store_every != 0:
+        raise ValueError(f"store_every={store_every} must divide n_steps={grid.n_steps}")
+    if grid.n_steps * n_factors > _N_DIMS:
+        raise ValueError(
+            f"n_steps*n_factors = {grid.n_steps * n_factors} exceeds the "
+            f"{_N_DIMS}-dimension Sobol direction table; regenerate with "
+            "tools/gen_directions.py at a larger N_DIMS"
+        )
+    n_blocks = grid.n_steps // store_every
+    dt = grid.dt
+    factor_ids = jnp.arange(n_factors, dtype=jnp.uint32)
+
+    def substep(state, t):
+        dims = (t - 1).astype(jnp.uint32) * n_factors + factor_ids
+        z = sobol_normal(indices, dims, seed, scramble=scramble, dtype=dtype)
+        return step_fn(state, z, t, dt)
+
+    def block(state, b):
+        t0 = b * store_every
+
+        def body(i, st):
+            return substep(st, (t0 + i + 1).astype(jnp.int32))
+
+        state = jax.lax.fori_loop(0, store_every, body, state)
+        return state, out_fn(state)
+
+    state, outs = jax.lax.scan(block, state0, jnp.arange(n_blocks, dtype=jnp.int32))
+    out0 = out_fn(state0)
+    traj = jax.tree.map(
+        lambda o0, o: jnp.moveaxis(jnp.concatenate([o0[None], o], axis=0), 0, 1),
+        out0,
+        outs,
+    )
+    return state, traj
+
+
+# ---------------------------------------------------------------------------
+# Single-asset kernels
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("grid", "scramble", "store_every", "dtype", "n_factors", "factor")
+)
+def simulate_gbm_arithmetic(
+    indices: jax.Array,
+    grid: TimeGrid,
+    y0: float,
+    mu: float,
+    sigma: float,
+    seed: int = 1235,
+    *,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+    n_factors: int = 1,
+    factor: int = 0,
+) -> jax.Array:
+    """Arithmetic-Euler GBM: ``Y_t = Y_{t-1}(1 + mu dt + sigma sqrt(dt) Z_t)``.
+
+    Semantics of the reference pension-fund simulator (RP.py:64-65). Returns
+    ``(n_paths, n_stored_knots)``. ``n_factors``/``factor`` place this asset inside a
+    wider factor layout when co-simulated with other processes.
+    """
+    y0 = jnp.asarray(y0, dtype)
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+
+    def step(y, z, t, dt):
+        return y * (1 + mu * dt + sigma * sdt * z[:, factor])
+
+    state0 = jnp.full(indices.shape, y0, dtype)
+    _, traj = scan_sde(
+        step, state0, lambda y: y, indices, grid, n_factors, seed,
+        scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return traj
+
+
+@functools.partial(
+    jax.jit, static_argnames=("grid", "scramble", "store_every", "dtype", "n_factors", "factor")
+)
+def simulate_gbm_log(
+    indices: jax.Array,
+    grid: TimeGrid,
+    s0: float,
+    drift: float,
+    sigma: float,
+    seed: int = 1234,
+    *,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+    n_factors: int = 1,
+    factor: int = 0,
+) -> jax.Array:
+    """Exact log-Euler GBM: ``S_t = S_{t-1} exp((drift - sigma^2/2) dt + sigma sqrt(dt) Z)``.
+
+    Semantics of the European-option simulator (``European Options.ipynb#6``, risk-
+    neutral ``drift=r``). Log-space accumulation keeps f32 drift error tiny over 3650+
+    steps (SURVEY.md §7 numerics policy).
+    """
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+    c0 = (drift - 0.5 * sigma * sigma) * grid.dt
+
+    def step(logs, z, t, dt):
+        return logs + c0 + sigma * sdt * z[:, factor]
+
+    state0 = jnp.full(indices.shape, jnp.log(jnp.asarray(s0, dtype)), dtype)
+    _, traj = scan_sde(
+        step, state0, lambda x: x, indices, grid, n_factors, seed,
+        scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return jnp.exp(traj)
+
+
+# ---------------------------------------------------------------------------
+# Pension model: fund + mortality + binomial population (coupled system)
+# ---------------------------------------------------------------------------
+
+
+def _binomial_step(key, t, n_prev, p, z, mode):
+    """One population-thinning step: ``N_t ~ Binomial(N_{t-1}, p)``.
+
+    ``exact``: stateless ``jax.random.binomial`` with a per-step folded key — the
+    TPU-native replacement of the reference's ``np.random.seed(1234+t)`` global-state
+    discipline (RP.py:83). ``normal``: moment-matched normal approximation driven by
+    the Sobol factor ``z`` (fully deterministic QMC, faster at pod scale; excellent at
+    N~10^4 where skewness ~ N^{-1/2}).
+    """
+    if mode == "exact":
+        kt = jax.random.fold_in(key, t)
+        draw = jax.random.binomial(kt, n_prev, p)
+        return jnp.asarray(draw, n_prev.dtype)
+    mean = n_prev * p
+    var = n_prev * p * (1 - p)
+    draw = jnp.round(mean + jnp.sqrt(jnp.maximum(var, 0.0)) * z)
+    return jnp.clip(draw, 0.0, n_prev).astype(n_prev.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "grid", "scramble", "store_every", "dtype", "binomial_mode", "sv",
+        "cir_drift_times_dt",
+    ),
+)
+def simulate_pension(
+    indices: jax.Array,
+    grid: TimeGrid,
+    *,
+    y0: float,
+    mu: float,
+    sigma: float | None = None,
+    l0: float,
+    mort_c: float,
+    eta: float,
+    n0: float,
+    seed: int = 1234,
+    key: jax.Array | None = None,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+    binomial_mode: str = "exact",
+    sv: bool = False,
+    v0: float = 0.0,
+    cir_a: float = 0.0,
+    cir_b: float = 0.0,
+    cir_c: float = 0.0,
+    cir_drift_times_dt: bool = False,
+) -> dict[str, jax.Array]:
+    """Coupled pension-liability system: fund Y, mortality intensity lambda, survivors N.
+
+    One scan advances all processes jointly (the reference runs three separate Python
+    loops over the same grid, RP.py:60-84). Factor layout per step: 0=fund shock,
+    1=mortality shock, 2=stochastic-vol shock (SV mode), 3=population shock (normal
+    binomial mode); unused factors are dead-code-eliminated by XLA.
+
+    ``sv=True`` switches the fund to the reference's CIR-vol + log-GBM coupling
+    (RP.py:280-289): ``v_t = v_{t-1} + a(b - v_{t-1})·[dt] + c sqrt(v_{t-1} dt) Z``.
+    The reference *omits* dt on the mean-reversion drift (RP.py:285) — default
+    ``cir_drift_times_dt=False`` preserves that quirk; ``True`` applies the
+    conventional ``a(b-v)dt`` drift. Fund log-drift is
+    ``(mu - v_t^2/2) dt`` (v holds *vol*, so this is the standard Ito correction).
+    Mortality: ``lam_t = lam_{t-1}(1 + c dt) + eta sqrt(dt) Z``
+    (RP.py:75-76). Population: binomial thinning with ``p_t = exp(-lam_t dt)``
+    (RP.py:81-84).
+
+    Returns dict of ``(n_paths, n_stored+1)`` arrays: ``Y``, ``lam``, ``N`` (+ ``v``
+    when ``sv``).
+    """
+    if not sv and sigma is None:
+        raise ValueError("sigma is required when sv=False (constant-vol fund)")
+    if key is None:
+        key = jax.random.key(seed)
+    n = indices.shape[0]
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+
+    def step(state, z, t, dt):
+        if sv:
+            logy, v, lam, pop = state
+            drift_scale = dt if cir_drift_times_dt else 1.0
+            v_new = (
+                v
+                + cir_a * (cir_b - v) * drift_scale
+                + cir_c * jnp.sqrt(jnp.maximum(v * dt, 0.0)) * z[:, 2]
+            )
+            logy = logy + (mu - 0.5 * v_new * v_new) * dt + v_new * sdt * z[:, 0]
+        else:
+            y, lam, pop = state
+            y = y * (1 + mu * dt + sigma * sdt * z[:, 0])
+        lam = lam + mort_c * lam * dt + eta * sdt * z[:, 1]
+        p = jnp.exp(-lam * dt)
+        zpop = z[:, 3] if binomial_mode == "normal" else z[:, 0]
+        pop = _binomial_step(key, t, pop, p, zpop, binomial_mode)
+        return (logy, v_new, lam, pop) if sv else (y, lam, pop)
+
+    if sv:
+        state0 = (
+            jnp.full((n,), jnp.log(jnp.asarray(y0, dtype)), dtype),
+            jnp.full((n,), jnp.asarray(v0, dtype), dtype),
+            jnp.full((n,), jnp.asarray(l0, dtype), dtype),
+            jnp.full((n,), jnp.asarray(n0, dtype), dtype),
+        )
+        out_fn = lambda s: {"Y": jnp.exp(s[0]), "v": s[1], "lam": s[2], "N": s[3]}
+    else:
+        state0 = (
+            jnp.full((n,), jnp.asarray(y0, dtype), dtype),
+            jnp.full((n,), jnp.asarray(l0, dtype), dtype),
+            jnp.full((n,), jnp.asarray(n0, dtype), dtype),
+        )
+        out_fn = lambda s: {"Y": s[0], "lam": s[1], "N": s[2]}
+
+    n_factors = 4  # fixed layout; unused columns are DCE'd by XLA
+    _, traj = scan_sde(
+        step, state0, out_fn, indices, grid, n_factors, seed,
+        scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return traj
+
+
+# ---------------------------------------------------------------------------
+# Heston-style corrected SV (the "proper" variant SURVEY.md §7 step 2 calls for)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("grid", "scramble", "store_every", "dtype"))
+def simulate_heston_log(
+    indices: jax.Array,
+    grid: TimeGrid,
+    *,
+    s0: float,
+    mu: float,
+    v0: float,
+    kappa: float,
+    theta: float,
+    xi: float,
+    rho: float = 0.0,
+    seed: int = 1234,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+) -> dict[str, jax.Array]:
+    """Full-truncation-Euler Heston: ``dv = kappa(theta-v)dt + xi sqrt(v dt) Zv``,
+    ``dlogS = (mu - v/2)dt + sqrt(v dt)(rho Zv + sqrt(1-rho^2) Zs)``.
+
+    ``v`` is *variance* here (unlike the reference's vol-CIR, RP.py:285). Corrected
+    companion to ``simulate_pension(sv=True)``; the BASELINE.json Heston config runs on
+    this kernel.
+    """
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+    rho_c = (1.0 - rho * rho) ** 0.5
+
+    def step(state, z, t, dt):
+        logs, v = state
+        vp = jnp.maximum(v, 0.0)
+        zs = rho * z[:, 1] + rho_c * z[:, 0]
+        logs = logs + (mu - 0.5 * vp) * dt + jnp.sqrt(vp) * sdt * zs
+        v = v + kappa * (theta - vp) * dt + xi * jnp.sqrt(vp) * sdt * z[:, 1]
+        return (logs, v)
+
+    n = indices.shape[0]
+    state0 = (
+        jnp.full((n,), jnp.log(jnp.asarray(s0, dtype)), dtype),
+        jnp.full((n,), jnp.asarray(v0, dtype), dtype),
+    )
+    _, traj = scan_sde(
+        step, state0, lambda s: {"S": jnp.exp(s[0]), "v": s[1]},
+        indices, grid, 2, seed, scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return traj
+
+
+# ---------------------------------------------------------------------------
+# Correlated multi-asset GBM basket (BASELINE.json config 5)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("grid", "scramble", "store_every", "dtype"))
+def simulate_gbm_basket(
+    indices: jax.Array,
+    grid: TimeGrid,
+    *,
+    s0: jax.Array,
+    drift: jax.Array,
+    sigma: jax.Array,
+    corr: jax.Array,
+    seed: int = 1234,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Correlated log-Euler GBM for an A-asset basket: ``(n_paths, n_stored+1, A)``.
+
+    Correlation via Cholesky of ``corr`` applied to the per-step factor block —
+    an (n, A) x (A, A) matmul each step that XLA maps onto the MXU. No reference
+    analogue (single-asset only); required by the 5-asset BASELINE.json config.
+    """
+    s0 = jnp.asarray(s0, dtype)
+    drift = jnp.asarray(drift, dtype)
+    sigma = jnp.asarray(sigma, dtype)
+    A = s0.shape[0]
+    chol = jnp.linalg.cholesky(jnp.asarray(corr, dtype))
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+    c0 = (drift - 0.5 * sigma * sigma) * grid.dt  # (A,)
+
+    def step(logs, z, t, dt):
+        zc = z @ chol.T  # (n, A) correlated shocks
+        return logs + c0[None, :] + sigma[None, :] * sdt * zc
+
+    n = indices.shape[0]
+    state0 = jnp.broadcast_to(jnp.log(s0)[None, :], (n, A)).astype(dtype)
+    _, traj = scan_sde(
+        step, state0, lambda x: x, indices, grid, A, seed,
+        scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return jnp.exp(traj)

@@ -1,0 +1,49 @@
+"""L3 payoff / liability layer.
+
+Reference semantics:
+- European call/put switched by ``OPTION_TYPE`` (``European Options.ipynb#3, #8``);
+- pension floor ``Payoff_Y = max(Y_T, K)`` elementwise (``Replicating_Portfolio.py:88``);
+- liability ``S_T = Payoff_Y * N_T * P`` (``Replicating_Portfolio.py:100``);
+- out-of-money probability prints (``RP.py:89``, ``Euro#8``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def call(s_T: jax.Array, strike: float) -> jax.Array:
+    return jnp.maximum(s_T - strike, 0.0)
+
+
+def put(s_T: jax.Array, strike: float) -> jax.Array:
+    return jnp.maximum(strike - s_T, 0.0)
+
+
+def european(s_T: jax.Array, strike: float, option_type: str) -> jax.Array:
+    """``OPTION_TYPE``-switched European payoff (European Options.ipynb#8)."""
+    if option_type not in ("call", "put"):
+        raise ValueError(f"option_type must be 'call' or 'put', got {option_type!r}")
+    return call(s_T, strike) if option_type == "call" else put(s_T, strike)
+
+
+def basket_call(s_T: jax.Array, weights: jax.Array, strike: float) -> jax.Array:
+    """Arithmetic basket call on terminal prices ``s_T (n, A)``."""
+    return jnp.maximum(s_T @ jnp.asarray(weights, s_T.dtype) - strike, 0.0)
+
+
+def pension_floor(y_T: jax.Array, guarantee: float) -> jax.Array:
+    """Per-unit pension payoff ``max(Y_T, K)`` (RP.py:88)."""
+    return jnp.maximum(y_T, guarantee)
+
+
+def pension_liability(y_T: jax.Array, n_T: jax.Array, premium: float, guarantee: float) -> jax.Array:
+    """Aggregate liability ``S_T = max(Y_T, K) * N_T * P`` (RP.py:100)."""
+    return pension_floor(y_T, guarantee) * n_T * premium
+
+
+def out_of_money_prob(y_T: jax.Array, ref_level: float) -> jax.Array:
+    """``P(Y_T < ref)`` — the moneyness statistic used for bias warm starts
+    (RP.py:89 and the ``Phi_Psi`` bias init at RP.py:160)."""
+    return jnp.mean(jnp.where(y_T < ref_level, 1.0, 0.0))

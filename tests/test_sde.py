@@ -1,0 +1,166 @@
+"""Closed-form oracles for the L2 SDE kernels (SURVEY.md §4: promote the reference's
+inline drift checks into real tests).
+
+Reference parity floors (BASELINE.md): GBM drift error |mean(Y_T) - e^{mu T}| was
+~5e-3 (8k paths) / ~2e-3 (4k paths) in the reference; we hold the same bars.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from orp_tpu.sde import (
+    TimeGrid,
+    bond_curve,
+    payoffs,
+    reduce_grid,
+    simulate_gbm_arithmetic,
+    simulate_gbm_basket,
+    simulate_gbm_log,
+    simulate_heston_log,
+    simulate_pension,
+)
+
+IDX = lambda n: jnp.arange(n, dtype=jnp.uint32)
+
+
+def test_gbm_arithmetic_drift_matches_reference_bar():
+    # Single Time Step.ipynb#7(out): 8192 paths, 120 steps, T=10, mu=.08 -> |err| ~ 5e-3
+    grid = TimeGrid(T=10.0, n_steps=120)
+    y = simulate_gbm_arithmetic(IDX(8192), grid, 1.0, 0.08, 0.15, seed=1235, dtype=jnp.float64)
+    assert y.shape == (8192, 121)
+    target = np.exp(0.08 * 10)  # Euler bias at dt=1/12 is ~0.3%; match reference bar
+    assert abs(float(y[:, -1].mean()) - target) < 1.5e-2
+    # martingale of discounted arithmetic-Euler: exact E[Y_t] = (1+mu dt)^t
+    exact = (1 + 0.08 * grid.dt) ** grid.n_steps
+    assert abs(float(y[:, -1].mean()) - exact) < 5e-3
+
+
+def test_gbm_log_exact_drift_and_variance():
+    # European Options.ipynb#6(out): mean S_T 108.327487 vs 108.328707 at 4096 paths
+    grid = TimeGrid(T=1.0, n_steps=365)
+    s = simulate_gbm_log(IDX(4096), grid, 100.0, 0.08, 0.15, seed=7, dtype=jnp.float64)
+    m = float(s[:, -1].mean())
+    assert abs(m - 100 * np.exp(0.08)) < 0.15  # reference bar ~1.2e-3, QMC here ~1e-2
+    logs = np.log(np.asarray(s[:, -1]) / 100.0)
+    assert abs(logs.mean() - (0.08 - 0.5 * 0.15**2)) < 5e-3
+    assert abs(logs.std() - 0.15) < 5e-3
+
+
+def test_gbm_log_store_every_equals_reduce_grid():
+    grid = TimeGrid(T=1.0, n_steps=52)
+    fine = simulate_gbm_log(IDX(512), grid, 100.0, 0.05, 0.2, seed=3, dtype=jnp.float64)
+    coarse = simulate_gbm_log(
+        IDX(512), grid, 100.0, 0.05, 0.2, seed=3, store_every=4, dtype=jnp.float64
+    )
+    np.testing.assert_allclose(np.asarray(reduce_grid(fine, 4)), np.asarray(coarse), rtol=1e-12)
+
+
+def test_bond_curve():
+    grid = TimeGrid(T=10.0, n_steps=40)
+    b = bond_curve(grid, 0.03, dtype=jnp.float64)
+    assert b.shape == (41,)
+    np.testing.assert_allclose(np.asarray(b[-1]), np.exp(0.3), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(b[0]), 1.0)
+
+
+def test_pension_population_and_lambda_match_reference_stats():
+    # Single#9(out)/Multi#11(out): N(T) mean 8615-8617, std ~132 of 10000 at T=10
+    grid = TimeGrid(T=10.0, n_steps=120)
+    traj = simulate_pension(
+        IDX(8192), grid, y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075,
+        eta=0.000597, n0=10_000.0, seed=1234, dtype=jnp.float64,
+    )
+    nT = np.asarray(traj["N"][:, -1])
+    assert abs(nT.mean() - 8616) < 40
+    assert 80 < nT.std() < 200
+    lam = np.asarray(traj["lam"])
+    # E[lam_T] = l0 * (1 + c dt)^steps (discrete compounding of the Euler drift)
+    expected = 0.01 * (1 + 0.075 * grid.dt) ** grid.n_steps
+    assert abs(lam[:, -1].mean() - expected) < 5e-4
+    assert traj["Y"].shape == (8192, 121)
+
+
+def test_pension_binomial_normal_mode_close_to_exact():
+    grid = TimeGrid(T=10.0, n_steps=40)
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+              n0=10_000.0, seed=1234, dtype=jnp.float64)
+    a = simulate_pension(IDX(4096), grid, binomial_mode="exact", **kw)
+    b = simulate_pension(IDX(4096), grid, binomial_mode="normal", **kw)
+    assert abs(float(a["N"][:, -1].mean()) - float(b["N"][:, -1].mean())) < 30
+    assert abs(float(np.std(np.asarray(a["N"][:, -1]))) - float(np.std(np.asarray(b["N"][:, -1])))) < 30
+
+
+def test_sv_pension_reference_form_runs_and_is_sane():
+    # RP.py:280-289 semantics (drift without dt), CIR params from Extra#8(out)
+    grid = TimeGrid(T=10.0, n_steps=1000)
+    traj = simulate_pension(
+        IDX(2048), grid, y0=1.0, mu=0.0946, l0=0.01, mort_c=0.075, eta=0.000597,
+        n0=10_000.0, seed=1234, dtype=jnp.float64, sv=True, v0=0.16679,
+        cir_a=0.00336, cir_b=0.15431, cir_c=0.01583,
+    )
+    v = np.asarray(traj["v"])
+    assert np.isfinite(v).all()
+    # vol pulled toward b=0.154 (no-dt drift pulls hard: a*(b-v) per step)
+    assert 0.10 < v[:, -1].mean() < 0.20
+    assert np.isfinite(np.asarray(traj["Y"])).all()
+
+
+def test_heston_corrected_variance_mean_reversion():
+    grid = TimeGrid(T=2.0, n_steps=500)
+    traj = simulate_heston_log(
+        IDX(4096), grid, s0=100.0, mu=0.05, v0=0.09, kappa=2.0, theta=0.04,
+        xi=0.3, rho=-0.7, seed=5, dtype=jnp.float64,
+    )
+    v = np.asarray(traj["v"])
+    # E[v_t] = theta + (v0-theta) e^{-kappa t}
+    expected = 0.04 + (0.09 - 0.04) * np.exp(-2.0 * 2.0)
+    assert abs(v[:, -1].mean() - expected) < 4e-3
+    s = np.asarray(traj["S"])
+    assert np.isfinite(s).all()
+    # risk-neutral-style drift check under mu: E[S_T] ~ s0 e^{mu T}
+    assert abs(s[:, -1].mean() - 100 * np.exp(0.05 * 2)) / 100 < 0.05
+
+
+def test_basket_correlation_structure():
+    grid = TimeGrid(T=1.0, n_steps=64)
+    corr = np.array([[1.0, 0.6, 0.3], [0.6, 1.0, 0.5], [0.3, 0.5, 1.0]])
+    s = simulate_gbm_basket(
+        IDX(8192), grid, s0=jnp.array([100.0, 90.0, 110.0]),
+        drift=jnp.array([0.05, 0.05, 0.05]), sigma=jnp.array([0.2, 0.25, 0.15]),
+        corr=jnp.asarray(corr), seed=9, dtype=jnp.float64,
+    )
+    assert s.shape == (8192, 65, 3)
+    rets = np.diff(np.log(np.asarray(s)), axis=1).reshape(-1, 3)
+    emp = np.corrcoef(rets.T)
+    assert np.abs(emp - corr).max() < 0.05
+    m = np.asarray(s[:, -1, :]).mean(axis=0)
+    np.testing.assert_allclose(m, np.array([100, 90, 110]) * np.exp(0.05), rtol=2e-2)
+
+
+def test_payoffs():
+    sT = jnp.asarray([80.0, 100.0, 130.0])
+    np.testing.assert_allclose(np.asarray(payoffs.call(sT, 100.0)), [0, 0, 30])
+    np.testing.assert_allclose(np.asarray(payoffs.put(sT, 100.0)), [20, 0, 0])
+    np.testing.assert_allclose(
+        np.asarray(payoffs.european(sT, 100.0, "put")), [20, 0, 0]
+    )
+    with pytest.raises(ValueError):
+        payoffs.european(sT, 100.0, "straddle")
+    yT = jnp.asarray([0.8, 1.2])
+    np.testing.assert_allclose(np.asarray(payoffs.pension_floor(yT, 1.0)), [1.0, 1.2])
+    np.testing.assert_allclose(
+        np.asarray(payoffs.pension_liability(yT, jnp.asarray([9000.0, 8500.0]), 100.0, 1.0)),
+        [900_000.0, 1_020_000.0],
+    )
+    assert float(payoffs.out_of_money_prob(yT, 1.0)) == 0.5
+
+
+def test_determinism_same_seed_bitwise():
+    grid = TimeGrid(T=1.0, n_steps=32)
+    a = simulate_gbm_log(IDX(256), grid, 100.0, 0.08, 0.15, seed=11)
+    b = simulate_gbm_log(IDX(256), grid, 100.0, 0.08, 0.15, seed=11)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    c = simulate_gbm_log(IDX(256), grid, 100.0, 0.08, 0.15, seed=12)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))

@@ -8,15 +8,16 @@ kernel in ``orp_tpu/qmc/sobol.py``.
 
 Reference parity target: the reference draws scrambled Sobol points of dimension up to
 3651 (``Replicating_Portfolio.py:54-57`` via ``scipy.stats.qmc.Sobol``); we generate
-8192 dimensions so every reference configuration fits with headroom.
+16384 dimensions so every reference configuration (incl. multi-factor fine grids,
+up to ~4 factors x 3651 steps) fits with headroom.
 
 Run:  python tools/gen_directions.py
-Out:  orp_tpu/qmc/_data/joe_kuo_8192x32.npy  (uint32, shape (8192, 32), ~1 MB)
+Out:  orp_tpu/qmc/_data/joe_kuo_16384x32.npy  (uint32, shape (16384, 32), ~2 MB)
 """
 
 import numpy as np
 
-N_DIMS = 8192
+N_DIMS = 16384
 N_BITS = 32
 
 
