@@ -1,0 +1,5 @@
+"""L4: hedge networks as pytrees."""
+
+from orp_tpu.models.mlp import HedgeMLP, Params
+
+__all__ = ["HedgeMLP", "Params"]

@@ -1,0 +1,99 @@
+"""L4 hedge networks as plain pytrees (TPU-native re-design of the Keras graphs).
+
+Reference models (``Replicating_Portfolio.py:149-172``, ``European Options.ipynb#12``,
+``Single Time Step.ipynb#17``):
+
+- features ``(Y_t, N_t/N0, lam_t)`` (pension, 3) or ``(S_t,)`` (European, 1)
+  -> Dense(8, LeakyReLU) -> Dense(8, LeakyReLU) -> Dense(2, linear, 'Phi_Psi')
+  -> Dot with prices ``(Y_t, B_t)`` -> scalar portfolio value ``V_t``;
+- European variant *constrains* ``psi = 1 - phi`` (self-financing normalisation,
+  Euro#12) with a single-output head;
+- ``Phi_Psi`` bias warm-started to ``[1 - P(OTM), P(OTM)]`` — a moneyness-informed
+  initial allocation (RP.py:158-166);
+- kernel init ``RandomNormal(0, 0.1, seed=1234)`` (RP.py:149-150).
+
+Here the model is ~122 params, so there is no framework overhead to amortise: a
+params-pytree + pure ``apply`` keeps it trivially jit/vmap/pjit-compatible and lets the
+train loop donate/swap weights with zero ceremony. The whole forward is two tiny
+matmuls; at 1M paths the batch axis carries all the parallelism and shards over the
+("paths",) mesh with the params replicated.
+
+The reference's ``model2 = Model(..., outputs=S_out)`` weight-sharing bug
+(RP.py:172 — model2 silently reuses model1's graph) is NOT reproduced here: each loss
+gets its own params pytree. The intended-semantics mode and a bug-compatible shared
+mode are both offered by the backward-induction driver (orp_tpu/train/backward.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # nested dict pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class HedgeMLP:
+    """Config + pure functions for the (phi, psi) hedge network."""
+
+    n_features: int
+    hidden: tuple[int, ...] = (8, 8)
+    negative_slope: float = 0.3  # Keras LeakyReLU default alpha
+    constrain_self_financing: bool = False  # psi = 1 - phi (Euro#12)
+    init_scale: float = 0.1
+    dtype: Any = jnp.float32
+
+    @property
+    def n_outputs(self) -> int:
+        return 1 if self.constrain_self_financing else 2
+
+    def init(self, key: jax.Array, bias_init: tuple[float, float] | None = None) -> Params:
+        """Initialise params. ``bias_init=(phi0, psi0)`` warm-starts the output bias
+        with a moneyness-informed allocation (the RP.py:158-166 trick); for the
+        constrained model only ``phi0`` is used."""
+        sizes = (self.n_features, *self.hidden, self.n_outputs)
+        params = {}
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, sub = jax.random.split(key)
+            params[f"w{i}"] = (
+                jax.random.normal(sub, (fan_in, fan_out), self.dtype) * self.init_scale
+            )
+            params[f"b{i}"] = jnp.zeros((fan_out,), self.dtype)
+        if bias_init is not None:
+            last = len(sizes) - 2
+            b = jnp.asarray(bias_init[: self.n_outputs], self.dtype)
+            params[f"b{last}"] = b
+        return params
+
+    def holdings(self, params: Params, features: jax.Array) -> jax.Array:
+        """Forward to the ``Phi_Psi`` layer: ``(n, 2)`` holdings (phi, psi).
+
+        Equivalent of the reference's sub-``Model`` ending at layer 'Phi_Psi'
+        (RP.py:103-112) — here it is just the natural intermediate of the pure
+        forward, no graph surgery needed.
+        """
+        x = features.astype(self.dtype)
+        n_layers = len(self.hidden) + 1
+        for i in range(n_layers):
+            x = x @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n_layers - 1:
+                x = jnp.where(x >= 0, x, self.negative_slope * x)  # LeakyReLU
+        if self.constrain_self_financing:
+            phi = x[..., 0]
+            return jnp.stack([phi, 1.0 - phi], axis=-1)
+        return x
+
+    def value(self, params: Params, features: jax.Array, prices: jax.Array) -> jax.Array:
+        """Portfolio value ``V = phi*price_0 + psi*price_1`` (the Dot head).
+
+        ``prices`` is ``(n, 2)`` — typically ``(Y_t, B_t)``.
+        """
+        h = self.holdings(params, features)
+        return jnp.sum(h * prices.astype(self.dtype), axis=-1)
+
+    def n_params(self) -> int:
+        sizes = (self.n_features, *self.hidden, self.n_outputs)
+        return sum((a + 1) * b for a, b in zip(sizes[:-1], sizes[1:]))

@@ -184,18 +184,22 @@ def simulate_gbm_log(
 # ---------------------------------------------------------------------------
 
 
-def _binomial_step(key, t, n_prev, p, z, mode):
+def _binomial_step(key, t, indices, n_prev, p, z, mode):
     """One population-thinning step: ``N_t ~ Binomial(N_{t-1}, p)``.
 
-    ``exact``: stateless ``jax.random.binomial`` with a per-step folded key — the
-    TPU-native replacement of the reference's ``np.random.seed(1234+t)`` global-state
-    discipline (RP.py:83). ``normal``: moment-matched normal approximation driven by
-    the Sobol factor ``z`` (fully deterministic QMC, faster at pod scale; excellent at
-    N~10^4 where skewness ~ N^{-1/2}).
+    ``exact``: stateless ``jax.random.binomial`` under keys folded by *(step,
+    global path index)* — index-addressed like the Sobol stream, so per-shard
+    generation is bitwise-identical to monolithic generation (the zero-
+    communication sharding contract) and replaces the reference's
+    ``np.random.seed(1234+t)`` global-state discipline (RP.py:83).
+    ``normal``: moment-matched normal approximation driven by the Sobol factor
+    ``z`` (fully deterministic QMC, faster at pod scale; good at N~10^4 where
+    per-step death counts are ~10).
     """
     if mode == "exact":
         kt = jax.random.fold_in(key, t)
-        draw = jax.random.binomial(kt, n_prev, p)
+        pkeys = jax.vmap(jax.random.fold_in, (None, 0))(kt, indices)
+        draw = jax.vmap(jax.random.binomial)(pkeys, n_prev, p)
         return jnp.asarray(draw, n_prev.dtype)
     mean = n_prev * p
     var = n_prev * p * (1 - p)
@@ -277,7 +281,7 @@ def simulate_pension(
         lam = lam + mort_c * lam * dt + eta * sdt * z[:, 1]
         p = jnp.exp(-lam * dt)
         zpop = z[:, 3] if binomial_mode == "normal" else z[:, 0]
-        pop = _binomial_step(key, t, pop, p, zpop, binomial_mode)
+        pop = _binomial_step(key, t, indices, pop, p, zpop, binomial_mode)
         return (logy, v_new, lam, pop) if sv else (y, lam, pop)
 
     if sv:

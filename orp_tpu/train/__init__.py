@@ -1,0 +1,15 @@
+"""L4/L5: losses, on-device fit loop, backward-induction hedge training."""
+
+from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
+from orp_tpu.train.fit import FitConfig, fit, reference_lr_schedule
+from orp_tpu.train import losses
+
+__all__ = [
+    "BackwardConfig",
+    "BackwardResult",
+    "backward_induction",
+    "FitConfig",
+    "fit",
+    "reference_lr_schedule",
+    "losses",
+]

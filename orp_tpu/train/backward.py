@@ -1,0 +1,201 @@
+"""L5: backward-induction hedge training over rebalance dates.
+
+The core pricing algorithm (neural dynamic programming, the analogue of
+Longstaff–Schwartz): for each rebalance date t from T-1 down to 0, train hedge
+network(s) to replicate the next-date portfolio value, then set
+
+    values[:, t] = g_t + i * (h_t - g_t)
+
+where ``g`` is the MSE (expectation) model's prediction at t-prices, ``h`` the
+0.99-quantile model's, and ``i`` the cost-of-capital margin.
+
+Reference: ``Replicating_Portfolio.py:188-227`` (loop), ``:221`` (combine),
+``Multi Time Step.ipynb#20``, ``European Options.ipynb#13`` (MSE-only variant),
+``Single Time Step.ipynb#18`` (single static step). Semantics kept:
+
+- warm start: the same params are re-fit at each step without re-initialisation;
+  first (latest-time) step gets ``epochs_first`` (500) with ``patience_first`` (50),
+  subsequent steps ``epochs_warm`` (100) with ``patience_warm`` (7) (RP.py:203-209);
+- per-step ledgers: training metrics (loss/mae/mape of the fit at X1 —
+  RP.py:215), holdings (phi/psi per path), residual hedge error ("VaR")
+  ``values_{t+1} - phi Y_{t+1} - psi B_{t+1}`` (RP.py:114-121), and portfolio-
+  vs-discounted-payoff comparisons (RP.py:227);
+- ``dual_mode``:
+  * ``"separate"`` (default) — two independent param sets, the *intended*
+    semantics (as in Single Time Step.ipynb#17-18);
+  * ``"shared"`` — one param set trained by MSE then additionally by the
+    quantile loss each step, reproducing the accidental weight sharing of
+    RP.py:172 (model2 reused model1's graph tensors);
+  * ``"mse_only"`` — quantile branch off (European Options.ipynb#13).
+- holdings combine: ``phi = phi1 + i*(phi2 - phi1)`` elementwise then averaged —
+  the ``Single Time Step.ipynb#18`` convention, consistent with the value combine
+  ``g + i*(h - g)``. (RP.py:114 flips the sign, ``phi1 + i*(phi1 - phi2)`` — an
+  internal inconsistency of the reference; flag ``holdings_combine="py"``
+  reproduces it.)
+
+The per-step work (two ``fit`` calls + predictions) is each a single fused XLA
+program (see orp_tpu/train/fit.py); the date loop itself is a host loop of
+~40-520 iterations, which is negligible orchestration and keeps per-step compiled
+programs shape-stable (two compilations: first step's epoch count, warm steps').
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.train import losses as L
+from orp_tpu.train.fit import FitConfig, fit
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardConfig:
+    epochs_first: int = 500
+    epochs_warm: int = 100
+    patience_first: int = 50
+    patience_warm: int = 7
+    batch_size: int = 512
+    cost_of_capital: float = 0.1
+    quantile: float = 0.99
+    quantile_loss: str = "pinball"  # or "smoothed_pinball"
+    dual_mode: str = "separate"  # "separate" | "shared" | "mse_only"
+    holdings_combine: str = "single"  # "single" | "py"
+    lr: float | None = None  # None -> reference step schedule
+    seed: int = 1234
+
+
+@dataclasses.dataclass
+class BackwardResult:
+    """Ledgers from the backward walk. Time axis is rebalance-date index
+    0..n_dates-1 (the walk visits them in reverse; arrays are stored date-ascending).
+    """
+
+    values: jax.Array          # (n_paths, n_dates+1) portfolio values incl. terminal
+    phi: jax.Array             # (n_paths, n_dates) combined stock holdings
+    psi: jax.Array             # (n_paths, n_dates) combined bond holdings
+    var_residuals: jax.Array   # (n_paths, n_dates) next-date replication residuals
+    train_loss: np.ndarray     # (n_dates,) final fit loss per date (model1)
+    train_mae: np.ndarray      # (n_dates,)
+    train_mape: np.ndarray     # (n_dates,)
+    epochs_ran: np.ndarray     # (n_dates,)
+    params1: Any = None
+    params2: Any = None
+
+    @property
+    def v0(self) -> jax.Array:
+        """t=0 portfolio value per path; mean is the price estimate."""
+        return self.values[:, 0]
+
+
+def backward_induction(
+    model: HedgeMLP,
+    features: jax.Array,   # (n_paths, n_dates+1, n_features) per rebalance knot
+    y_prices: jax.Array,   # (n_paths, n_dates+1) risky-asset price at knots
+    b_prices: jax.Array,   # (n_dates+1,) bond price at knots
+    terminal_values: jax.Array,  # (n_paths,) normalised terminal condition
+    cfg: BackwardConfig,
+    *,
+    bias_init: tuple[float, float] | None = None,
+) -> BackwardResult:
+    """Run the backward hedge-training walk. All arrays may be device-sharded over
+    the path axis; parameters stay replicated."""
+    n_paths, n_knots = y_prices.shape
+    n_dates = n_knots - 1
+    dtype = model.dtype
+
+    key = jax.random.key(cfg.seed)
+    k1, k2, kfit = jax.random.split(key, 3)
+    params1 = model.init(k1, bias_init=bias_init)
+    params2 = params1 if cfg.dual_mode == "shared" else model.init(k2, bias_init=bias_init)
+
+    q_loss = L.make_loss(cfg.quantile_loss, q=cfg.quantile)
+    mse = L.make_loss("mse")
+    metric_fns = (L.mae, L.mape)
+
+    values = jnp.zeros((n_paths, n_knots), dtype)
+    values = values.at[:, -1].set(terminal_values.astype(dtype))
+
+    phi_cols, psi_cols, var_cols = [], [], []
+    tl, tmae, tmape, eps_ran = [], [], [], []
+
+    b_prices = jnp.asarray(b_prices, dtype)
+
+    for step_i, t in enumerate(range(n_dates - 1, -1, -1)):
+        first = step_i == 0
+        fit_cfg = FitConfig(
+            n_epochs=cfg.epochs_first if first else cfg.epochs_warm,
+            batch_size=cfg.batch_size,
+            patience=cfg.patience_first if first else cfg.patience_warm,
+            lr=cfg.lr,
+        )
+        feats_t = features[:, t]
+        prices_t = jnp.stack(
+            [y_prices[:, t], jnp.broadcast_to(b_prices[t], (n_paths,))], axis=-1
+        )
+        prices_t1 = jnp.stack(
+            [y_prices[:, t + 1], jnp.broadcast_to(b_prices[t + 1], (n_paths,))], axis=-1
+        )
+        target = values[:, t + 1]
+
+        kfit, ka, kb = jax.random.split(kfit, 3)
+        params1, aux1 = fit(
+            params1, feats_t, prices_t1, target, ka,
+            value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
+        )
+        g_t = model.value(params1, feats_t, prices_t)
+
+        if cfg.dual_mode == "mse_only":
+            h_t = g_t
+            params2 = params1
+        else:
+            if cfg.dual_mode == "shared":
+                params2 = params1
+            params2, _ = fit(
+                params2, feats_t, prices_t1, target, kb,
+                value_fn=model.value, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
+            )
+            h_t = model.value(params2, feats_t, prices_t)
+            if cfg.dual_mode == "shared":
+                params1 = params2
+
+        i_cc = cfg.cost_of_capital
+        v_t = g_t + i_cc * (h_t - g_t)
+        values = values.at[:, t].set(v_t)
+
+        # holdings + next-date replication residual ledgers (RP.py:103-125)
+        h1 = model.holdings(params1, feats_t)
+        h2 = model.holdings(params2, feats_t)
+        if cfg.dual_mode == "mse_only":
+            comb = h1
+        elif cfg.holdings_combine == "py":
+            comb = h1 + i_cc * (h1 - h2)  # RP.py:114 sign quirk
+        else:
+            comb = h1 + i_cc * (h2 - h1)  # Single#18, matches value combine
+        phi_cols.append(comb[:, 0])
+        psi_cols.append(comb[:, 1])
+        var_cols.append(target - jnp.sum(comb * prices_t1, axis=-1))
+
+        tl.append(float(aux1["final_loss"]))
+        tmae.append(float(aux1["mae"]))
+        tmape.append(float(aux1["mape"]))
+        eps_ran.append(int(aux1["n_epochs_ran"]))
+
+    # ledgers were appended walking t downward; store date-ascending
+    stack_asc = lambda cols: jnp.stack(cols[::-1], axis=1)
+    return BackwardResult(
+        values=values,
+        phi=stack_asc(phi_cols),
+        psi=stack_asc(psi_cols),
+        var_residuals=stack_asc(var_cols),
+        train_loss=np.array(tl[::-1]),
+        train_mae=np.array(tmae[::-1]),
+        train_mape=np.array(tmape[::-1]),
+        epochs_ran=np.array(eps_ran[::-1]),
+        params1=params1,
+        params2=params2,
+    )

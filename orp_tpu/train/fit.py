@@ -1,0 +1,174 @@
+"""On-device training loop: minibatch Adam + step-LR + early stopping w/ best-weights.
+
+TPU re-design of the reference's per-timestep Keras ``fit`` calls
+(``Replicating_Portfolio.py:203-211``):
+
+- Adam(1e-3 base) with the step schedule of ``scheduler`` (RP.py:128-136):
+  lr 1e-2 for epoch<100, 1e-3 for epoch<200, 5e-4 beyond;
+- ``EarlyStopping(monitor='loss', patience, restore_best_weights=True)``
+  (RP.py:174) — here a scan-carried (best_params, best_loss, wait, stopped) state;
+- minibatch 512, full data each epoch, reshuffled per epoch (Keras default).
+
+Where the reference crosses the Python<->TF-C++ boundary O(epochs x steps) times
+(SURVEY.md §3.1 hot loop B), here the ENTIRE fit — all epochs, all minibatches,
+early stopping included — is ONE compiled XLA program (`lax.scan` over epochs,
+inner scan over minibatches, `lax.cond` no-op once stopped). Host sees only the
+final params and the loss history.
+
+Sharding: data enters ``(n, ...)`` path-sharded; the per-epoch permutation is
+applied shard-locally via ``shard_map``-compatible index arithmetic when a mesh is
+given (see orp_tpu/parallel), or globally on one device. Gradient means over the
+batch are global reductions — XLA inserts the psum over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Params = Any
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+# model_value(params, features, prices) -> (n,) predictions
+ValueFn = Callable[[Params, jax.Array, jax.Array], jax.Array]
+
+
+def reference_lr_schedule(count_to_epoch: float = 1.0):
+    """The reference's step schedule (RP.py:128-136), as an optax schedule over
+    *epochs*: 1e-2 below 100, 1e-3 below 200, 5e-4 from 200 on."""
+
+    def schedule(epoch):
+        e = epoch * count_to_epoch
+        return jnp.where(e < 100, 1e-2, jnp.where(e < 200, 1e-3, 5e-4))
+
+    return schedule
+
+
+@dataclasses.dataclass(frozen=True)
+class FitConfig:
+    n_epochs: int = 100
+    batch_size: int = 512
+    patience: int = 7
+    min_delta: float = 0.0
+    shuffle: bool = True
+    lr: float | None = None  # constant LR; None -> reference step schedule
+
+
+def _make_optimizer(cfg: FitConfig):
+    if cfg.lr is not None:
+        return optax.adam(cfg.lr)
+    # inject_hyperparams lets the scan-carried epoch drive the LR
+    return optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg")
+)
+def fit(
+    params: Params,
+    features: jax.Array,
+    prices: jax.Array,
+    targets: jax.Array,
+    key: jax.Array,
+    *,
+    value_fn: ValueFn,
+    loss_fn: LossFn,
+    cfg: FitConfig,
+    metric_fns: tuple = (),
+) -> tuple[Params, dict[str, jax.Array]]:
+    """Train ``params`` so ``value_fn(params, features, prices) ~ targets``.
+
+    One fused XLA program. Returns ``(best_params, aux)`` where ``aux`` has
+    ``loss_history (n_epochs,)`` (inf past the stop epoch), ``best_loss``,
+    ``n_epochs_ran``, and final-data metrics (evaluated with best params —
+    the reference's ``restore_best_weights=True`` then ``evaluate`` pattern,
+    RP.py:174, :215).
+    """
+    n = targets.shape[0]
+    bs = min(cfg.batch_size, n)
+    n_batches = max(n // bs, 1)
+    n_used = n_batches * bs
+    schedule = reference_lr_schedule() if cfg.lr is None else None
+
+    opt = _make_optimizer(cfg)
+    opt_state = opt.init(params)
+
+    def batch_loss(p, f, pr, t):
+        return loss_fn(value_fn(p, f, pr), t)
+
+    grad_fn = jax.value_and_grad(batch_loss)
+
+    def run_epoch(params, opt_state, epoch, ekey):
+        if cfg.shuffle:
+            perm = jax.random.permutation(ekey, n)[:n_used]
+        else:
+            perm = jnp.arange(n_used)
+        fb = features[perm].reshape(n_batches, bs, *features.shape[1:])
+        pb = prices[perm].reshape(n_batches, bs, *prices.shape[1:])
+        tb = targets[perm].reshape(n_batches, bs)
+
+        def step(carry, batch):
+            p, s = carry
+            f, pr, t = batch
+            loss, g = grad_fn(p, f, pr, t)
+            loss = loss.astype(ldtype)
+            if schedule is not None:
+                s.hyperparams["learning_rate"] = schedule(epoch)
+            updates, s = opt.update(g, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), loss
+
+        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (fb, pb, tb))
+        return params, opt_state, jnp.mean(losses)
+
+    def epoch_body(carry, xs):
+        params, opt_state, best_params, best_loss, wait, stopped = carry
+        epoch, ekey = xs
+
+        def do(_):
+            p, s, loss = run_epoch(params, opt_state, epoch, ekey)
+            improved = loss < best_loss - cfg.min_delta
+            bp = jax.tree.map(
+                lambda new, old: jnp.where(improved, new, old), p, best_params
+            )
+            bl = jnp.where(improved, loss, best_loss).astype(ldtype)
+            w = jnp.where(improved, 0, wait + 1).astype(jnp.int32)
+            stop = w >= cfg.patience  # Keras EarlyStopping: stop once wait hits patience
+            return (p, s, bp, bl, w, stop), loss
+
+        def skip(_):
+            return (params, opt_state, best_params, best_loss, wait, stopped), jnp.asarray(
+                jnp.inf, ldtype
+            )
+
+        carry, loss = jax.lax.cond(stopped, skip, do, None)
+        return carry, loss
+
+    ldtype = jnp.result_type(targets.dtype)
+    keys = jax.random.split(key, cfg.n_epochs)
+    init = (
+        params,
+        opt_state,
+        params,
+        jnp.asarray(jnp.inf, ldtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(False),
+    )
+    (params, _, best_params, best_loss, _, _), loss_hist = jax.lax.scan(
+        epoch_body, init, (jnp.arange(cfg.n_epochs), keys)
+    )
+
+    aux = {
+        "loss_history": loss_hist,
+        "best_loss": best_loss,
+        "n_epochs_ran": jnp.sum(jnp.isfinite(loss_hist)),
+    }
+    pred = value_fn(best_params, features, prices)
+    aux["final_loss"] = loss_fn(pred, targets)
+    for fn in metric_fns:
+        aux[fn.__name__] = fn(pred, targets)
+    return best_params, aux

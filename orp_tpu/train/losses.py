@@ -1,0 +1,64 @@
+"""Losses and metrics (reference: ``Replicating_Portfolio.py:138-145, :174-180``).
+
+- ``mse`` — model1's expectation-hedge loss;
+- ``pinball(q)`` — the 0.99 quantile/VaR-hedge loss of model2
+  (``quantile_loss``, RP.py:138-142): ``mean(max(q*e, (q-1)*e))``, ``e = y - y_hat``;
+- ``smoothed pinball`` — a Huberised variant for gradient density at extreme
+  quantiles (SURVEY.md §7 hard-part 5: at q=0.99 only ~1% of residuals carry the
+  upper gradient branch; smoothing the kink stabilises full-batch training);
+- metrics ``mae`` / ``mape`` (compiled into the reference models, RP.py:177).
+
+All are mean-reductions over the path axis; under a sharded batch the mean is a
+global ``pmean``-style reduction that XLA lowers onto ICI automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    d = pred - target
+    return jnp.mean(d * d)
+
+
+def mae(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.abs(pred - target))
+
+
+def mape(pred: jax.Array, target: jax.Array, eps: float = 1e-7) -> jax.Array:
+    """Mean absolute percentage error, in percent (Keras convention)."""
+    return 100.0 * jnp.mean(jnp.abs((target - pred) / jnp.maximum(jnp.abs(target), eps)))
+
+
+def pinball(pred: jax.Array, target: jax.Array, q: float = 0.99) -> jax.Array:
+    """Quantile (pinball) loss at level ``q`` — RP.py:138-142 semantics."""
+    e = target - pred
+    return jnp.mean(jnp.maximum(q * e, (q - 1.0) * e))
+
+
+def smoothed_pinball(
+    pred: jax.Array, target: jax.Array, q: float = 0.99, delta: float = 1e-3
+) -> jax.Array:
+    """Pinball with a quadratic Huber-smoothed kink of half-width ``delta``.
+
+    Converges to ``pinball`` as delta -> 0; keeps gradients dense near the kink,
+    which matters for full-batch Adam at extreme quantiles on TPU.
+    """
+    e = target - pred
+    abs_e = jnp.abs(e)
+    quad = 0.5 * e * e / delta + 0.5 * delta
+    rho = jnp.where(abs_e <= delta, quad, abs_e)  # smoothed |e|
+    return jnp.mean(0.5 * rho + (q - 0.5) * e)
+
+
+def make_loss(name: str, q: float = 0.99, delta: float = 1e-3):
+    """Loss factory: 'mse' | 'pinball' | 'smoothed_pinball'."""
+    if name == "mse":
+        return mse
+    if name == "pinball":
+        return lambda p, t: pinball(p, t, q)
+    if name == "smoothed_pinball":
+        return lambda p, t: smoothed_pinball(p, t, q, delta)
+    raise ValueError(f"unknown loss {name!r}")

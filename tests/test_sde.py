@@ -164,3 +164,13 @@ def test_determinism_same_seed_bitwise():
     assert np.array_equal(np.asarray(a), np.asarray(b))
     c = simulate_gbm_log(IDX(256), grid, 100.0, 0.08, 0.15, seed=12)
     assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_pension_exact_binomial_is_index_addressed():
+    # per-shard generation must equal monolithic generation path-for-path
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+              n0=10_000.0, seed=1234)
+    grid = TimeGrid(10.0, 20)
+    full = simulate_pension(IDX(64), grid, **kw)
+    part = simulate_pension(jnp.arange(32, 64, dtype=jnp.uint32), grid, **kw)
+    assert np.array_equal(np.asarray(full["N"][32:]), np.asarray(part["N"]))

@@ -102,3 +102,12 @@ def test_seed_changes_points_but_not_law():
 def test_reference_signature_shape():
     z = oqmc.sobol_normal_matrix(10, 3, seed=1234)
     assert z.shape == (1024, 3)
+
+
+def test_low_precision_dtypes_stay_inside_unit_interval():
+    # bf16's 8-bit mantissa must not round the top bucket to 1.0 (ndtri -> inf)
+    idx = jnp.arange(4096, dtype=jnp.uint32)
+    for dt in (jnp.bfloat16, jnp.float16):
+        u = oqmc.sobol_uniform(idx, jnp.arange(2), seed=0, dtype=dt)
+        arr = np.asarray(u, dtype=np.float64)
+        assert arr.max() < 1.0 and arr.min() > 0.0, dt
