@@ -64,10 +64,12 @@ def test_losses_values():
 
 
 def test_lr_schedule_reference_steps():
+    # tolerance, not bitwise: XLA constant-folding of the select chain can land a
+    # few ULPs off the literal on some backends
     s = reference_lr_schedule()
-    assert float(s(0)) == 1e-2 and float(s(99)) == 1e-2
-    assert float(s(100)) == 1e-3 and float(s(199)) == 1e-3
-    assert float(s(200)) == 5e-4 and float(s(1000)) == 5e-4
+    expected = {0: 1e-2, 99: 1e-2, 100: 1e-3, 199: 1e-3, 200: 5e-4, 1000: 5e-4}
+    for e, lr in expected.items():
+        np.testing.assert_allclose(float(s(e)), lr, rtol=1e-9)
 
 
 def test_fit_learns_linear_hedge_exactly():
