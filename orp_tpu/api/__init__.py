@@ -1,0 +1,33 @@
+"""L7 config-driven entry points."""
+
+from orp_tpu.api.config import (
+    ActuarialConfig,
+    EuropeanConfig,
+    HedgeRunConfig,
+    MarketConfig,
+    SimConfig,
+    StochVolConfig,
+    TrainConfig,
+)
+from orp_tpu.api.pipelines import (
+    european_hedge,
+    pension_hedge,
+    replicating_portfolio,
+    replicating_portfolio_sv,
+    sigma_sweep,
+)
+
+__all__ = [
+    "ActuarialConfig",
+    "EuropeanConfig",
+    "HedgeRunConfig",
+    "MarketConfig",
+    "SimConfig",
+    "StochVolConfig",
+    "TrainConfig",
+    "european_hedge",
+    "pension_hedge",
+    "replicating_portfolio",
+    "replicating_portfolio_sv",
+    "sigma_sweep",
+]

@@ -1,0 +1,132 @@
+"""Typed, namespaced run configs (SURVEY.md §5 "config/flag system").
+
+The reference passes a *flat* dict consumed positionally
+(``Replicating_Portfolio.py:30-49``; example at ``Multi Time Step.ipynb#28``) —
+which is how its ``'c'`` key collision went unnoticed: in
+``Replicating_Portfolio_SV`` the CIR vol-of-vol (``RP.py:249``) is silently
+overwritten by the mortality drift (``RP.py:257``), so the SV simulation runs
+with the wrong parameter. Here every sub-model owns its namespace
+(``sv.c`` vs ``actuarial.mort_c``), making that bug unrepresentable; the legacy
+dict shim (``orp_tpu.api.pipelines.replicating_portfolio``) documents the fix.
+
+All configs are frozen dataclasses -> hashable -> usable as jit static args.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class MarketConfig:
+    """Fund / underlying dynamics and the money-market rate."""
+
+    y0: float = 1.0          # initial fund/underlying level (Y in RP.py:31)
+    mu: float = 0.08         # real-world drift (RP.py:34)
+    r: float = 0.03          # risk-free rate -> bond curve (RP.py:35)
+    sigma: float = 0.15      # constant vol (RP.py:36); ignored when sv is set
+
+
+@dataclasses.dataclass(frozen=True)
+class ActuarialConfig:
+    """Pension-liability population and mortality (RP.py:38-45).
+
+    ``mort_c`` is the reference's mortality drift ``c`` — renamed to kill the
+    ``'c'`` collision with the CIR vol-of-vol (RP.py:249 vs :257).
+    """
+
+    n0: int = 10_000         # initial policyholders N(0)
+    premium: float = 100.0   # P per policyholder
+    guarantee: float = 1.0   # K floor per unit fund (payoff max(Y_T, K))
+    age: int = 55            # x — carried for reporting only
+    l0: float = 0.01         # lambda(0) initial mortality intensity
+    mort_c: float = 0.075    # intensity drift
+    eta: float = 0.000597    # intensity vol
+
+
+@dataclasses.dataclass(frozen=True)
+class StochVolConfig:
+    """CIR stochastic-vol parameters (reference semantics: v is *vol*, not
+    variance — RP.py:280-289; calibrated values from Extra#8(out))."""
+
+    a: float = 0.00336       # mean-reversion speed
+    b: float = 0.15431       # long-run vol level
+    c: float = 0.01583       # vol-of-vol (the parameter RP.py:285 lost to the collision)
+    v0: float = 0.15         # initial vol
+    drift_times_dt: bool = False  # False reproduces RP.py:285 omitting dt on the drift
+
+    def feller_ok(self) -> bool:
+        """The 2ab >= c^2 condition checked by the reference's CIRParams
+        (Extra: Stochastic Volatility.ipynb#3)."""
+        return 2 * self.a * self.b >= self.c * self.c
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Path-simulation settings (L1/L2)."""
+
+    n_paths: int = 4096          # reference uses 2^n_paths Sobol points (RP.py:49)
+    T: float = 10.0
+    dt: float = 0.01             # fine simulation step (RP.py:47)
+    rebalance_every: int = 25    # fine steps per rebalance date (RP.py:92-96)
+    seed: int = 1234
+    seed_fund: int = 1235        # distinct Sobol stream for the fund (RP.py:60 vs :72)
+    scramble: str = "owen"
+    binomial_mode: str = "exact"  # "exact" | "normal" (orp_tpu.sde.kernels)
+    dtype: str = "float32"
+
+    @property
+    def n_steps(self) -> int:
+        # epsilon guards float quotients like 1/(1/365) = 365.00000000000006,
+        # which would otherwise ceil to a phantom 366th step
+        return math.ceil(self.T / self.dt - 1e-9)
+
+    @property
+    def n_rebalance(self) -> int:
+        if self.n_steps % self.rebalance_every != 0:
+            raise ValueError(
+                f"rebalance_every={self.rebalance_every} must divide n_steps={self.n_steps}"
+            )
+        return self.n_steps // self.rebalance_every
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Backward-induction training policy (mirrors orp_tpu.train.BackwardConfig)."""
+
+    epochs_first: int = 500
+    epochs_warm: int = 100
+    patience_first: int = 50
+    patience_warm: int = 7
+    batch_size: int = 512
+    cost_of_capital: float = 0.1
+    quantile: float = 0.99
+    quantile_loss: str = "pinball"
+    dual_mode: str = "separate"     # "separate" | "shared" | "mse_only"
+    holdings_combine: str = "single"
+    lr: float | None = None
+    seed: int = 1234
+
+
+@dataclasses.dataclass(frozen=True)
+class EuropeanConfig:
+    """European-option hedge run (``European Options.ipynb#3`` defaults)."""
+
+    s0: float = 100.0
+    strike: float = 100.0
+    r: float = 0.08
+    sigma: float = 0.15
+    option_type: str = "call"
+    constrain_self_financing: bool = True  # psi = 1 - phi head (Euro#12)
+
+
+@dataclasses.dataclass(frozen=True)
+class HedgeRunConfig:
+    """Top-level run config: market + actuarial + optional SV + sim + train."""
+
+    market: MarketConfig = MarketConfig()
+    actuarial: ActuarialConfig = ActuarialConfig()
+    sv: StochVolConfig | None = None
+    sim: SimConfig = SimConfig()
+    train: TrainConfig = TrainConfig()

@@ -1,0 +1,29 @@
+"""Mesh / sharding / distributed-reduction utilities (the framework's DP layer).
+
+The reference has no parallelism of any kind (SURVEY.md §2 rows 16-18: single
+Python process, NumPy on host, TF on one device). Here the Monte-Carlo path axis
+is the data-parallel axis: everything in the framework is elementwise over paths
+except (a) training-loss means (XLA lowers to ``psum`` over ICI) and (b) risk
+quantiles (handled by ``orp_tpu.parallel.quantiles``).
+"""
+
+from orp_tpu.parallel.mesh import (
+    make_mesh,
+    path_indices,
+    path_sharding,
+    replicated_sharding,
+    shard_paths,
+)
+from orp_tpu.parallel.quantiles import histogram_quantile, quantile
+from orp_tpu.parallel.multihost import initialize_multihost
+
+__all__ = [
+    "make_mesh",
+    "path_indices",
+    "path_sharding",
+    "replicated_sharding",
+    "shard_paths",
+    "histogram_quantile",
+    "quantile",
+    "initialize_multihost",
+]

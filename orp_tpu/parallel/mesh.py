@@ -1,0 +1,67 @@
+"""Device-mesh and path-sharding helpers.
+
+Design (SURVEY.md §5 "distributed communication backend"): a 1-D ``("paths",)``
+mesh is the framework's native topology — the Monte-Carlo path axis is
+embarrassingly parallel, the 122-param hedge nets replicate, and the only
+collectives the algorithm needs are loss/grad means (``psum``) and risk
+quantiles. Sobol generation is *index-addressed* (``orp_tpu.qmc.sobol``), so a
+path-sharded ``jnp.arange`` of global point indices makes every device generate
+exactly its own contiguous index range with zero communication — the QMC
+analogue of a sharded data loader.
+
+Multi-host: the same code runs under ``jax.distributed`` — ``make_mesh`` uses
+all visible devices (ICI within a slice, DCN across hosts handled by the
+runtime); nothing else changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "paths") -> Mesh:
+    """1-D mesh over the first ``n_devices`` visible devices (all by default)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs, dtype=object).reshape(len(devs)), (axis,))
+
+
+def path_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard leading (path) axis over the mesh; trailing axes replicated."""
+    axis = mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (for params / opt state / scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def path_indices(n_paths: int, mesh: Mesh | None = None, dtype=jnp.uint32) -> jax.Array:
+    """Global Sobol point indices ``0..n_paths-1``, path-sharded over ``mesh``.
+
+    Each device materialises only its own contiguous block; feeding this to the
+    index-addressed Sobol/SDE kernels gives communication-free shard-local path
+    generation (the contract of ``orp_tpu.sde.kernels``).
+    """
+    idx = jnp.arange(n_paths, dtype=dtype)
+    if mesh is not None:
+        if n_paths % mesh.devices.size != 0:
+            raise ValueError(
+                f"n_paths={n_paths} must be divisible by mesh size {mesh.devices.size}"
+            )
+        idx = jax.device_put(idx, path_sharding(mesh))
+    return idx
+
+
+def shard_paths(tree, mesh: Mesh):
+    """Device-put every array leaf with its leading axis sharded over ``mesh``."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, path_sharding(mesh, ndim=jnp.ndim(x))), tree
+    )

@@ -1,0 +1,46 @@
+"""Multi-host initialisation (the NCCL/MPI-backend equivalent, SURVEY.md §5).
+
+On a TPU pod slice each host sees only its local chips until
+``jax.distributed.initialize`` stitches them into one global runtime: ICI
+carries collectives within the slice, DCN across slices/hosts — all chosen by
+the XLA runtime, never by user code. After this call every ``make_mesh()`` is a
+*global* mesh and the path-sharded pipelines scale with zero code change.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize_multihost(
+    *,
+    auto: bool = False,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Initialise the distributed runtime; returns a topology summary.
+
+    Three modes:
+    - default (``auto=False``, no coordinator args): explicit no-op — single-
+      process run, nothing to stitch;
+    - ``auto=True``: calls ``jax.distributed.initialize()`` with no arguments so
+      JAX's pod auto-detection (metadata server / env) discovers the peers —
+      required on every host of a multi-host slice *before* any device use;
+    - manual: pass ``coordinator_address``/``num_processes``/``process_id``
+      explicitly (non-TPU clusters, e.g. CPU/GPU fleets over DCN).
+    """
+    if auto:
+        jax.distributed.initialize()
+    elif num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -1,0 +1,63 @@
+"""Distributed quantiles for risk analytics (VaR, fan charts).
+
+The reference computes quantiles with ``np.quantile``/pandas on host
+(``Replicating_Portfolio.py:122``, ``Multi Time Step.ipynb#23``). At 1M+ sharded
+paths a global sort forces an all-gather (SURVEY.md §7 hard-part 6), so two
+methods are provided:
+
+- ``method="sort"`` — exact ``jnp.quantile``; fine to ~10^6 values per host
+  (XLA gathers the sharded operand). Default.
+- ``method="histogram"`` — two-pass fixed-bin histogram inversion: global
+  min/max reduction, shard-local ``bincount``, global ``sum`` of counts (a
+  bins-sized ``psum`` over ICI instead of a paths-sized all-gather), then linear
+  interpolation inside the selected bin. Error <= (max-min)/bins; with the
+  default 16384 bins that is ~4 significant digits on typical P&L ranges —
+  tighter than MC noise at any realistic path count.
+
+Both are jit-compatible and shard-agnostic: they accept replicated or
+path-sharded inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def histogram_quantile(x: jax.Array, qs: jax.Array, n_bins: int = 16384) -> jax.Array:
+    """Approximate quantiles of flat ``x`` at levels ``qs`` via CDF inversion.
+
+    One pass for (min, max), one ``bincount`` pass, a ``cumsum`` over bins, and a
+    ``searchsorted`` + in-bin linear interpolation. All reductions are
+    bins-sized, never paths-sized.
+    """
+    x = x.reshape(-1)
+    qs = jnp.atleast_1d(jnp.asarray(qs, x.dtype))
+    n = x.shape[0]
+    lo = jnp.min(x)
+    hi = jnp.max(x)
+    span = jnp.maximum(hi - lo, jnp.finfo(x.dtype).tiny)
+    # bin index per value; top edge clamps into the last bin
+    b = jnp.clip(((x - lo) / span * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    counts = jnp.zeros((n_bins,), jnp.int32).at[b].add(1)
+    cdf = jnp.cumsum(counts).astype(x.dtype) / n  # cdf[i] = P(X <= right edge of bin i)
+    idx = jnp.searchsorted(cdf, qs, side="left")
+    idx = jnp.clip(idx, 0, n_bins - 1)
+    cdf_lo = jnp.where(idx > 0, cdf[jnp.maximum(idx - 1, 0)], 0.0)
+    mass = jnp.maximum(cdf[idx] - cdf_lo, jnp.finfo(x.dtype).tiny)
+    frac = jnp.clip((qs - cdf_lo) / mass, 0.0, 1.0)
+    edges_lo = lo + span * idx.astype(x.dtype) / n_bins
+    return edges_lo + span / n_bins * frac
+
+
+def quantile(x: jax.Array, qs, method: str = "sort", n_bins: int = 16384) -> jax.Array:
+    """Quantiles of ``x`` along its last flattening, dispatching on ``method``."""
+    qs_arr = jnp.atleast_1d(jnp.asarray(qs))
+    if method == "sort":
+        return jnp.quantile(x.reshape(-1), qs_arr.astype(x.dtype))
+    if method == "histogram":
+        return histogram_quantile(x, qs_arr, n_bins=n_bins)
+    raise ValueError(f"unknown quantile method {method!r}")

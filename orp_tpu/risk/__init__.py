@@ -1,0 +1,25 @@
+"""L6 risk analytics & reporting (SURVEY.md §2 rows 14-15)."""
+
+from orp_tpu.risk.analytics import (
+    FanChart,
+    HedgeReport,
+    build_report,
+    discounted_payoff_compare,
+    fan_chart,
+    holdings_summary,
+    residual_pnl_stats,
+    var_by_date,
+    var_overall,
+)
+
+__all__ = [
+    "FanChart",
+    "HedgeReport",
+    "build_report",
+    "discounted_payoff_compare",
+    "fan_chart",
+    "holdings_summary",
+    "residual_pnl_stats",
+    "var_by_date",
+    "var_overall",
+]

@@ -1,0 +1,197 @@
+"""Risk analytics: VaR ledgers, residual P&L, fan charts, holdings aggregation.
+
+TPU re-design of the reference's pandas/seaborn reporting layer:
+
+- per-step VaR quantile prints              ``Replicating_Portfolio.py:122``
+- VaR-over-time aggregation (groupby+quantile) ``Multi Time Step.ipynb#23``,
+  ``European Options.ipynb#16``
+- residual P&L at T scatter/stats           ``European Options.ipynb#15``
+- portfolio-value fan chart bands           ``Euro#20``, ``Multi#26``
+- phi/psi aggregation to the t=0 answer ×ADJUSTMENT_FACTOR
+  ``Replicating_Portfolio.py:229-235``, ``Multi#25``, ``Euro#18``
+- portfolio value vs discounted payoffs (P_E_Values ledger) ``RP.py:227``
+
+Everything here is plain arrays under jit — no pandas in the hot path; the
+quantile reductions go through ``orp_tpu.parallel.quantiles`` so they stay
+device-side and sharding-aware. Optional pandas frames at the edge are provided
+by ``to_frames`` for notebook parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.parallel.quantiles import quantile
+
+DEFAULT_VAR_QS = (0.98, 0.99, 0.995)
+DEFAULT_FAN_QS = (0.01, 0.05, 0.10, 0.90, 0.95, 0.99)
+
+
+def _columnwise_quantiles(x: jax.Array, qs, method: str) -> np.ndarray:
+    """Quantiles per time column of ``x (n_paths, n_cols)`` -> ``(n_cols, n_q)``,
+    as one device dispatch (not a per-column host loop)."""
+    qs_arr = jnp.asarray(qs, x.dtype)
+    if method == "sort":
+        return np.asarray(jnp.quantile(x, qs_arr, axis=0).T)
+    out = jax.vmap(lambda col: quantile(col, qs_arr, method=method), in_axes=1)(x)
+    return np.asarray(out)
+
+
+def var_by_date(
+    residuals: jax.Array, qs=DEFAULT_VAR_QS, method: str = "sort"
+) -> np.ndarray:
+    """Per-rebalance-date VaR quantiles of replication residuals.
+
+    ``residuals`` is ``(n_paths, n_dates)`` (the ``VaR_HV`` ledger,
+    RP.py:114-121); returns ``(n_dates, len(qs))`` — the ``groupby(level=0)`` +
+    quantile aggregation of ``Multi Time Step.ipynb#23``.
+    """
+    return _columnwise_quantiles(residuals, qs, method)
+
+
+def var_overall(residuals: jax.Array, qs=DEFAULT_VAR_QS, method: str = "sort") -> np.ndarray:
+    """Pooled VaR over all dates+paths (``European Options.ipynb#16`` overall print)."""
+    return np.asarray(quantile(residuals.reshape(-1), qs, method=method))
+
+
+@dataclasses.dataclass
+class FanChart:
+    """Quantile bands of portfolio value over time (``Euro#20`` chart data)."""
+
+    qs: np.ndarray      # (n_q,)
+    bands: np.ndarray   # (n_knots, n_q)
+    mean: np.ndarray    # (n_knots,)
+
+
+def fan_chart(values: jax.Array, qs=DEFAULT_FAN_QS, method: str = "sort") -> FanChart:
+    """Per-knot quantile bands + mean of the ``values`` matrix ``(n_paths, n_knots)``."""
+    return FanChart(
+        qs=np.asarray(qs),
+        bands=_columnwise_quantiles(values, qs, method),
+        mean=np.asarray(jnp.mean(values, axis=0)),
+    )
+
+
+def residual_pnl_stats(residual: jax.Array) -> dict[str, float]:
+    """Mean/std/min/max of terminal hedge residuals (``Euro#15(out)`` stats)."""
+    return {
+        "mean": float(jnp.mean(residual)),
+        "std": float(jnp.std(residual)),
+        "min": float(jnp.min(residual)),
+        "max": float(jnp.max(residual)),
+    }
+
+
+def holdings_summary(
+    phi: jax.Array, psi: jax.Array, adjustment_factor: float = 1.0
+) -> dict[str, np.ndarray]:
+    """Per-date mean holdings ×``adjustment_factor`` and the t=0 answer.
+
+    The reference's final aggregation (``Replicating_Portfolio.py:229-235``):
+    pandas ``groupby(T, Type).mean`` of the Phi_Psi ledger scaled by
+    ``ADJUSTMENT_FACTOR`` (= N·P for pensions, S0 for options). Here a plain
+    per-column mean.
+    """
+    phi_mean = np.asarray(jnp.mean(phi, axis=0)) * adjustment_factor
+    psi_mean = np.asarray(jnp.mean(psi, axis=0)) * adjustment_factor
+    return {
+        "phi_by_date": phi_mean,
+        "psi_by_date": psi_mean,
+        "phi0": float(phi_mean[0]),
+        "psi0": float(psi_mean[0]),
+    }
+
+
+def discounted_payoff_compare(
+    values: jax.Array,
+    terminal_payoff: jax.Array,
+    r: float,
+    times: jax.Array,
+) -> dict[str, np.ndarray]:
+    """Portfolio value vs discounted expected payoff per knot (P_E_Values ledger,
+    RP.py:227; the E^Q/E^P reference lines of the ``Euro#20`` fan chart).
+
+    ``times`` are the knot times ``(n_knots,)``; discounting uses ``exp(-r (T - t))``.
+    """
+    times = jnp.asarray(times)
+    T = times[-1]
+    e_payoff = jnp.mean(terminal_payoff)
+    disc = jnp.exp(-r * (T - times)) * e_payoff
+    return {
+        "mean_value": np.asarray(jnp.mean(values, axis=0)),
+        "discounted_payoff": np.asarray(disc),
+    }
+
+
+@dataclasses.dataclass
+class HedgeReport:
+    """Bundled L6 outputs for one hedge run (what the notebooks print/plot)."""
+
+    v0: float                      # learned t=0 price (adjusted units)
+    phi0: float
+    psi0: float
+    discounted_payoff: float       # e^{-rT} E[payoff] comparison line
+    var_by_date: np.ndarray        # (n_dates, n_q)
+    var_overall: np.ndarray        # (n_q,)
+    var_qs: tuple
+    residual_stats: dict[str, float]
+    fan: FanChart
+    holdings: dict[str, np.ndarray]
+    train_loss: np.ndarray
+    train_mae: np.ndarray
+    train_mape: np.ndarray
+    epochs_ran: np.ndarray
+
+    def summary(self) -> str:
+        qs = ", ".join(
+            f"{q:.1%}: {v:,.4f}" for q, v in zip(self.var_qs, self.var_overall)
+        )
+        if self.discounted_payoff != 0.0:
+            diff = f"diff {100 * (self.v0 / self.discounted_payoff - 1):+.3f}%"
+        else:
+            diff = "diff n/a (zero payoff)"
+        return (
+            f"V0 = {self.v0:,.4f} (discounted E[payoff] = {self.discounted_payoff:,.4f}, "
+            f"{diff})\n"
+            f"phi0 = {self.phi0:,.4f}, psi0 = {self.psi0:,.4f}\n"
+            f"overall VaR  {qs}\n"
+            f"residual P&L mean {self.residual_stats['mean']:+.4f} "
+            f"std {self.residual_stats['std']:.4f}"
+        )
+
+
+def build_report(
+    result,
+    *,
+    terminal_payoff: jax.Array,
+    r: float,
+    times: jax.Array,
+    adjustment_factor: float = 1.0,
+    var_qs=DEFAULT_VAR_QS,
+    fan_qs=DEFAULT_FAN_QS,
+    quantile_method: str = "sort",
+) -> HedgeReport:
+    """Assemble a full HedgeReport from a ``BackwardResult`` (orp_tpu.train.backward)."""
+    holdings = holdings_summary(result.phi, result.psi, adjustment_factor)
+    T = float(np.asarray(times)[-1])
+    disc = float(jnp.mean(terminal_payoff)) * float(np.exp(-r * T)) * adjustment_factor
+    return HedgeReport(
+        v0=float(jnp.mean(result.v0)) * adjustment_factor,
+        phi0=holdings["phi0"],
+        psi0=holdings["psi0"],
+        discounted_payoff=disc,
+        var_by_date=var_by_date(result.var_residuals, var_qs, method=quantile_method),
+        var_overall=var_overall(result.var_residuals, var_qs, method=quantile_method),
+        var_qs=tuple(var_qs),
+        residual_stats=residual_pnl_stats(result.var_residuals[:, -1]),
+        fan=fan_chart(result.values, fan_qs, method=quantile_method),
+        holdings=holdings,
+        train_loss=result.train_loss,
+        train_mae=result.train_mae,
+        train_mape=result.train_mape,
+        epochs_ran=result.epochs_ran,
+    )

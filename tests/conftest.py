@@ -16,6 +16,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The ambient TPU plugin ("axon") registers itself regardless of JAX_PLATFORMS;
+# the config update (unlike the env var) reliably pins the platform to CPU.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

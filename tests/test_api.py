@@ -1,0 +1,127 @@
+"""End-to-end pipeline oracles (SURVEY.md §4 items 2-3): config plumbing, the
+European hedge vs Black-Scholes, pension pipelines incl. SV, and the legacy
+flat-dict shims. Configs here are deliberately tiny — precision at full configs
+is tracked by bench.py, not unit tests."""
+
+import numpy as np
+import pytest
+
+from orp_tpu.api import (
+    ActuarialConfig,
+    EuropeanConfig,
+    HedgeRunConfig,
+    MarketConfig,
+    SimConfig,
+    StochVolConfig,
+    TrainConfig,
+    european_hedge,
+    pension_hedge,
+    replicating_portfolio,
+    replicating_portfolio_sv,
+    sigma_sweep,
+)
+from tests.test_train import bs_call
+
+FAST_TRAIN = TrainConfig(
+    epochs_first=200, epochs_warm=80, batch_size=2048, dual_mode="mse_only"
+)
+
+
+def test_sim_config_grid_derivations():
+    s = SimConfig(T=1.0, dt=1 / 365, rebalance_every=5)
+    assert s.n_steps == 365  # not the float-quotient phantom 366
+    assert s.n_rebalance == 73
+    with pytest.raises(ValueError):
+        SimConfig(T=1.0, dt=1 / 365, rebalance_every=7).n_rebalance
+
+
+def test_sv_config_feller_and_namespacing():
+    sv = StochVolConfig()
+    assert sv.feller_ok()  # calibrated Extra#8 params satisfy 2ab >= c^2
+    # the collision fix: mortality drift and CIR vol-of-vol are distinct fields
+    a = ActuarialConfig()
+    assert a.mort_c == 0.075 and sv.c == 0.01583
+
+
+def test_european_hedge_prices_near_black_scholes():
+    res = european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=4096, T=1.0, dt=1 / 16, rebalance_every=2),
+        FAST_TRAIN,
+    )
+    bs, _ = bs_call(100.0, 100.0, 0.08, 0.15, 1.0)
+    assert abs(res.v0 - bs) / bs < 0.12, (res.v0, bs)
+    # self-financing head: phi0 + psi0 ~ holdings summing near V0/S0 scale
+    assert 0.0 < res.phi0 < 100.0
+    assert res.report.var_by_date.shape[0] == 8
+    assert np.isfinite(res.report.train_loss).all()
+
+
+def test_european_put_pipeline_runs():
+    res = european_hedge(
+        EuropeanConfig(option_type="put", constrain_self_financing=False),
+        SimConfig(n_paths=2048, T=1.0, dt=0.25, rebalance_every=1),
+        TrainConfig(epochs_first=500, epochs_warm=200, batch_size=512, dual_mode="mse_only"),
+    )
+    bs_c, _ = bs_call(100.0, 100.0, 0.08, 0.15, 1.0)
+    bs_p = bs_c - 100.0 + 100.0 * np.exp(-0.08)  # put-call parity
+    assert abs(res.v0 - bs_p) < 1.0, (res.v0, bs_p)
+    # hedge ratio: phi (x S0 report scale) should be near the negative BS put delta
+    assert -45.0 < res.phi0 < -5.0, res.phi0
+
+
+PENSION_FAST = HedgeRunConfig(
+    sim=SimConfig(n_paths=1024, T=2.0, dt=1 / 12, rebalance_every=12),
+    train=TrainConfig(epochs_first=120, epochs_warm=60, batch_size=1024),
+)
+
+
+def test_pension_hedge_end_to_end():
+    res = pension_hedge(PENSION_FAST)
+    # liability floor: guaranteed premium pool is ~N0*P=1M; V0 must be of that order
+    assert 0.5e6 < res.v0 < 3e6, res.v0
+    assert res.report.phi0 > 0  # long the fund
+    assert res.backward.values.shape == (1024, 3)
+
+
+def test_pension_hedge_sv_runs():
+    cfg = HedgeRunConfig(
+        sv=StochVolConfig(),
+        sim=PENSION_FAST.sim,
+        train=PENSION_FAST.train,
+    )
+    res = pension_hedge(cfg)
+    assert np.isfinite(res.v0) and res.v0 > 0
+
+
+def test_sigma_sweep_monotone_total():
+    rows = sigma_sweep(
+        [0.05, 0.30],
+        HedgeRunConfig(sim=PENSION_FAST.sim, train=PENSION_FAST.train),
+    )
+    assert [r["sigma"] for r in rows] == [0.05, 0.30]
+    # Multi#30(out): higher sigma -> dearer guarantee -> larger total portfolio
+    assert rows[1]["total"] > rows[0]["total"]
+
+
+REF_PARAMS = {  # the exact key set of Multi Time Step.ipynb#28 (tiny grid)
+    "Y": 1.0, "K": 1.0, "T": 2.0, "mu": 0.08, "r": 0.03, "sigma": 0.15,
+    "rebalancing": 1.0, "N": 10_000, "P": 100.0, "x": 55,
+    "l0": 0.01, "c": 0.075, "ita": 0.000597, "dt": 1 / 12, "n_paths": 10,
+}
+
+
+def test_legacy_dict_shim():
+    phi, psi = replicating_portfolio(
+        REF_PARAMS, train=TrainConfig(epochs_first=100, epochs_warm=50, batch_size=1024)
+    )
+    assert np.isfinite(phi) and np.isfinite(psi)
+    # scaled by ADJUSTMENT_FACTOR = N*P = 1M: holdings are portfolio-sized
+    assert 1e4 < phi + psi < 5e6
+
+
+def test_legacy_sv_shim_uses_namespaced_c():
+    phi, psi = replicating_portfolio_sv(
+        REF_PARAMS, train=TrainConfig(epochs_first=60, epochs_warm=30, batch_size=1024)
+    )
+    assert np.isfinite(phi) and np.isfinite(psi)

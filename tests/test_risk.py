@@ -1,0 +1,67 @@
+"""Risk-analytics oracles: quantile ledgers, residual stats, fan bands,
+holdings aggregation (reference semantics per SURVEY.md §2 rows 14-15)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.risk import (
+    discounted_payoff_compare,
+    fan_chart,
+    holdings_summary,
+    residual_pnl_stats,
+    var_by_date,
+    var_overall,
+)
+
+
+def test_var_by_date_matches_numpy_quantiles():
+    rng = np.random.default_rng(0)
+    res = rng.normal(size=(4096, 5))
+    out = var_by_date(jnp.asarray(res), qs=(0.98, 0.99))
+    expect = np.quantile(res, [0.98, 0.99], axis=0).T
+    np.testing.assert_allclose(out, expect, atol=1e-6)
+    assert out.shape == (5, 2)
+
+
+def test_var_overall_pools_all_dates():
+    rng = np.random.default_rng(1)
+    res = rng.normal(size=(1024, 3))
+    out = var_overall(jnp.asarray(res), qs=(0.99,))
+    np.testing.assert_allclose(out, np.quantile(res, 0.99), atol=1e-6)
+
+
+def test_fan_chart_bands_ordered_and_centered():
+    rng = np.random.default_rng(2)
+    vals = rng.normal(loc=10.0, size=(8192, 4))
+    fc = fan_chart(jnp.asarray(vals))
+    assert fc.bands.shape == (4, 6)
+    # bands must be monotone in q at every knot
+    assert (np.diff(fc.bands, axis=1) >= 0).all()
+    np.testing.assert_allclose(fc.mean, vals.mean(axis=0), atol=1e-6)
+
+
+def test_residual_stats_keys_and_values():
+    r = jnp.asarray([-1.0, 0.0, 1.0, 2.0])
+    st = residual_pnl_stats(r)
+    assert st["mean"] == 0.5 and st["min"] == -1.0 and st["max"] == 2.0
+    np.testing.assert_allclose(st["std"], np.std([-1.0, 0.0, 1.0, 2.0]), rtol=1e-6)
+
+
+def test_holdings_summary_adjustment_factor():
+    # RP.py:229-235: groupby-mean x ADJUSTMENT_FACTOR; t=0 is column 0
+    phi = jnp.asarray([[0.6, 0.7], [0.8, 0.9]])
+    psi = jnp.asarray([[0.3, 0.2], [0.1, 0.0]])
+    out = holdings_summary(phi, psi, adjustment_factor=1_000_000.0)
+    np.testing.assert_allclose(out["phi0"], 0.7e6)
+    np.testing.assert_allclose(out["psi0"], 0.2e6)
+    np.testing.assert_allclose(out["phi_by_date"], [0.7e6, 0.8e6])
+
+
+def test_discounted_payoff_compare_lines():
+    vals = jnp.ones((128, 3)) * 5.0
+    payoff = jnp.full((128,), 7.0)
+    times = jnp.asarray([0.0, 0.5, 1.0])
+    out = discounted_payoff_compare(vals, payoff, r=0.1, times=times)
+    np.testing.assert_allclose(out["mean_value"], 5.0)
+    np.testing.assert_allclose(out["discounted_payoff"][-1], 7.0, rtol=1e-6)
+    np.testing.assert_allclose(out["discounted_payoff"][0], 7.0 * np.exp(-0.1), rtol=1e-6)
