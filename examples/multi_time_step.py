@@ -1,0 +1,46 @@
+"""Dynamic pension-liability hedge — parity example for ``Multi Time Step.ipynb``
+and ``Replicating_Portfolio(params)`` (RP.py:29-235).
+
+Reference outputs to compare (Multi#23/#25/#26(out)): V0=981,038 EUR,
+phi0=643,687 / psi0=350,888, VaR99=54.38 EUR; sigma sweep table at Multi#30.
+
+Run: env -u PALLAS_AXON_POOL_IPS python examples/multi_time_step.py [--sweep] [--sv]
+"""
+
+import argparse
+
+from orp_tpu.api import (
+    HedgeRunConfig,
+    SimConfig,
+    StochVolConfig,
+    TrainConfig,
+    pension_hedge,
+    sigma_sweep,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--paths", type=int, default=4096)
+    ap.add_argument("--sweep", action="store_true", help="Multi#29-30 sigma sweep")
+    ap.add_argument("--sv", action="store_true", help="RP_SV stochastic-vol variant")
+    args = ap.parse_args()
+
+    cfg = HedgeRunConfig(
+        sv=StochVolConfig() if args.sv else None,
+        # RP defaults: T=10y, dt=1/100, quarterly rebalancing -> 40 dates
+        sim=SimConfig(n_paths=args.paths, T=10.0, dt=0.01, rebalance_every=25),
+        train=TrainConfig(),  # dual separate models, 500/100 epochs, i=0.1
+    )
+    if args.sweep:
+        rows = sigma_sweep([0.05, 0.10, 0.15, 0.20, 0.30], cfg)
+        print(f"{'sigma':>6} {'phi0':>12} {'psi0':>12} {'total':>12}")
+        for r in rows:
+            print(f"{r['sigma']:6.2f} {r['phi']:12,.0f} {r['psi']:12,.0f} {r['total']:12,.0f}")
+    else:
+        res = pension_hedge(cfg)
+        print(res.report.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Static (one-step) pension hedge — parity example for ``Single Time Step.ipynb``.
+
+The reference trains both models from scratch for one 10y rebalance interval
+(8192 paths, monthly fine grid reduced to {0, T}) and reports (Single#23-24):
+phi0=819,539 stocks / psi0=257,308 bonds, V0=1,076,847 EUR.
+
+Run: env -u PALLAS_AXON_POOL_IPS python examples/single_time_step.py
+"""
+
+from orp_tpu.api import HedgeRunConfig, SimConfig, TrainConfig, pension_hedge
+
+
+def main():
+    n_steps = 120  # monthly over 10y (Single#5: dt=1/12)
+    cfg = HedgeRunConfig(
+        sim=SimConfig(n_paths=8192, T=10.0, dt=10.0 / n_steps, rebalance_every=n_steps),
+        # one date -> only the from-scratch 500-epoch phase runs; the reference
+        # combines with cost_of_capital = 0.1*dt there (Single#16)
+        train=TrainConfig(cost_of_capital=0.1 * (10.0 / n_steps)),
+    )
+    res = pension_hedge(cfg)
+    print(res.report.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,7 @@ class TrainConfig:
     holdings_combine: str = "single"
     lr: float | None = None
     seed: int = 1234
+    checkpoint_dir: str | None = None  # persist/resume per backward date
 
 
 @dataclasses.dataclass(frozen=True)

@@ -63,6 +63,7 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         holdings_combine=t.holdings_combine,
         lr=t.lr,
         seed=t.seed,
+        checkpoint_dir=t.checkpoint_dir,
     )
 
 
@@ -119,7 +120,11 @@ def european_hedge(
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
 
-    s0 = euro.s0  # ADJUSTMENT_FACTOR (Euro#13): everything trains in units of S0
+    # Euro#13 normalisation: features, prices (S and B) and values all in units
+    # of S0 (ADJUSTMENT_FACTOR). Holdings stay unadjusted in the report — the
+    # reference's phi0=0.10456/psi0=0.89544 (Euro#18) are in these normalised
+    # units; only values scale back by S0.
+    s0 = euro.s0
     model = HedgeMLP(n_features=1, constrain_self_financing=euro.constrain_self_financing)
     e_payoff_n = float(jnp.mean(payoff)) / s0
     bias = (e_payoff_n,) if euro.constrain_self_financing else (e_payoff_n, 0.0)
@@ -140,6 +145,7 @@ def european_hedge(
         r=euro.r,
         times=times,
         adjustment_factor=s0,
+        holdings_adjustment=1.0,
     )
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
 

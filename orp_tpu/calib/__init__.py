@@ -1,0 +1,17 @@
+"""Side pipeline: CIR volatility-parameter calibration (SURVEY.md §2 row 16)."""
+
+from orp_tpu.calib.cir import (
+    CIRParams,
+    annualized_drift,
+    estimate_cir_params,
+    log_returns,
+    rolling_volatility,
+)
+
+__all__ = [
+    "CIRParams",
+    "annualized_drift",
+    "estimate_cir_params",
+    "log_returns",
+    "rolling_volatility",
+]

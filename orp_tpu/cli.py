@@ -1,0 +1,201 @@
+"""Command-line driver: ``python -m orp_tpu.cli <command> [flags]``.
+
+The reference has no CLI (flat params dicts in notebook cells,
+``Multi Time Step.ipynb#28``); this is the typed-config equivalent with JSON
+output for scripting. Commands mirror the reference's four entry shapes:
+
+- ``euro``      European-option hedge   (European Options.ipynb)
+- ``pension``   pension-liability hedge (Replicating_Portfolio / Multi notebook;
+                ``--sv`` for the stochastic-vol variant, ``--single-step`` for
+                the Single Time Step shape)
+- ``sweep``     sigma sweep             (Multi Time Step.ipynb#29-30)
+- ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+
+def _train_cfg(args, default_dual: str):
+    from orp_tpu.api import TrainConfig
+
+    return TrainConfig(
+        epochs_first=args.epochs_first,
+        epochs_warm=args.epochs_warm,
+        batch_size=args.batch_size,
+        dual_mode=args.dual_mode or default_dual,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+
+
+def _add_train_flags(p):
+    p.add_argument("--epochs-first", type=int, default=500)
+    p.add_argument("--epochs-warm", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--dual-mode", choices=["separate", "shared", "mse_only"], default=None)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="persist per-date state; rerun resumes automatically")
+    p.add_argument("--json", action="store_true", help="emit a JSON result line")
+
+
+def _emit(args, report, extra=None):
+    if args.json:
+        out = {
+            "v0": report.v0,
+            "phi0": report.phi0,
+            "psi0": report.psi0,
+            "discounted_payoff": report.discounted_payoff,
+            "var_overall": report.var_overall.tolist(),
+            "var_qs": list(report.var_qs),
+        }
+        if extra:
+            out.update(extra)
+        print(json.dumps(out))
+    else:
+        print(report.summary())
+
+
+def cmd_euro(args):
+    from orp_tpu.api import EuropeanConfig, SimConfig, european_hedge
+
+    res = european_hedge(
+        EuropeanConfig(
+            s0=args.s0, strike=args.strike, r=args.r, sigma=args.sigma,
+            option_type=args.option_type,
+            constrain_self_financing=not args.unconstrained,
+        ),
+        SimConfig(
+            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+            rebalance_every=args.rebalance_every,
+        ),
+        _train_cfg(args, "mse_only"),
+    )
+    _emit(args, res.report)
+
+
+def cmd_pension(args):
+    from orp_tpu.api import (
+        HedgeRunConfig, MarketConfig, SimConfig, StochVolConfig, pension_hedge,
+    )
+
+    n_steps = args.steps
+    cfg = HedgeRunConfig(
+        market=MarketConfig(mu=args.mu, r=args.r, sigma=args.sigma),
+        sv=StochVolConfig() if args.sv else None,
+        sim=SimConfig(
+            n_paths=args.paths, T=args.T, dt=args.T / n_steps,
+            rebalance_every=n_steps if args.single_step else args.rebalance_every,
+        ),
+        train=_train_cfg(args, "separate"),
+    )
+    res = pension_hedge(cfg)
+    _emit(args, res.report)
+
+
+def cmd_sweep(args):
+    from orp_tpu.api import HedgeRunConfig, SimConfig, sigma_sweep
+
+    rows = sigma_sweep(
+        [float(s) for s in args.sigmas.split(",")],
+        HedgeRunConfig(
+            sim=SimConfig(
+                n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+                rebalance_every=args.rebalance_every,
+            ),
+            train=_train_cfg(args, "separate"),
+        ),
+    )
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(f"{'sigma':>8} {'phi0':>14} {'psi0':>14} {'total':>14}")
+        for r in rows:
+            print(f"{r['sigma']:8.2f} {r['phi']:14,.0f} {r['psi']:14,.0f} {r['total']:14,.0f}")
+
+
+def cmd_calibrate(args):
+    from orp_tpu.calib import (
+        annualized_drift, estimate_cir_params, log_returns, rolling_volatility,
+    )
+
+    prices = np.loadtxt(args.csv, delimiter=",", usecols=args.column, skiprows=args.skiprows)
+    rets = log_returns(prices)
+    vol = rolling_volatility(rets, window=args.window)
+    try:
+        params = estimate_cir_params(vol)
+    except ValueError as e:
+        print(f"calibration failed: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    out = {
+        "a": params.a, "b": params.b, "c": params.c,
+        "mu": annualized_drift(prices, args.years),
+        "sigma0": float(vol[-1]),
+    }
+    print(json.dumps(out) if args.json else
+          f"CIRParams(a={params.a:.6f}, b={params.b:.6f}, c={params.c:.6f})  "
+          f"mu={out['mu']:.5f}  sigma0={out['sigma0']:.5f}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="orp_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pe = sub.add_parser("euro", help="European option hedge")
+    pe.add_argument("--paths", type=int, default=4096)
+    pe.add_argument("--steps", type=int, default=364)
+    pe.add_argument("--rebalance-every", type=int, default=7)
+    pe.add_argument("--T", type=float, default=1.0)
+    pe.add_argument("--s0", type=float, default=100.0)
+    pe.add_argument("--strike", type=float, default=100.0)
+    pe.add_argument("--r", type=float, default=0.08)
+    pe.add_argument("--sigma", type=float, default=0.15)
+    pe.add_argument("--option-type", choices=["call", "put"], default="call")
+    pe.add_argument("--unconstrained", action="store_true",
+                    help="drop the psi=1-phi self-financing head")
+    _add_train_flags(pe)
+    pe.set_defaults(fn=cmd_euro)
+
+    pp = sub.add_parser("pension", help="pension-liability hedge")
+    pp.add_argument("--paths", type=int, default=4096)
+    pp.add_argument("--steps", type=int, default=1000)
+    pp.add_argument("--rebalance-every", type=int, default=25)
+    pp.add_argument("--T", type=float, default=10.0)
+    pp.add_argument("--mu", type=float, default=0.08)
+    pp.add_argument("--r", type=float, default=0.03)
+    pp.add_argument("--sigma", type=float, default=0.15)
+    pp.add_argument("--sv", action="store_true", help="CIR stochastic-vol fund")
+    pp.add_argument("--single-step", action="store_true",
+                    help="one rebalance interval (Single Time Step shape)")
+    _add_train_flags(pp)
+    pp.set_defaults(fn=cmd_pension)
+
+    ps = sub.add_parser("sweep", help="sigma sweep")
+    ps.add_argument("--sigmas", default="0.05,0.10,0.15,0.20,0.30")
+    ps.add_argument("--paths", type=int, default=4096)
+    ps.add_argument("--steps", type=int, default=1000)
+    ps.add_argument("--rebalance-every", type=int, default=25)
+    ps.add_argument("--T", type=float, default=10.0)
+    _add_train_flags(ps)
+    ps.set_defaults(fn=cmd_sweep)
+
+    pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
+    pc.add_argument("csv")
+    pc.add_argument("--column", type=int, default=0)
+    pc.add_argument("--skiprows", type=int, default=0)
+    pc.add_argument("--window", type=int, default=40)
+    pc.add_argument("--years", type=float, default=10.0)
+    pc.add_argument("--json", action="store_true")
+    pc.set_defaults(fn=cmd_calibrate)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,24 +171,40 @@ def build_report(
     r: float,
     times: jax.Array,
     adjustment_factor: float = 1.0,
+    holdings_adjustment: float | None = None,
     var_qs=DEFAULT_VAR_QS,
     fan_qs=DEFAULT_FAN_QS,
     quantile_method: str = "sort",
 ) -> HedgeReport:
-    """Assemble a full HedgeReport from a ``BackwardResult`` (orp_tpu.train.backward)."""
-    holdings = holdings_summary(result.phi, result.psi, adjustment_factor)
+    """Assemble a full HedgeReport from a ``BackwardResult`` (orp_tpu.train.backward).
+
+    ``adjustment_factor`` scales *values* (V0, discounted payoff);
+    ``holdings_adjustment`` scales phi/psi — defaults to the same factor
+    (pension semantics, RP.py:230: both x N0*P), but the European pipeline
+    passes 1.0 because its phi is already a stock-value fraction (Euro#18).
+    """
+    if holdings_adjustment is None:
+        holdings_adjustment = adjustment_factor
+    holdings = holdings_summary(result.phi, result.psi, holdings_adjustment)
     T = float(np.asarray(times)[-1])
-    disc = float(jnp.mean(terminal_payoff)) * float(np.exp(-r * T)) * adjustment_factor
+    adj = adjustment_factor
+    disc = float(jnp.mean(terminal_payoff)) * float(np.exp(-r * T)) * adj
+    # every value-denominated output scales by the same factor: the reference
+    # multiplies the VaR/residual ledgers by ADJUSTMENT_FACTOR before reporting
+    # (Multi#23 VaR in EUR; Euro#15-16 in units of S0)
+    fan = fan_chart(result.values, fan_qs, method=quantile_method)
+    fan = FanChart(qs=fan.qs, bands=fan.bands * adj, mean=fan.mean * adj)
+    resid = residual_pnl_stats(result.var_residuals[:, -1])
     return HedgeReport(
-        v0=float(jnp.mean(result.v0)) * adjustment_factor,
+        v0=float(jnp.mean(result.v0)) * adj,
         phi0=holdings["phi0"],
         psi0=holdings["psi0"],
         discounted_payoff=disc,
-        var_by_date=var_by_date(result.var_residuals, var_qs, method=quantile_method),
-        var_overall=var_overall(result.var_residuals, var_qs, method=quantile_method),
+        var_by_date=var_by_date(result.var_residuals, var_qs, method=quantile_method) * adj,
+        var_overall=var_overall(result.var_residuals, var_qs, method=quantile_method) * adj,
         var_qs=tuple(var_qs),
-        residual_stats=residual_pnl_stats(result.var_residuals[:, -1]),
-        fan=fan_chart(result.values, fan_qs, method=quantile_method),
+        residual_stats={k: v * adj for k, v in resid.items()},
+        fan=fan,
         holdings=holdings,
         train_loss=result.train_loss,
         train_mae=result.train_mae,

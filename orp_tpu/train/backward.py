@@ -65,8 +65,14 @@ class BackwardConfig:
     quantile_loss: str = "pinball"  # or "smoothed_pinball"
     dual_mode: str = "separate"  # "separate" | "shared" | "mse_only"
     holdings_combine: str = "single"  # "single" | "py"
-    lr: float | None = None  # None -> reference step schedule
+    lr: float | None = None  # None -> reference policy (schedule / warm_lr)
+    warm_lr: float = 5e-4  # warm steps train at the settled LR: the reference
+    # passes the LR scheduler only on the FIRST date's fit (RP.py:205-209,
+    # `callabacks=[callback]` on warm steps), so later fits keep Adam at the
+    # schedule's final 5e-4 — re-running the 1e-2 schedule each warm step
+    # (the naive reading) floors per-step MSE ~20x higher
     seed: int = 1234
+    checkpoint_dir: str | None = None  # persist state per date; resume if present
 
 
 @dataclasses.dataclass
@@ -125,13 +131,42 @@ def backward_induction(
 
     b_prices = jnp.asarray(b_prices, dtype)
 
+    # resume from the last completed date if a checkpoint exists (SURVEY.md §5:
+    # the reference can only rerun by hand; here a preempted TPU job continues)
+    start_step = 0
+    if cfg.checkpoint_dir is not None:
+        from orp_tpu.utils import checkpoint as ckpt
+
+        # refuse to resume a directory written by a different run: shapes or
+        # training policy mismatches would otherwise return stale/garbled results
+        ckpt.check_fingerprint(
+            cfg.checkpoint_dir,
+            f"{cfg} n_paths={n_paths} n_dates={n_dates} model={model}",
+        )
+        last = ckpt.latest_step(cfg.checkpoint_dir)
+        if last is not None:
+            st = ckpt.load_checkpoint(cfg.checkpoint_dir, last)
+            params1, params2 = st["params1"], st["params2"]
+            if cfg.dual_mode == "shared":
+                params2 = params1
+            values = jnp.asarray(st["values"], dtype)
+            phi_cols = [jnp.asarray(c) for c in st["phi_cols"]]
+            psi_cols = [jnp.asarray(c) for c in st["psi_cols"]]
+            var_cols = [jnp.asarray(c) for c in st["var_cols"]]
+            tl, tmae = list(st["train_loss"]), list(st["train_mae"])
+            tmape, eps_ran = list(st["train_mape"]), list(st["epochs_ran"])
+            start_step = last + 1
+
     for step_i, t in enumerate(range(n_dates - 1, -1, -1)):
+        kfit, ka, kb = jax.random.split(kfit, 3)
+        if step_i < start_step:
+            continue  # key stream still advances: resumed == uninterrupted run
         first = step_i == 0
         fit_cfg = FitConfig(
             n_epochs=cfg.epochs_first if first else cfg.epochs_warm,
             batch_size=cfg.batch_size,
             patience=cfg.patience_first if first else cfg.patience_warm,
-            lr=cfg.lr,
+            lr=cfg.lr if (first or cfg.lr is not None) else cfg.warm_lr,
         )
         feats_t = features[:, t]
         prices_t = jnp.stack(
@@ -142,7 +177,6 @@ def backward_induction(
         )
         target = values[:, t + 1]
 
-        kfit, ka, kb = jax.random.split(kfit, 3)
         params1, aux1 = fit(
             params1, feats_t, prices_t1, target, ka,
             value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
@@ -184,6 +218,26 @@ def backward_induction(
         tmae.append(float(aux1["mae"]))
         tmape.append(float(aux1["mape"]))
         eps_ran.append(int(aux1["n_epochs_ran"]))
+
+        if cfg.checkpoint_dir is not None:
+            from orp_tpu.utils import checkpoint as ckpt
+
+            ckpt.save_checkpoint(
+                cfg.checkpoint_dir,
+                step_i,
+                {
+                    "params1": params1,
+                    "params2": params2,
+                    "values": values,
+                    "phi_cols": phi_cols,
+                    "psi_cols": psi_cols,
+                    "var_cols": var_cols,
+                    "train_loss": tl,
+                    "train_mae": tmae,
+                    "train_mape": tmape,
+                    "epochs_ran": eps_ran,
+                },
+            )
 
     # ledgers were appended walking t downward; store date-ascending
     stack_asc = lambda cols: jnp.stack(cols[::-1], axis=1)

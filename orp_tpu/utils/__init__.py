@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: checkpointing, profiling/timing."""
+
+from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
+from orp_tpu.utils.profiling import timed, trace
+
+__all__ = ["latest_step", "load_checkpoint", "save_checkpoint", "timed", "trace"]

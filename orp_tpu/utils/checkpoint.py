@@ -1,0 +1,68 @@
+"""Step-indexed pytree checkpointing for preemptible backward-induction runs.
+
+The reference has no disk checkpointing (SURVEY.md §5): its only recovery
+mechanisms are Keras best-weight restoration inside one ``fit`` and the warm
+start across dates. This module adds the missing piece for long TPU jobs —
+persist ``(params, values, ledgers, date index)`` after each backward step so a
+preempted run resumes at the next date instead of re-simulating/retraining.
+
+Built on ``orbax.checkpoint.CheckpointManager`` (the supported step-management
+API: atomic finalisation, latest-step discovery, retention). A *fingerprint*
+side-file guards resume compatibility: a directory written by a different run
+configuration refuses to resume instead of silently returning stale results.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import jax
+import orbax.checkpoint as ocp
+
+_FPRINT = "run_fingerprint.txt"
+
+
+def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(pathlib.Path(directory).absolute())
+
+
+def check_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:
+    """Write the run fingerprint on first use; refuse a mismatched directory."""
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / _FPRINT
+    if f.exists():
+        saved = f.read_text()
+        if saved != fingerprint:
+            raise ValueError(
+                f"checkpoint dir {d} belongs to a different run config:\n"
+                f"  saved:   {saved}\n  current: {fingerprint}\n"
+                "use a fresh --checkpoint-dir (or delete the old one)"
+            )
+    else:
+        f.write_text(fingerprint)
+
+
+def save_checkpoint(directory: str | pathlib.Path, step: int, state) -> None:
+    """Persist ``state`` (any pytree of arrays/scalars) under ``step``."""
+    with _manager(directory) as mgr:
+        mgr.save(
+            step,
+            args=ocp.args.PyTreeSave(jax.tree.map(jax.numpy.asarray, state)),
+            force=True,
+        )
+        mgr.wait_until_finished()
+
+
+def latest_step(directory: str | pathlib.Path) -> int | None:
+    """Highest saved step in ``directory``, or None if nothing is saved."""
+    if not pathlib.Path(directory).is_dir():
+        return None
+    with _manager(directory) as mgr:
+        return mgr.latest_step()
+
+
+def load_checkpoint(directory: str | pathlib.Path, step: int):
+    """Restore the pytree saved at ``step``."""
+    with _manager(directory) as mgr:
+        return mgr.restore(step)

@@ -1,0 +1,32 @@
+"""Tracing / timing helpers (SURVEY.md §5 "tracing/profiling").
+
+The reference times hot loops with ``perf_counter`` prints
+(``Single Time Step.ipynb#7`` etc.). Here:
+
+- ``trace(name)`` — ``jax.profiler.TraceAnnotation`` context manager, so
+  framework phases (simulate / fit / analytics) show up as named spans in a
+  TensorBoard/XProf capture;
+- ``timed(fn, *args)`` — jit-aware wall timing: blocks on the result tree, so
+  the figure is real device time, not dispatch time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(name: str):
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def timed(fn, *args, **kwargs):
+    """Run ``fn`` and return ``(result, seconds)``, blocking until device-done."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0

@@ -22,8 +22,10 @@ from orp_tpu.api import (
 )
 from tests.test_train import bs_call
 
+# constant 1e-3 LR: the reference's warm-step policy (settled 5e-4, see
+# BackwardConfig.warm_lr) under-trains these deliberately tiny grids
 FAST_TRAIN = TrainConfig(
-    epochs_first=200, epochs_warm=80, batch_size=2048, dual_mode="mse_only"
+    epochs_first=300, epochs_warm=100, batch_size=512, dual_mode="mse_only", lr=1e-3
 )
 
 
@@ -66,8 +68,8 @@ def test_european_put_pipeline_runs():
     bs_c, _ = bs_call(100.0, 100.0, 0.08, 0.15, 1.0)
     bs_p = bs_c - 100.0 + 100.0 * np.exp(-0.08)  # put-call parity
     assert abs(res.v0 - bs_p) < 1.0, (res.v0, bs_p)
-    # hedge ratio: phi (x S0 report scale) should be near the negative BS put delta
-    assert -45.0 < res.phi0 < -5.0, res.phi0
+    # phi is the stock-value fraction: near the negative BS put delta
+    assert -0.45 < res.phi0 < -0.05, res.phi0
 
 
 PENSION_FAST = HedgeRunConfig(

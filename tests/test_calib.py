@@ -1,0 +1,62 @@
+"""Calibration oracles: CIR OLS recovers known parameters from synthetic CIR
+data; Feller validation; rolling-vol and drift helpers (reference:
+``Extra: Stochastic Volatility.ipynb#3-8``)."""
+
+import numpy as np
+import pytest
+
+from orp_tpu.calib import (
+    CIRParams,
+    annualized_drift,
+    estimate_cir_params,
+    log_returns,
+    rolling_volatility,
+)
+
+
+def test_cirparams_feller_validation():
+    CIRParams(a=0.00336, b=0.15431, c=0.01583)  # Extra#8(out): valid
+    with pytest.raises(ValueError):
+        CIRParams(a=0.001, b=0.01, c=0.5)
+
+
+def test_estimate_recovers_synthetic_cir():
+    # simulate the exact discretisation the regression assumes:
+    # ds = a(b - s) + c sqrt(s) eps  (per-step, the notebook's unit-dt form)
+    rng = np.random.default_rng(0)
+    a, b, c = 0.004, 0.16, 0.008
+    n = 200_000
+    s = np.empty(n)
+    s[0] = b
+    eps = rng.normal(size=n)
+    for t in range(1, n):
+        s[t] = s[t - 1] + a * (b - s[t - 1]) + c * np.sqrt(s[t - 1]) * eps[t]
+    est = estimate_cir_params(s)
+    np.testing.assert_allclose(est.a, a, rtol=0.15)
+    np.testing.assert_allclose(est.b, b, rtol=0.05)
+    np.testing.assert_allclose(est.c, c, rtol=0.05)
+
+
+def test_rolling_volatility_matches_pandas_semantics():
+    rng = np.random.default_rng(1)
+    r = rng.normal(0, 0.01, size=300)
+    out = np.asarray(rolling_volatility(r, window=40))
+    assert out.shape == (261,)
+    # windowed sample std x sqrt(252), checked at two positions
+    for i in [0, 200]:
+        expect = np.std(r[i : i + 40], ddof=1) * np.sqrt(252)
+        np.testing.assert_allclose(out[i], expect, rtol=1e-10)
+
+
+def test_log_returns_and_drift():
+    p = np.array([100.0, 110.0, 99.0])
+    lr = np.asarray(log_returns(p))
+    np.testing.assert_allclose(lr, [np.log(1.1), np.log(0.9)])
+    np.testing.assert_allclose(annualized_drift([100.0, 200.0], 10.0), np.log(2.0) / 10)
+
+
+def test_estimate_requires_enough_data():
+    with pytest.raises(ValueError):
+        estimate_cir_params([0.1, 0.2])
+    with pytest.raises(ValueError):
+        rolling_volatility(np.ones(10), window=40)

@@ -1,0 +1,101 @@
+"""Checkpoint/resume oracles: a run interrupted mid-walk and resumed must
+reproduce the uninterrupted run exactly (SURVEY.md §5 checkpoint/resume)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.models import HedgeMLP
+from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
+from orp_tpu.train import BackwardConfig, backward_induction
+from orp_tpu.utils import latest_step, load_checkpoint, save_checkpoint
+
+
+def test_save_load_roundtrip(tmp_path):
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "n": jnp.asarray(3), "ls": [jnp.ones(2)]}
+    save_checkpoint(tmp_path, 0, state)
+    save_checkpoint(tmp_path, 4, state)
+    assert latest_step(tmp_path) == 4
+    back = load_checkpoint(tmp_path, 4)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.arange(6.0).reshape(2, 3))
+    assert int(back["n"]) == 3
+
+
+def test_latest_step_empty(tmp_path):
+    assert latest_step(tmp_path) is None
+    assert latest_step(tmp_path / "missing") is None
+
+
+def _setup(n_paths=512, n_steps=3):
+    grid = TimeGrid(1.0, n_steps)
+    idx = jnp.arange(n_paths, dtype=jnp.uint32)
+    s = simulate_gbm_log(idx, grid, 100.0, 0.08, 0.2, seed=1)
+    b = bond_curve(grid, 0.08)
+    payoff = payoffs.call(s[:, -1], 100.0)
+    model = HedgeMLP(n_features=1, constrain_self_financing=True)
+    return model, (s / 100)[:, :, None], s / 100, b / 100, payoff / 100
+
+
+def test_resume_reproduces_uninterrupted_run(tmp_path):
+    model, feats, y, b, term = _setup()
+    base = dict(epochs_first=40, epochs_warm=20, dual_mode="mse_only", batch_size=512)
+
+    full = backward_induction(model, feats, y, b, term, BackwardConfig(**base))
+
+    ckdir = str(tmp_path / "walk")
+    # phase 1: run and checkpoint all 3 dates; then wipe nothing and resume — the
+    # resumed run must skip all dates and return identical ledgers
+    first = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    resumed = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    for a, c in [(full, first), (first, resumed)]:
+        np.testing.assert_allclose(
+            np.asarray(a.values), np.asarray(c.values), rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(a.phi), np.asarray(c.phi), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_resume_refuses_mismatched_config(tmp_path):
+    import pytest
+
+    model, feats, y, b, term = _setup()
+    ckdir = str(tmp_path / "guard")
+    base = dict(epochs_first=20, epochs_warm=10, dual_mode="mse_only", batch_size=512)
+    backward_induction(model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base))
+    # a different training policy must not silently reuse the old ledgers
+    with pytest.raises(ValueError, match="different run config"):
+        backward_induction(
+            model, feats, y, b, term,
+            BackwardConfig(checkpoint_dir=ckdir, cost_of_capital=0.5, **base),
+        )
+
+
+def test_resume_from_partial_checkpoint(tmp_path):
+    model, feats, y, b, term = _setup()
+    base = dict(epochs_first=40, epochs_warm=20, dual_mode="mse_only", batch_size=512)
+    ckdir = str(tmp_path / "partial")
+
+    full = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    # drop the last date's checkpoint -> resume recomputes only that date
+    # (orbax CheckpointManager lays steps out as <dir>/<step-number>)
+    import shutil
+
+    shutil.rmtree(f"{ckdir}/2")
+    assert latest_step(ckdir) == 1
+    resumed = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    np.testing.assert_allclose(
+        np.asarray(full.values), np.asarray(resumed.values), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(full.var_residuals), np.asarray(resumed.var_residuals),
+        rtol=1e-6, atol=1e-7,
+    )

@@ -1,0 +1,45 @@
+"""CLI smoke tests (tiny configs, JSON output contract)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from orp_tpu import cli
+
+
+def test_euro_json(capsys):
+    cli.main([
+        "euro", "--paths", "512", "--steps", "4", "--rebalance-every", "2",
+        "--epochs-first", "30", "--epochs-warm", "15", "--batch-size", "512",
+        "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) >= {"v0", "phi0", "psi0", "var_overall"}
+    assert np.isfinite(out["v0"])
+
+
+def test_pension_single_step(capsys):
+    cli.main([
+        "pension", "--paths", "256", "--steps", "12", "--T", "2.0",
+        "--single-step", "--epochs-first", "20", "--epochs-warm", "10",
+        "--batch-size", "256", "--dual-mode", "mse_only", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["v0"] > 0
+
+
+def test_calibrate_csv(tmp_path, capsys):
+    rng = np.random.default_rng(0)
+    prices = 100 * np.exp(np.cumsum(rng.normal(0.0003, 0.01, size=400)))
+    f = tmp_path / "prices.csv"
+    np.savetxt(f, prices, delimiter=",")
+    cli.main(["calibrate", str(f), "--years", "1.6", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) == {"a", "b", "c", "mu", "sigma0"}
+    assert out["sigma0"] > 0
+
+
+def test_unknown_command_errors():
+    with pytest.raises(SystemExit):
+        cli.main(["nope"])

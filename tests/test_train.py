@@ -128,7 +128,7 @@ def test_backward_induction_prices_european_call():
     S0, K, r, sigma, T, S, B, payoff = _euro_setup()
     model = HedgeMLP(n_features=1, constrain_self_financing=True)
     cfg = BackwardConfig(
-        epochs_first=250, epochs_warm=120, dual_mode="mse_only", batch_size=1024,
+        epochs_first=300, epochs_warm=100, dual_mode="mse_only", batch_size=512, lr=1e-3,
     )
     res = backward_induction(
         model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0, cfg,
