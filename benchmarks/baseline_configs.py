@@ -1,0 +1,160 @@
+"""The five BASELINE.json benchmark configs as runnable entries.
+
+Each returns a dict of headline numbers; ``python benchmarks/baseline_configs.py
+[n]`` runs config n (default: all) and prints one JSON line per config.
+
+1. Single-time-step European call, GBM, 10k Sobol paths  (Single Time Step shape)
+2. Multi-time-step European call, 52 rebalance steps, 100k paths
+3. European put + call, 1M paths, put-call parity of learned t=0 price
+4. Heston stochastic-vol paths, 52-step hedge
+5. 5-asset correlated-GBM basket call, 1M paths (path-sharded over the mesh)
+"""
+
+import json
+import pathlib
+import sys
+from math import exp
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.utils import bs_call as _bs_call
+
+
+def bs_call(s0, k, r, sigma, T):
+    return _bs_call(s0, k, r, sigma, T)[0]
+
+
+FAST = dict(dual_mode="mse_only", epochs_first=150, epochs_warm=40, lr=1e-3)
+
+
+def config_1_single_step():
+    """European call, ONE rebalance over 1y, 10k-ish Sobol paths."""
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    res = european_hedge(
+        EuropeanConfig(constrain_self_financing=False),
+        SimConfig(n_paths=1 << 13, T=1.0, dt=1 / 364, rebalance_every=364),
+        TrainConfig(batch_size=1 << 11, **FAST),
+    )
+    bs = bs_call(100, 100, 0.08, 0.15, 1.0)
+    return {
+        "config": "single_step_call_8k",
+        "v0_cv": round(res.report.v0_cv, 4),
+        "bp_err": round((res.report.v0_cv - bs) / bs * 1e4, 2),
+    }
+
+
+def config_2_multi_step_100k():
+    """52-step weekly hedge at 100k paths."""
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    res = european_hedge(
+        EuropeanConfig(constrain_self_financing=False),
+        SimConfig(n_paths=1 << 17, T=1.0, dt=1 / 364, rebalance_every=7),
+        TrainConfig(batch_size=1 << 14, **FAST),
+    )
+    bs = bs_call(100, 100, 0.08, 0.15, 1.0)
+    return {
+        "config": "multi_step_call_131k",
+        "v0_cv": round(res.report.v0_cv, 4),
+        "bp_err": round((res.report.v0_cv - bs) / bs * 1e4, 2),
+        "cv_std": round(res.report.cv_std, 3),
+    }
+
+
+def config_3_put_call_parity(n_paths=1 << 20):
+    """Learned t=0 call and put at 1M paths: check C - P = S0 - K e^{-rT}."""
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 364, rebalance_every=7)
+    train = TrainConfig(batch_size=max(n_paths // 8, 512), **FAST)
+    call = european_hedge(EuropeanConfig(constrain_self_financing=False), sim, train)
+    put = european_hedge(
+        EuropeanConfig(option_type="put", constrain_self_financing=False), sim, train
+    )
+    parity_true = 100.0 - 100.0 * exp(-0.08)
+    parity_learned = call.report.v0_cv - put.report.v0_cv
+    return {
+        "config": f"put_call_parity_{n_paths // 1000}k",
+        "call_cv": round(call.report.v0_cv, 4),
+        "put_cv": round(put.report.v0_cv, 4),
+        "parity_err_bp": round((parity_learned - parity_true) / 100.0 * 1e4, 2),
+    }
+
+
+def config_4_heston():
+    """Heston SV paths + 52-step hedge on the simulated S."""
+    from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_heston_log
+    from orp_tpu.models import HedgeMLP
+    from orp_tpu.train import BackwardConfig, backward_induction
+
+    n = 1 << 16
+    grid = TimeGrid(1.0, 364)
+    traj = simulate_heston_log(
+        jnp.arange(n, dtype=jnp.uint32), grid,
+        s0=100.0, mu=0.08, v0=0.0225, kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6,
+        seed=1235, store_every=7,
+    )
+    s = traj["S"]
+    b = bond_curve(grid.reduced(7), 0.08)
+    payoff = payoffs.call(s[:, -1], 100.0)
+    model = HedgeMLP(n_features=1)
+    res = backward_induction(
+        model, (s / 100.0)[:, :, None], s / 100.0, b / 100.0, payoff / 100.0,
+        BackwardConfig(batch_size=1 << 13, **FAST),
+        bias_init=(float(payoff.mean()) / 100.0, 0.0),
+    )
+    # unbiased QMC price under the risk-neutral Heston sim
+    disc = jnp.exp(-0.08 * jnp.asarray(np.asarray(grid.reduced(7).times())))
+    d_mart = disc[1:] * s[:, 1:] - disc[:-1] * s[:, :-1]
+    cv = disc[-1] * payoff - jnp.sum(res.phi * d_mart, axis=1)
+    return {
+        "config": "heston_52step_65k",
+        "v0_cv": round(float(cv.mean()), 4),
+        "cv_std": round(float(cv.std()), 3),
+        "v0_network": round(float(res.v0.mean()) * 100.0, 4),
+    }
+
+
+def config_5_basket(n_paths=1 << 20):
+    """5-asset correlated-GBM basket call at 1M paths, path-sharded mesh."""
+    from orp_tpu.parallel import make_mesh, path_indices
+    from orp_tpu.sde import TimeGrid, payoffs, simulate_gbm_basket
+
+    mesh = make_mesh() if len(__import__("jax").devices()) > 1 else None
+    grid = TimeGrid(1.0, 52)
+    A = 5
+    corr = np.full((A, A), 0.3)
+    np.fill_diagonal(corr, 1.0)
+    s = simulate_gbm_basket(
+        path_indices(n_paths, mesh), grid,
+        s0=jnp.full(A, 100.0), drift=jnp.full(A, 0.08),
+        sigma=jnp.asarray([0.1, 0.12, 0.15, 0.18, 0.2]), corr=jnp.asarray(corr),
+        seed=1235, store_every=52,
+    )
+    w = jnp.full(A, 1.0 / A)
+    payoff = payoffs.basket_call(s[:, -1], w, 100.0)
+    price = float(payoff.mean()) * exp(-0.08)
+    return {
+        "config": f"basket5_call_{n_paths // 1000}k",
+        "price_qmc": round(price, 4),
+        "mean_basket_T": round(float((s[:, -1] @ w).mean()), 4),
+    }
+
+
+CONFIGS = [
+    config_1_single_step,
+    config_2_multi_step_100k,
+    config_3_put_call_parity,
+    config_4_heston,
+    config_5_basket,
+]
+
+
+if __name__ == "__main__":
+    picks = [int(a) for a in sys.argv[1:]] or range(1, len(CONFIGS) + 1)
+    for i in picks:
+        print(json.dumps(CONFIGS[i - 1]()))

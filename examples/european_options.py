@@ -3,7 +3,8 @@
 Reference run (Euro#3): S0=K=100, r=8%, sigma=15%, T=1y, 4096 Sobol paths,
 weekly rebalancing, MSE-only training normalised by S0. Reference outputs to
 compare (Euro#18/#20(out)): V0=11.352 vs discounted payoff 10.479;
-phi0=0.10456, psi0=0.89544 (x S0 scale); Black-Scholes ~10.39.
+phi0=0.10456, psi0=0.89544 (normalised holdings, reported as-is);
+Black-Scholes ~10.39.
 
 Run: env -u PALLAS_AXON_POOL_IPS python examples/european_options.py [--paths 4096]
 """

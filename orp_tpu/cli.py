@@ -54,6 +54,8 @@ def _emit(args, report, extra=None):
             "var_overall": report.var_overall.tolist(),
             "var_qs": list(report.var_qs),
         }
+        if report.v0_cv is not None:
+            out.update(v0_plain=report.v0_plain, v0_cv=report.v0_cv, cv_std=report.cv_std)
         if extra:
             out.update(extra)
         print(json.dumps(out))

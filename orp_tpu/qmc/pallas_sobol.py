@@ -1,0 +1,207 @@
+"""Pallas TPU kernel: fused scrambled-Sobol -> inverse-normal -> log-GBM scan.
+
+The hot op of the whole framework (SURVEY.md §3.1 hot loop A / BASELINE.json
+"Sobol-QMC GBM path generator") as ONE kernel: the time loop lives *inside* the
+kernel, path state stays in VMEM registers across all steps, and only the
+coarse rebalance-grid knots are written back to HBM. Per path-step the kernel
+does the full chain
+
+    sobol bits (32-term XOR)  ->  Owen scramble (Laine-Karras hashes)
+    -> bucket-centred uint32->(0,1)  ->  AS241 inverse normal  ->  GBM update
+
+with zero HBM traffic besides the knot stores — the XLA `lax.scan` path
+(orp_tpu/sde/kernels.py) round-trips the carry through HBM between scan
+blocks instead.
+
+Layout: paths are tiled into (8, 128) f32 blocks; each grid instance owns
+``block_paths`` rows of the (n_paths,) axis. Direction numbers enter as a
+``(n_steps, 32)`` uint32 VMEM block (467 KB at 3,650 steps — fits comfortably).
+
+Parity: bitwise-identical Sobol integers to ``orp_tpu.qmc.sobol`` (same hashes,
+same 23-bit f32 bucket mapping); the inverse normal is AS241 evaluated in f32,
+~1 ulp from ``jax.scipy.special.ndtri`` (tested in tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from orp_tpu.qmc.sobol import direction_numbers
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def _u32(x):
+    return jnp.uint32(x)
+
+
+def _laine_karras(x, seed):
+    x = x + seed
+    x = x ^ (x * _u32(0x6C50B47C))
+    x = x ^ (x * _u32(0xB82F1E52))
+    x = x ^ (x * _u32(0xC7AFE638))
+    x = x ^ (x * _u32(0x8D22F6E6))
+    return x
+
+
+def _reverse_bits32(x):
+    x = ((x & _u32(0x55555555)) << 1) | ((x >> 1) & _u32(0x55555555))
+    x = ((x & _u32(0x33333333)) << 2) | ((x >> 2) & _u32(0x33333333))
+    x = ((x & _u32(0x0F0F0F0F)) << 4) | ((x >> 4) & _u32(0x0F0F0F0F))
+    x = ((x & _u32(0x00FF00FF)) << 8) | ((x >> 8) & _u32(0x00FF00FF))
+    return (x << 16) | (x >> 16)
+
+
+def _hash_combine(a, b):
+    x = (a ^ (b + _u32(0x9E3779B9) + (a << 6) + (a >> 2))).astype(jnp.uint32)
+    x = x * _u32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * _u32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def _ndtri_f32(u):
+    """AS241 (PPND7-grade, f32) inverse normal CDF — elementwise VPU ops only."""
+    q = u - 0.5
+    r_c = 0.180625 - q * q
+    num_c = (((2.5090809287301226727e3 * r_c + 3.3430575583588128105e4) * r_c
+              + 6.7265770927008700853e4) * r_c + 4.5921953931549871457e4)
+    num_c = ((num_c * r_c + 1.3731693765509461125e4) * r_c + 1.9715909503065514427e3)
+    num_c = (num_c * r_c + 1.3314166789178437745e2) * r_c + 3.3871328727963666080e0
+    den_c = (((5.2264952788528545610e3 * r_c + 2.8729085735721942674e4) * r_c
+              + 3.9307895800092710610e4) * r_c + 2.1213794301586595867e4)
+    den_c = ((den_c * r_c + 5.3941960214247511077e3) * r_c + 6.8718700749205790830e2)
+    den_c = (den_c * r_c + 4.2313330701600911252e1) * r_c + 1.0
+    central = q * num_c / den_c
+
+    p_tail = jnp.minimum(u, 1.0 - u)
+    # clamp before log: p_tail >= 2^-24 by the bucket mapping
+    rt = jnp.sqrt(-jnp.log(jnp.maximum(p_tail, 1e-38)))
+    r1 = rt - 1.6
+    num_m = (((7.74545014278341407640e-4 * r1 + 2.27238449892691845833e-2) * r1
+              + 2.41780725177450611770e-1) * r1 + 1.27045825245236838258e0)
+    num_m = ((num_m * r1 + 3.64784832476320460504e0) * r1 + 5.76949722146069140550e0)
+    num_m = (num_m * r1 + 4.63033784615654529590e0) * r1 + 1.42343711074968357734e0
+    den_m = (((1.05075007164441684324e-9 * r1 + 5.47593808499534494600e-4) * r1
+              + 1.51986665636164571966e-2) * r1 + 1.48103976427480074590e-1)
+    den_m = ((den_m * r1 + 6.89767334985100004550e-1) * r1 + 1.67638483018380384940e0)
+    den_m = (den_m * r1 + 2.05319162663775882187e0) * r1 + 1.0
+    r2 = rt - 5.0
+    num_f = (((2.01033439929228813265e-7 * r2 + 2.71155556874348757815e-5) * r2
+              + 1.24266094738807843860e-3) * r2 + 2.65321895265761230930e-2)
+    num_f = ((num_f * r2 + 2.96560571828504891230e-1) * r2 + 1.78482653991729133580e0)
+    num_f = (num_f * r2 + 5.46378491116411436990e0) * r2 + 6.65790464350110377720e0
+    den_f = (((2.04426310338993978564e-15 * r2 + 1.42151175831644588870e-7) * r2
+              + 1.84631831751005468180e-5) * r2 + 7.86869131145613259100e-4)
+    den_f = ((den_f * r2 + 1.48753612908506148525e-2) * r2 + 1.36929880922735805310e-1)
+    den_f = (den_f * r2 + 5.99832206555887937690e-1) * r2 + 1.0
+    tail = jnp.where(rt <= 5.0, num_m / den_m, num_f / den_f)
+    tail = jnp.where(q < 0.0, -tail, tail)
+    return jnp.where(jnp.abs(q) <= 0.425, central, tail)
+
+
+def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
+                seed, c0, vol_sdt, log_s0):
+    """One grid instance: evolve ``block_paths`` paths through all steps."""
+    pid = pl.program_id(0)
+    rows = block_paths // _LANES
+    base = pid.astype(jnp.uint32) * _u32(block_paths)
+    # global path indices for this block, shaped (rows, 128) uint32; keep every
+    # operand uint32 — promotion to signed/wider ints breaks the bit kernels
+    idx = (base
+           + _u32(_LANES) * jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 0)
+           + jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1))
+
+    out_ref[0, :, :] = jnp.full((rows, _LANES), log_s0, jnp.float32)
+
+    def step(t, logs):
+        # direction row for dimension t-1: dynamic sublane load, (1, 32) uint32
+        drow = dirs_ref[pl.dslice(t - 1, 1), :]
+        # Sobol integer: XOR of direction entries where the index bit is set;
+        # the 32-term reduction is unrolled statically (Mosaic has no dynamic
+        # array indexing, and unrolling keeps drow accesses static)
+        x = jnp.zeros((rows, _LANES), jnp.uint32)
+        for k in range(32):
+            bit = ((idx >> _u32(k)) & _u32(1)).astype(jnp.bool_)
+            x = x ^ jnp.where(bit, drow[0, k], _u32(0))
+        dim_seed = _hash_combine(_u32(seed), (t - 1).astype(jnp.uint32))
+        x = _reverse_bits32(_laine_karras(_reverse_bits32(x), dim_seed))
+        # 23-bit bucket-centred mapping (f32); cast via int32 — the value is
+        # < 2^23 so the signed cast is exact (Mosaic lacks uint32->f32)
+        u = ((x >> _u32(9)).astype(jnp.int32).astype(jnp.float32) + 0.5) * jnp.float32(2.0**-23)
+        z = _ndtri_f32(u)
+        logs = logs + c0 + vol_sdt * z
+
+        @pl.when(t % store_every == 0)
+        def _():
+            out_ref[pl.dslice(t // store_every, 1), :, :] = logs[None]
+
+        return logs
+
+    jax.lax.fori_loop(1, n_steps + 1, step, out_ref[0, :, :], unroll=False)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_paths", "n_steps", "store_every", "seed", "block_paths", "interpret",
+        "s0", "drift", "sigma", "dt",
+    ),
+)
+def gbm_log_pallas(
+    n_paths: int,
+    n_steps: int,
+    *,
+    s0: float,
+    drift: float,
+    sigma: float,
+    dt: float,
+    seed: int = 1234,
+    store_every: int = 1,
+    block_paths: int = 2048,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused Pallas log-GBM: returns ``(n_paths, n_steps//store_every + 1)``.
+
+    Semantics identical to ``simulate_gbm_log`` with ``scramble="owen"`` and the
+    same ``(indices, dims, seed)`` addressing — the Sobol stream matches the
+    XLA path bit-for-bit; end values agree to f32 roundoff (see
+    tests/test_pallas.py).
+    """
+    if n_paths % block_paths or block_paths % _LANES:
+        raise ValueError(f"n_paths {n_paths} must tile into {block_paths}-path blocks")
+    if n_steps % store_every:
+        raise ValueError("store_every must divide n_steps")
+    n_knots = n_steps // store_every + 1
+    rows = block_paths // _LANES
+    dirs = direction_numbers(n_steps)  # (n_steps, 32) uint32
+
+    kernel = functools.partial(
+        _gbm_kernel,
+        n_steps=n_steps,
+        store_every=store_every,
+        block_paths=block_paths,
+        seed=seed,
+        c0=float((drift - 0.5 * sigma * sigma) * dt),
+        vol_sdt=float(sigma * dt**0.5),
+        log_s0=math.log(s0),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_paths // block_paths,),
+        in_specs=[pl.BlockSpec((n_steps, 32), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((n_knots, rows, _LANES), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_knots, n_paths // _LANES, _LANES), jnp.float32
+        ),
+        interpret=interpret,
+    )(dirs)
+    # (knots, path_rows, 128) -> (paths, knots)
+    return jnp.exp(out).reshape(n_knots, n_paths).T

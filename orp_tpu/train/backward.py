@@ -138,10 +138,13 @@ def backward_induction(
         from orp_tpu.utils import checkpoint as ckpt
 
         # refuse to resume a directory written by a different run: shapes or
-        # training policy mismatches would otherwise return stale/garbled results
+        # training policy mismatches would otherwise return stale/garbled
+        # results. checkpoint_dir itself is excluded — the same directory
+        # spelled differently ('ckpts' vs './ckpts') must still resume.
+        fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None)
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
-            f"{cfg} n_paths={n_paths} n_dates={n_dates} model={model}",
+            f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model}",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:

@@ -1,6 +1,15 @@
 """Cross-cutting utilities: checkpointing, profiling/timing."""
 
+from orp_tpu.utils.black_scholes import bs_call, bs_put
 from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
 from orp_tpu.utils.profiling import timed, trace
 
-__all__ = ["latest_step", "load_checkpoint", "save_checkpoint", "timed", "trace"]
+__all__ = [
+    "bs_call",
+    "bs_put",
+    "latest_step",
+    "load_checkpoint",
+    "save_checkpoint",
+    "timed",
+    "trace",
+]

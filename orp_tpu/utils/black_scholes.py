@@ -1,0 +1,28 @@
+"""Closed-form Black-Scholes oracle (shared by tests and benchmarks).
+
+The reference has no analytic pricer — its notebooks eyeball the learned V0
+against the discounted mean payoff (``Euro#20``). SURVEY.md §6 computes
+BS ~ 10.39 for the Euro config as the independent oracle; this module is that
+oracle, defined once.
+"""
+
+from __future__ import annotations
+
+from math import erf, exp, log, sqrt
+
+
+def _N(x: float) -> float:
+    return 0.5 * (1.0 + erf(x / sqrt(2.0)))
+
+
+def bs_call(s0: float, k: float, r: float, sigma: float, T: float) -> tuple[float, float]:
+    """European call (price, delta)."""
+    d1 = (log(s0 / k) + (r + sigma * sigma / 2.0) * T) / (sigma * sqrt(T))
+    d2 = d1 - sigma * sqrt(T)
+    return s0 * _N(d1) - k * exp(-r * T) * _N(d2), _N(d1)
+
+
+def bs_put(s0: float, k: float, r: float, sigma: float, T: float) -> tuple[float, float]:
+    """European put (price, delta) via parity."""
+    call, delta_c = bs_call(s0, k, r, sigma, T)
+    return call - s0 + k * exp(-r * T), delta_c - 1.0

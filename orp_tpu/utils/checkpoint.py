@@ -23,7 +23,13 @@ _FPRINT = "run_fingerprint.txt"
 
 
 def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
-    return ocp.CheckpointManager(pathlib.Path(directory).absolute())
+    # resume only ever reads latest_step, so retain just the newest two steps
+    # (two, not one: the previous step survives until the new save finalises) —
+    # unbounded retention is O(n_dates * state) disk on long walks
+    return ocp.CheckpointManager(
+        pathlib.Path(directory).absolute(),
+        options=ocp.CheckpointManagerOptions(max_to_keep=2),
+    )
 
 
 def check_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:

@@ -57,6 +57,9 @@ def test_european_hedge_prices_near_black_scholes():
     assert 0.0 < res.phi0 < 100.0
     assert res.report.var_by_date.shape[0] == 8
     assert np.isfinite(res.report.train_loss).all()
+    # the unbiased QMC/CV estimators must be far tighter than the network v0
+    assert abs(res.report.v0_plain - bs) / bs < 0.01, res.report.v0_plain
+    assert abs(res.report.v0_cv - bs) / bs < 0.01, res.report.v0_cv
 
 
 def test_european_put_pipeline_runs():

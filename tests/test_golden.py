@@ -1,0 +1,75 @@
+"""Golden-value regression pins against the reference's recorded outputs
+(SURVEY.md §4 item 3 / §6 table). Parity is distributional — same point-set
+law, different RNG streams — so every pin carries the tolerance its MC noise
+allows. Configs match the reference's exactly where feasible on CPU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.sde import TimeGrid, payoffs, simulate_gbm_arithmetic, simulate_gbm_log, simulate_pension
+
+
+def test_golden_gbm_drift_multi():
+    # Multi#7(out): 4096 paths x 3650 fine steps, mean(Y_T)=2.227189 vs e^{0.8}=2.225541
+    grid = TimeGrid(10.0, 3650)
+    y = simulate_gbm_arithmetic(
+        jnp.arange(4096, dtype=jnp.uint32), grid, 1.0, 0.08, 0.15,
+        seed=1235, store_every=3650,
+    )
+    drift_err = float(y[:, -1].mean()) - float(np.exp(0.8))
+    assert abs(drift_err) < 0.02, drift_err  # reference landed +0.0016
+
+
+def test_golden_risk_neutral_drift_euro():
+    # Euro#6(out): mean S(T)=108.327487 vs S0 e^{rT}=108.328707 (|err| ~ 0.0012)
+    grid = TimeGrid(1.0, 364)
+    s = simulate_gbm_log(
+        jnp.arange(4096, dtype=jnp.uint32), grid, 100.0, 0.08, 0.15,
+        seed=1235, store_every=364,
+    )
+    err = float(s[:, -1].mean()) - 100.0 * float(np.exp(0.08))
+    assert abs(err) < 0.1, err
+
+
+def test_golden_population_distribution():
+    # Single#9(out)/Multi#11(out): N(T) mean 8615-8617, std ~132 of 10,000
+    traj = simulate_pension(
+        jnp.arange(8192, dtype=jnp.uint32), TimeGrid(10.0, 120),
+        y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+        n0=1e4, seed=1234, store_every=120,
+    )
+    n_T = traj["N"][:, -1]
+    assert abs(float(n_T.mean()) - 8616) < 40
+    assert abs(float(n_T.std()) - 132) < 30
+
+
+def test_golden_liability_level():
+    # Single#13(out): E[S_T] = 1,923,068 EUR at 8192 paths, monthly grid
+    traj = simulate_pension(
+        jnp.arange(8192, dtype=jnp.uint32), TimeGrid(10.0, 120),
+        y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+        n0=1e4, seed=1234, store_every=120,
+    )
+    s_T = payoffs.pension_liability(traj["Y"][:, -1], traj["N"][:, -1], 100.0, 1.0)
+    assert abs(float(s_T.mean()) - 1.923e6) / 1.923e6 < 0.03
+
+
+def test_golden_euro_flagship_hedge():
+    # Euro#18/#20(out): V0=11.352 (learned) vs discounted 10.479; phi0=0.10456,
+    # psi0=0.89544 — the reference's headline numbers at its exact config
+    # (4096 Sobol paths, 52 weekly steps, MSE-only, inputs /S0)
+    res = european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=4096, T=1.0, dt=1 / 364, rebalance_every=7),
+        TrainConfig(dual_mode="mse_only"),
+    )
+    assert abs(res.v0 - 11.352) / 11.352 < 0.04, res.v0
+    assert abs(res.phi0 - 0.10456) < 0.02, res.phi0
+    assert abs(res.psi0 - 0.89544) < 0.02, res.psi0
+    assert abs(res.report.discounted_payoff - 10.479) / 10.479 < 0.02
+    # Euro#16(out): overall VaR 99%: 4.05 EUR, 99.5%: 4.59 EUR (x S0 units)
+    v99, v995 = res.report.var_overall[1], res.report.var_overall[2]
+    assert 1.5 < v99 < 8.0, v99
+    assert v995 > v99

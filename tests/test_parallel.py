@@ -82,6 +82,24 @@ def test_histogram_quantile_sharded_input():
     )
 
 
+def test_european_pipeline_on_mesh_matches_single_device():
+    # full pipeline with a path-sharded mesh: same Sobol indices -> same paths
+    # -> numerically equivalent hedge (reduction order may differ slightly)
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    euro = EuropeanConfig()
+    sim = SimConfig(n_paths=2048, T=1.0, dt=0.25, rebalance_every=1)
+    train = TrainConfig(epochs_first=60, epochs_warm=30, batch_size=2048,
+                        dual_mode="mse_only", lr=1e-3)
+    res_1 = european_hedge(euro, sim, train)
+    res_8 = european_hedge(euro, sim, train, mesh=make_mesh())
+    np.testing.assert_allclose(res_8.v0, res_1.v0, rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(res_8.backward.values), np.asarray(res_1.backward.values),
+        rtol=2e-3, atol=2e-4,
+    )
+
+
 def test_quantile_dispatch():
     x = jnp.linspace(0.0, 1.0, 1001)
     np.testing.assert_allclose(float(quantile(x, 0.5, method="sort")[0]), 0.5, atol=1e-6)

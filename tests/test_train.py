@@ -2,7 +2,6 @@
 backward induction vs Black–Scholes (SURVEY.md §4 items 2-4)."""
 
 import numpy as np
-from math import erf, exp, log, sqrt
 
 import jax
 import jax.numpy as jnp
@@ -19,11 +18,7 @@ from orp_tpu.train import (
 )
 
 
-def bs_call(s0, k, r, sigma, T):
-    N = lambda x: 0.5 * (1 + erf(x / sqrt(2)))
-    d1 = (log(s0 / k) + (r + sigma**2 / 2) * T) / (sigma * sqrt(T))
-    d2 = d1 - sigma * sqrt(T)
-    return s0 * N(d1) - k * exp(-r * T) * N(d2), N(d1)
+from orp_tpu.utils import bs_call  # single shared oracle (re-exported for test_api)
 
 
 def test_model_param_counts_match_reference():
