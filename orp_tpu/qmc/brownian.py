@@ -1,0 +1,41 @@
+"""Plain Brownian-increment helpers (parity with ``brownian_motion.py:6-24``).
+
+The reference ships ``get_dW``/``get_W`` as an unused utility module (SURVEY.md
+§2 row 1 — dead code, imported nowhere, pseudo-random rather than Sobol). The
+equivalents here are stateless ``jax.random`` versions, plus Sobol-driven
+variants so the helpers share the frameworks' QMC stream when wanted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from orp_tpu.qmc.sobol import sobol_normal
+
+
+def get_dW(key: jax.Array, n: int, dtype=jnp.float32) -> jax.Array:
+    """``n`` i.i.d. N(0,1) increments (reference ``get_dW``, brownian_motion.py:6-13)."""
+    return jax.random.normal(key, (n,), dtype)
+
+
+def get_W(key: jax.Array, n: int, dtype=jnp.float32) -> jax.Array:
+    """Brownian path with ``W[0] = 0`` via cumulative sum (brownian_motion.py:16-24)."""
+    dW = get_dW(key, n, dtype)
+    return jnp.concatenate([jnp.zeros((1,), dtype), jnp.cumsum(dW[:-1])])
+
+
+def get_dW_sobol(
+    indices: jax.Array, n_steps: int, seed: int = 1234, dtype=jnp.float32
+) -> jax.Array:
+    """QMC variant: ``(n_paths, n_steps)`` Sobol N(0,1) increments."""
+    return sobol_normal(indices, jnp.arange(n_steps), seed, dtype=dtype)
+
+
+def get_W_sobol(
+    indices: jax.Array, n_steps: int, seed: int = 1234, dtype=jnp.float32
+) -> jax.Array:
+    """QMC Brownian paths ``(n_paths, n_steps)`` with ``W[:, 0] = 0``."""
+    dW = get_dW_sobol(indices, n_steps, seed, dtype)
+    w = jnp.cumsum(dW[:, :-1], axis=1)
+    return jnp.concatenate([jnp.zeros((indices.shape[0], 1), dtype), w], axis=1)

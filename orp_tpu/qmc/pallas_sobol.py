@@ -32,39 +32,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from orp_tpu.qmc.sobol import direction_numbers
+# the SAME hash chain as the XLA path — imported, not copied, so the bitwise
+# Sobol-stream parity between device kernels can't drift (all are elementwise
+# jnp ops, equally lowerable by Mosaic)
+from orp_tpu.qmc.sobol import (
+    _hash_combine,
+    _laine_karras_permutation as _laine_karras,
+    _reverse_bits32,
+    direction_numbers,
+)
 
 _LANES = 128
-_SUBLANES = 8
 
 
 def _u32(x):
     return jnp.uint32(x)
-
-
-def _laine_karras(x, seed):
-    x = x + seed
-    x = x ^ (x * _u32(0x6C50B47C))
-    x = x ^ (x * _u32(0xB82F1E52))
-    x = x ^ (x * _u32(0xC7AFE638))
-    x = x ^ (x * _u32(0x8D22F6E6))
-    return x
-
-
-def _reverse_bits32(x):
-    x = ((x & _u32(0x55555555)) << 1) | ((x >> 1) & _u32(0x55555555))
-    x = ((x & _u32(0x33333333)) << 2) | ((x >> 2) & _u32(0x33333333))
-    x = ((x & _u32(0x0F0F0F0F)) << 4) | ((x >> 4) & _u32(0x0F0F0F0F))
-    x = ((x & _u32(0x00FF00FF)) << 8) | ((x >> 8) & _u32(0x00FF00FF))
-    return (x << 16) | (x >> 16)
-
-
-def _hash_combine(a, b):
-    x = (a ^ (b + _u32(0x9E3779B9) + (a << 6) + (a >> 2))).astype(jnp.uint32)
-    x = x * _u32(0x85EBCA6B)
-    x = x ^ (x >> 13)
-    x = x * _u32(0xC2B2AE35)
-    return x ^ (x >> 16)
 
 
 def _ndtri_f32(u):

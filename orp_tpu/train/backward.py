@@ -42,6 +42,7 @@ programs shape-stable (two compilations: first step's epoch count, warm steps').
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -51,6 +52,55 @@ import numpy as np
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.train import losses as L
 from orp_tpu.train.fit import FitConfig, fit
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _value(model, params, feats, prices):
+    return model.value(params, feats, prices)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "dual_mode", "holdings_combine")
+)
+def _date_outputs(
+    model, params1, params2, feats_t, prices_t, prices_t1, target,
+    cost_of_capital, g_pre, *, dual_mode, holdings_combine,
+):
+    """Everything the walk derives per date AFTER the fits, as one fused XLA
+    program: value predictions, cost-of-capital combine, holdings ledger and
+    next-date replication residual. Eager per-date evaluation of these at 1M
+    paths costs seconds/date in op-by-op dispatch — this is the walk's hot
+    non-fit path.
+
+    ``shared`` mode (the RP.py:172 weight-sharing bug): ``g`` must come from
+    the weights as they were right after the MSE fit (the caller snapshots it
+    as ``g_pre`` before the quantile fit mutates the shared params), while the
+    holdings ledger reads the post-quantile weights — exactly the reference's
+    call order (predict at :212, fit quantile at :217, get_phi_psi_VaR at
+    :224 seeing identical phi1/phi2 so the combine collapses to phi1).
+    """
+    if dual_mode == "shared":
+        h_t = model.value(params2, feats_t, prices_t)
+        v_t = g_pre + cost_of_capital * (h_t - g_pre)
+        comb = model.holdings(params2, feats_t)
+        return v_t, comb, target - jnp.sum(comb * prices_t1, axis=-1)
+    g_t = model.value(params1, feats_t, prices_t)
+    if dual_mode == "mse_only":
+        v_t = g_t
+    else:
+        h_t = model.value(params2, feats_t, prices_t)
+        v_t = g_t + cost_of_capital * (h_t - g_t)
+    h1 = model.holdings(params1, feats_t)
+    if dual_mode == "mse_only":
+        comb = h1
+    else:
+        h2 = model.holdings(params2, feats_t)
+        if holdings_combine == "py":
+            comb = h1 + cost_of_capital * (h1 - h2)  # RP.py:114 sign quirk
+        else:
+            comb = h1 + cost_of_capital * (h2 - h1)  # Single#18, matches values
+    var_resid = target - jnp.sum(comb * prices_t1, axis=-1)
+    return v_t, comb, var_resid
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +180,11 @@ def backward_induction(
     tl, tmae, tmape, eps_ran = [], [], [], []
 
     b_prices = jnp.asarray(b_prices, dtype)
+    # all (Y_t, B_t) price pairs materialised once — per-date eager stacks at
+    # 1M paths cost ~0.5s/date in dispatch on a tunneled device
+    prices_all = jax.jit(
+        lambda y, b: jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
+    )(y_prices.astype(dtype), b_prices)
 
     # resume from the last completed date if a checkpoint exists (SURVEY.md §5:
     # the reference can only rerun by hand; here a preempted TPU job continues)
@@ -172,50 +227,41 @@ def backward_induction(
             lr=cfg.lr if (first or cfg.lr is not None) else cfg.warm_lr,
         )
         feats_t = features[:, t]
-        prices_t = jnp.stack(
-            [y_prices[:, t], jnp.broadcast_to(b_prices[t], (n_paths,))], axis=-1
-        )
-        prices_t1 = jnp.stack(
-            [y_prices[:, t + 1], jnp.broadcast_to(b_prices[t + 1], (n_paths,))], axis=-1
-        )
+        prices_t = prices_all[:, t]
+        prices_t1 = prices_all[:, t + 1]
         target = values[:, t + 1]
 
         params1, aux1 = fit(
             params1, feats_t, prices_t1, target, ka,
             value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
         )
-        g_t = model.value(params1, feats_t, prices_t)
-
+        g_pre = jnp.zeros((), dtype)  # only read in shared mode
         if cfg.dual_mode == "mse_only":
-            h_t = g_t
             params2 = params1
         else:
             if cfg.dual_mode == "shared":
+                # snapshot the MSE-fit prediction before the quantile fit
+                # mutates the shared weights (reference order, RP.py:212-217)
+                g_pre = _value(model, params1, feats_t, prices_t)
                 params2 = params1
             params2, _ = fit(
                 params2, feats_t, prices_t1, target, kb,
                 value_fn=model.value, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
             )
-            h_t = model.value(params2, feats_t, prices_t)
             if cfg.dual_mode == "shared":
                 params1 = params2
 
-        i_cc = cfg.cost_of_capital
-        v_t = g_t + i_cc * (h_t - g_t)
+        # values combine + holdings/VaR ledgers (RP.py:103-125, :221) — one
+        # fused program per date
+        v_t, comb, var_resid = _date_outputs(
+            model, params1, params2, feats_t, prices_t, prices_t1, target,
+            cfg.cost_of_capital, g_pre,
+            dual_mode=cfg.dual_mode, holdings_combine=cfg.holdings_combine,
+        )
         values = values.at[:, t].set(v_t)
-
-        # holdings + next-date replication residual ledgers (RP.py:103-125)
-        h1 = model.holdings(params1, feats_t)
-        h2 = model.holdings(params2, feats_t)
-        if cfg.dual_mode == "mse_only":
-            comb = h1
-        elif cfg.holdings_combine == "py":
-            comb = h1 + i_cc * (h1 - h2)  # RP.py:114 sign quirk
-        else:
-            comb = h1 + i_cc * (h2 - h1)  # Single#18, matches value combine
         phi_cols.append(comb[:, 0])
         psi_cols.append(comb[:, 1])
-        var_cols.append(target - jnp.sum(comb * prices_t1, axis=-1))
+        var_cols.append(var_resid)
 
         tl.append(float(aux1["final_loss"]))
         tmae.append(float(aux1["mae"]))

@@ -56,6 +56,8 @@ class FitConfig:
     min_delta: float = 0.0
     shuffle: bool = True
     lr: float | None = None  # constant LR; None -> reference step schedule
+    unroll: int = 4  # minibatch-scan unroll: amortises TPU loop overhead over
+    # the tiny per-batch matmuls (122-param net); 4 is a measured sweet spot
 
 
 def _make_optimizer(cfg: FitConfig):
@@ -122,7 +124,10 @@ def fit(
             p = optax.apply_updates(p, updates)
             return (p, s), loss
 
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (fb, pb, tb))
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), (fb, pb, tb),
+            unroll=min(cfg.unroll, n_batches),
+        )
         return params, opt_state, jnp.mean(losses)
 
     def epoch_body(carry, xs):

@@ -1,0 +1,32 @@
+"""Numerical sanitizers (SURVEY.md §5 "race detection / sanitizers").
+
+The reference needs no thread sanitizers (single-threaded NumPy); the JAX
+equivalent of a sanitizer pass is NaN/Inf detection on jitted programs plus
+``checkify`` for in-kernel assertions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.experimental import checkify
+
+
+@contextlib.contextmanager
+def nan_debug():
+    """Enable ``jax_debug_nans`` within the block: any NaN produced by a jitted
+    computation raises immediately with the offending primitive located."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checked(fn, *, errors=checkify.float_checks):
+    """Wrap ``fn`` with checkify float checks: returns ``checked_fn`` whose
+    first output is an error carrier — call ``err.throw()`` to surface NaN/Inf
+    divisions etc. raised inside jit/scan, where Python exceptions can't."""
+    return checkify.checkify(fn, errors=errors)

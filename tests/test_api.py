@@ -20,7 +20,7 @@ from orp_tpu.api import (
     replicating_portfolio_sv,
     sigma_sweep,
 )
-from tests.test_train import bs_call
+from orp_tpu.utils import bs_call
 
 # constant 1e-3 LR: the reference's warm-step policy (settled 5e-4, see
 # BackwardConfig.warm_lr) under-trains these deliberately tiny grids

@@ -1,0 +1,40 @@
+"""Smoke tests for the matplotlib reporting layer (Agg backend, no display)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import jax.numpy as jnp
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.risk import plots
+
+
+def _tiny_run():
+    return european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=512, T=1.0, dt=0.25, rebalance_every=1),
+        TrainConfig(epochs_first=30, epochs_warm=15, batch_size=512,
+                    dual_mode="mse_only", lr=1e-3),
+    )
+
+
+def test_all_charts_render():
+    res = _tiny_run()
+    r = res.report
+    axes = [
+        plots.fan_chart(r, res.times),
+        plots.holdings_violins(res.backward.phi, res.backward.psi, res.times),
+        plots.residual_scatter(
+            res.backward.var_residuals[:, -1], jnp.ones(512) * 100.0
+        ),
+        plots.var_over_time(r, res.times),
+        plots.training_error_curve(r, res.times),
+    ]
+    for ax in axes:
+        assert ax.figure is not None
+        ax.figure.canvas.draw()
+    import matplotlib.pyplot as plt
+
+    plt.close("all")

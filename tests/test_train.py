@@ -18,7 +18,7 @@ from orp_tpu.train import (
 )
 
 
-from orp_tpu.utils import bs_call  # single shared oracle (re-exported for test_api)
+from orp_tpu.utils import bs_call
 
 
 def test_model_param_counts_match_reference():
@@ -164,3 +164,23 @@ def test_backward_shared_mode_runs():
     )
     assert res.params1 is res.params2  # the RP.py:172 accidental sharing, reproduced
     assert np.isfinite(float(res.v0.mean()))
+
+
+def test_backward_shared_mode_g_predates_quantile_fit():
+    # reference order (RP.py:212-217): g is predicted BEFORE the quantile fit
+    # mutates the shared weights. With cost_of_capital=0, values must equal
+    # that pre-quantile MSE prediction — NOT the final shared weights' value.
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=512, n_steps=2)
+    model = HedgeMLP(n_features=1)
+    res = backward_induction(
+        model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0,
+        BackwardConfig(
+            epochs_first=60, epochs_warm=30, dual_mode="shared",
+            batch_size=512, cost_of_capital=0.0,
+        ),
+    )
+    prices_0 = jnp.stack([S[:, 0] / S0, jnp.broadcast_to(B[0] / S0, S[:, 0].shape)], -1)
+    post = model.value(res.params2, (S[:, 0] / S0)[:, None], prices_0)
+    # quantile training moved the shared weights, so the stored t=0 values
+    # (pure g_pre at cc=0) must differ from the post-quantile prediction
+    assert float(jnp.abs(res.values[:, 0] - post).max()) > 1e-4
