@@ -4,6 +4,7 @@ from orp_tpu.api.config import (
     ActuarialConfig,
     EuropeanConfig,
     HedgeRunConfig,
+    HestonConfig,
     MarketConfig,
     SimConfig,
     StochVolConfig,
@@ -11,6 +12,7 @@ from orp_tpu.api.config import (
 )
 from orp_tpu.api.pipelines import (
     european_hedge,
+    heston_hedge,
     pension_hedge,
     replicating_portfolio,
     replicating_portfolio_sv,
@@ -21,11 +23,13 @@ __all__ = [
     "ActuarialConfig",
     "EuropeanConfig",
     "HedgeRunConfig",
+    "HestonConfig",
     "MarketConfig",
     "SimConfig",
     "StochVolConfig",
     "TrainConfig",
     "european_hedge",
+    "heston_hedge",
     "pension_hedge",
     "replicating_portfolio",
     "replicating_portfolio_sv",

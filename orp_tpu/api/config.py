@@ -75,6 +75,8 @@ class SimConfig:
     scramble: str = "owen"
     binomial_mode: str = "exact"  # "exact" | "normal" (orp_tpu.sde.kernels)
     dtype: str = "float32"
+    engine: str = "scan"  # "scan" (XLA, any pipeline/mesh) | "pallas" (fused
+    # kernel, ~3.8x sim speedup; single-chip log-GBM pipelines only)
 
     @property
     def n_steps(self) -> int:
@@ -120,6 +122,23 @@ class EuropeanConfig:
     sigma: float = 0.15
     option_type: str = "call"
     constrain_self_financing: bool = True  # psi = 1 - phi head (Euro#12)
+
+
+@dataclasses.dataclass(frozen=True)
+class HestonConfig:
+    """Risk-neutral Heston dynamics for the European hedge (the corrected-SV
+    companion to the reference's vol-CIR, SURVEY.md §7 step 2; BASELINE.json
+    config 4). ``v`` is *variance*."""
+
+    s0: float = 100.0
+    strike: float = 100.0
+    r: float = 0.08
+    v0: float = 0.0225
+    kappa: float = 1.5
+    theta: float = 0.0225
+    xi: float = 0.25
+    rho: float = -0.6
+    option_type: str = "call"
 
 
 @dataclasses.dataclass(frozen=True)

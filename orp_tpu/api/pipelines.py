@@ -31,11 +31,13 @@ from orp_tpu.api.config import (
     ActuarialConfig,
     EuropeanConfig,
     HedgeRunConfig,
+    HestonConfig,
     MarketConfig,
     SimConfig,
     StochVolConfig,
     TrainConfig,
 )
+from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.parallel.mesh import path_indices
 from orp_tpu.risk.analytics import HedgeReport, build_report
@@ -47,6 +49,30 @@ from orp_tpu.sde import (
     simulate_pension,
 )
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
+
+
+def _require_scan_engine(sim: SimConfig, name: str) -> None:
+    if sim.engine != "scan":
+        raise ValueError(
+            f"{name} supports engine='scan' only (the Pallas kernel covers the "
+            "single-factor log-GBM pipeline); got engine={sim.engine!r}"
+        )
+
+
+def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times) -> None:
+    """Unbiased QMC price + learned-hedge control variate (risk-neutral sims
+    only): ``disc_t S_t`` is a Q-martingale, so subtracting
+    ``sum_t phi_t (disc_{t+1} S_{t+1} - disc_t S_t)`` changes no mean and
+    removes the delta-hedgeable variance. The network-predicted ``report.v0``
+    keeps the reference's (biased) estimator for parity; this is the
+    framework-native price."""
+    disc = jnp.exp(-r * jnp.asarray(times, s.dtype))
+    d_mart = disc[1:] * s[:, 1:] - disc[:-1] * s[:, :-1]
+    plain = disc[-1] * payoff
+    cv = plain - jnp.sum(res.phi * d_mart, axis=1)
+    report.v0_plain = float(jnp.mean(plain))
+    report.v0_cv = float(jnp.mean(cv))
+    report.cv_std = float(jnp.std(cv))
 
 
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
@@ -111,11 +137,28 @@ def european_hedge(
     """
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    idx = path_indices(sim.n_paths, mesh)
-    s = simulate_gbm_log(
-        idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
-        scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
-    )
+    if sim.engine == "pallas":
+        if mesh is not None:
+            raise ValueError(
+                "engine='pallas' is single-chip (grid indices are kernel-local); "
+                "use engine='scan' with a mesh"
+            )
+        if sim.scramble != "owen" or dtype != jnp.float32:
+            raise ValueError(
+                "engine='pallas' generates Owen-scrambled float32 paths only; "
+                f"got scramble={sim.scramble!r} dtype={sim.dtype!r}"
+            )
+        s = gbm_log_pallas(
+            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
+            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
+            block_paths=min(2048, sim.n_paths),
+        ).astype(dtype)
+    else:
+        idx = path_indices(sim.n_paths, mesh)
+        s = simulate_gbm_log(
+            idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
+            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
+        )
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
@@ -147,19 +190,52 @@ def european_hedge(
         adjustment_factor=s0,
         holdings_adjustment=1.0,
     )
-    # unbiased QMC price + learned-hedge control variate: under the pipeline's
-    # risk-neutral measure (drift r, Euro#5), disc_t*S_t is a martingale, so
-    # subtracting sum_t phi_t (disc_{t+1} S_{t+1} - disc_t S_t) changes no mean
-    # and removes the delta-hedgeable variance. The network-predicted v0 above
-    # keeps the reference's biased estimator for parity; these are the
-    # framework-native price.
-    disc = jnp.exp(-euro.r * jnp.asarray(times, s.dtype))
-    d_mart = disc[1:] * s[:, 1:] - disc[:-1] * s[:, :-1]
-    plain = disc[-1] * payoff
-    cv = plain - jnp.sum(res.phi * d_mart, axis=1)
-    report.v0_plain = float(jnp.mean(plain))
-    report.v0_cv = float(jnp.mean(cv))
-    report.cv_std = float(jnp.std(cv))
+    _attach_cv_price(report, res, s, payoff, euro.r, times)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
+
+
+def heston_hedge(
+    heston: HestonConfig | None = None,
+    sim: SimConfig = SimConfig(n_paths=1 << 16, T=1.0, dt=1 / 364, rebalance_every=7),
+    train: TrainConfig = TrainConfig(dual_mode="mse_only"),
+    *,
+    mesh=None,
+) -> PipelineResult:
+    """European hedge under risk-neutral Heston stochastic vol (BASELINE.json
+    config 4). The hedge net sees features ``(S_t/S0, v_t)`` — the variance
+    state is observable to the hedger, unlike the reference's SV pension where
+    only ``(Y, N, lambda)`` feed the net (RP.py:300s). Reports include the
+    unbiased CV price (discounted S is still a Q-martingale under Heston)."""
+    _require_scan_engine(sim, "heston_hedge")
+    h = heston or HestonConfig()
+    dtype = jnp.dtype(sim.dtype)
+    grid = TimeGrid(sim.T, sim.n_steps)
+    idx = path_indices(sim.n_paths, mesh)
+    traj = simulate_heston_log(
+        idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
+        xi=h.xi, rho=h.rho, seed=sim.seed_fund,
+        scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
+    )
+    s, v = traj["S"], traj["v"]
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, h.r, dtype)
+    payoff = payoffs.european(s[:, -1], h.strike, h.option_type)
+
+    s0 = h.s0
+    model = HedgeMLP(n_features=2)
+    e_payoff_n = float(jnp.mean(payoff)) / s0
+    features = jnp.stack([s / s0, v], axis=-1)
+    res = backward_induction(
+        model, features, s / s0, b / s0, payoff / s0,
+        _backward_cfg(train),
+        bias_init=(e_payoff_n, 0.0),
+    )
+    times = np.asarray(coarse.times())
+    report = build_report(
+        res, terminal_payoff=payoff / s0, r=h.r, times=times,
+        adjustment_factor=s0, holdings_adjustment=1.0,
+    )
+    _attach_cv_price(report, res, s, payoff, h.r, times)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
 
 
@@ -177,6 +253,7 @@ def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> Pipel
     the reported phi/psi/V0 are scaled by ``ADJUSTMENT_FACTOR = N0 * premium``
     (RP.py:46, :230).
     """
+    _require_scan_engine(cfg.sim, "pension_hedge")
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)

@@ -74,7 +74,7 @@ def cmd_euro(args):
         ),
         SimConfig(
             n_paths=args.paths, T=args.T, dt=args.T / args.steps,
-            rebalance_every=args.rebalance_every,
+            rebalance_every=args.rebalance_every, engine=args.engine,
         ),
         _train_cfg(args, "mse_only"),
     )
@@ -160,6 +160,8 @@ def main(argv=None):
     pe.add_argument("--option-type", choices=["call", "put"], default="call")
     pe.add_argument("--unconstrained", action="store_true",
                     help="drop the psi=1-phi self-financing head")
+    pe.add_argument("--engine", choices=["scan", "pallas"], default="scan",
+                    help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(pe)
     pe.set_defaults(fn=cmd_euro)
 

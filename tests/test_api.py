@@ -75,6 +75,36 @@ def test_european_put_pipeline_runs():
     assert -0.45 < res.phi0 < -0.05, res.phi0
 
 
+def test_heston_hedge_pipeline():
+    from orp_tpu.api import HestonConfig, heston_hedge
+
+    res = heston_hedge(
+        HestonConfig(),
+        SimConfig(n_paths=4096, T=1.0, dt=1 / 16, rebalance_every=2),
+        FAST_TRAIN,
+    )
+    # Heston ATM call with long-run vol sqrt(0.0225)=15%: price in the BS-15% ballpark
+    assert 8.0 < res.report.v0_cv < 13.0, res.report.v0_cv
+    assert np.isfinite(res.v0)
+    assert res.backward.phi.shape == (4096, 8)
+
+
+def test_european_pallas_engine_matches_scan():
+    euro = EuropeanConfig()
+    sim_scan = SimConfig(n_paths=512, T=1.0, dt=0.25, rebalance_every=1)
+    sim_pl = SimConfig(n_paths=512, T=1.0, dt=0.25, rebalance_every=1, engine="pallas")
+    train = TrainConfig(epochs_first=40, epochs_warm=20, batch_size=512,
+                        dual_mode="mse_only", lr=1e-3)
+    a = european_hedge(euro, sim_scan, train)
+    b = european_hedge(euro, sim_pl, train)
+    # same Sobol stream bit-for-bit; training on f32-ulp-different paths
+    np.testing.assert_allclose(b.v0, a.v0, rtol=1e-3)
+    with pytest.raises(ValueError, match="single-chip"):
+        from orp_tpu.parallel import make_mesh
+
+        european_hedge(euro, sim_pl, train, mesh=make_mesh())
+
+
 PENSION_FAST = HedgeRunConfig(
     sim=SimConfig(n_paths=1024, T=2.0, dt=1 / 12, rebalance_every=12),
     train=TrainConfig(epochs_first=120, epochs_warm=60, batch_size=1024),
