@@ -46,6 +46,7 @@ from orp_tpu.sde import (
     bond_curve,
     payoffs,
     simulate_gbm_log,
+    simulate_heston_log,
     simulate_pension,
 )
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
