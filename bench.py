@@ -1,12 +1,15 @@
-"""Round benchmark: Sobol-QMC GBM path-simulation throughput on one chip.
+"""Round benchmark: Sobol-QMC GBM simulation throughput + the north-star hedge.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with the
+end-to-end hedge headline merged in as ``hedge_*`` keys (the 1M-path 52-step
+European-call walk of ``benchmarks/north_star.py``: bp error vs Black-Scholes
+and wall seconds — both perf axes in one artifact).
 
-Baseline: the reference's best observed simulation throughput — ~15M path-steps/s
-on host NumPy (BASELINE.md, derived from ``Multi Time Step.ipynb#7(out)``:
-4,096 paths x 3,651 steps in 0.967 s). Here the same workload class (scrambled
-Sobol -> inverse-normal -> log-Euler GBM scan) runs as one fused XLA program on
-the TPU chip; the figure is paths*steps/sec of the jit-warmed kernel.
+Baselines: sim — the reference's best observed throughput, ~15M path-steps/s on
+host NumPy (BASELINE.md, from ``Multi Time Step.ipynb#7(out)``: 4,096 paths x
+3,651 steps in 0.967 s); hedge — the reference's learned Euro V0 of 11.352 vs
+Black-Scholes 10.3896 (+926 bp, ``European Options.ipynb#20(out)``) at 4,096
+paths, wall unrecorded.
 """
 
 import json
@@ -69,17 +72,33 @@ def main():
     assert drift_err < 0.02, f"drift oracle failed: {drift_err}"
 
     value = n_paths * n_steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": "sobol_gbm_path_steps_per_sec_per_chip",
-                "value": round(value),
-                "unit": "path-steps/s",
-                "vs_baseline": round(value / BASELINE_PATH_STEPS_PER_SEC, 2),
-                "kernel": kernel,
-            }
+    record = {
+        "metric": "sobol_gbm_path_steps_per_sec_per_chip",
+        "value": round(value),
+        "unit": "path-steps/s",
+        "vs_baseline": round(value / BASELINE_PATH_STEPS_PER_SEC, 2),
+        "kernel": kernel,
+    }
+
+    # second perf axis: the end-to-end north-star hedge (1M paths, 52 weekly
+    # dates, v0_cv vs Black-Scholes). Failures degrade to an error note rather
+    # than sinking the sim metric.
+    try:
+        from benchmarks.north_star import main as north_star
+
+        hedge = north_star(quiet=True)
+        record.update(
+            hedge_bp_err=hedge["bp_err"],
+            hedge_wall_s=hedge["wall_s"],
+            hedge_v0_cv=hedge["v0_cv"],
+            hedge_cv_std=hedge["cv_std"],
+            hedge_bs=hedge["bs"],
+            hedge_paths=hedge["paths"],
         )
-    )
+    except Exception as e:  # noqa: BLE001
+        record.update(hedge_error=f"{type(e).__name__}: {e}")
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

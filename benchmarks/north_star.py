@@ -24,7 +24,7 @@ from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
 from orp_tpu.utils import bs_call
 
 
-def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64):
+def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64, quiet=False):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(
@@ -52,7 +52,8 @@ def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64):
         "paths": n_paths,
         "v0_network": round(res.v0, 4),
     }
-    print(json.dumps(out))
+    if not quiet:
+        print(json.dumps(out))
     return out
 
 

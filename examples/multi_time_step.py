@@ -24,13 +24,19 @@ def main():
     ap.add_argument("--paths", type=int, default=4096)
     ap.add_argument("--sweep", action="store_true", help="Multi#29-30 sigma sweep")
     ap.add_argument("--sv", action="store_true", help="RP_SV stochastic-vol variant")
+    ap.add_argument("--shared", action="store_true",
+                    help="reference-parity mode: the RP.py:172 accidental weight "
+                         "sharing + the RP.py:114 phi-combine sign (closest match "
+                         "to Multi#25-26(out); see PARITY.md)")
     args = ap.parse_args()
 
     cfg = HedgeRunConfig(
         sv=StochVolConfig() if args.sv else None,
         # RP defaults: T=10y, dt=1/100, quarterly rebalancing -> 40 dates
         sim=SimConfig(n_paths=args.paths, T=10.0, dt=0.01, rebalance_every=25),
-        train=TrainConfig(),  # dual separate models, 500/100 epochs, i=0.1
+        # default: dual separate models (intended semantics), 500/100 epochs, i=0.1
+        train=TrainConfig(dual_mode="shared", holdings_combine="py")
+        if args.shared else TrainConfig(),
     )
     if args.sweep:
         rows = sigma_sweep([0.05, 0.10, 0.15, 0.20, 0.30], cfg)

@@ -37,6 +37,7 @@ from orp_tpu.api.config import (
     StochVolConfig,
     TrainConfig,
 )
+from orp_tpu.qmc.pallas_mf import heston_log_pallas, pension_pallas
 from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.parallel.mesh import path_indices
@@ -52,11 +53,18 @@ from orp_tpu.sde import (
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
 
 
-def _require_scan_engine(sim: SimConfig, name: str) -> None:
-    if sim.engine != "scan":
+def _check_pallas(sim: SimConfig, mesh, name: str) -> None:
+    """Validate the Pallas-engine constraints shared by every pipeline: the
+    fused kernels are single-chip (grid indices are kernel-local), generate
+    Owen-scrambled float32 paths, and tile paths into power-of-two blocks."""
+    if mesh is not None:
         raise ValueError(
-            f"{name} supports engine='scan' only (the Pallas kernel covers the "
-            "single-factor log-GBM pipeline); got engine={sim.engine!r}"
+            f"{name}: engine='pallas' is single-chip; use engine='scan' with a mesh"
+        )
+    if sim.scramble != "owen" or jnp.dtype(sim.dtype) != jnp.float32:
+        raise ValueError(
+            f"{name}: engine='pallas' generates Owen-scrambled float32 paths only; "
+            f"got scramble={sim.scramble!r} dtype={sim.dtype!r}"
         )
 
 
@@ -139,16 +147,7 @@ def european_hedge(
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     if sim.engine == "pallas":
-        if mesh is not None:
-            raise ValueError(
-                "engine='pallas' is single-chip (grid indices are kernel-local); "
-                "use engine='scan' with a mesh"
-            )
-        if sim.scramble != "owen" or dtype != jnp.float32:
-            raise ValueError(
-                "engine='pallas' generates Owen-scrambled float32 paths only; "
-                f"got scramble={sim.scramble!r} dtype={sim.dtype!r}"
-            )
+        _check_pallas(sim, mesh, "european_hedge")
         s = gbm_log_pallas(
             sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
             dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
@@ -207,16 +206,24 @@ def heston_hedge(
     state is observable to the hedger, unlike the reference's SV pension where
     only ``(Y, N, lambda)`` feed the net (RP.py:300s). Reports include the
     unbiased CV price (discounted S is still a Q-martingale under Heston)."""
-    _require_scan_engine(sim, "heston_hedge")
     h = heston or HestonConfig()
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    idx = path_indices(sim.n_paths, mesh)
-    traj = simulate_heston_log(
-        idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
-        xi=h.xi, rho=h.rho, seed=sim.seed_fund,
-        scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
-    )
+    if sim.engine == "pallas":
+        _check_pallas(sim, mesh, "heston_hedge")
+        traj = heston_log_pallas(
+            sim.n_paths, sim.n_steps, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa,
+            theta=h.theta, xi=h.xi, rho=h.rho, dt=grid.dt, seed=sim.seed_fund,
+            store_every=sim.rebalance_every,
+            block_paths=min(1024, sim.n_paths),
+        )
+    else:
+        idx = path_indices(sim.n_paths, mesh)
+        traj = simulate_heston_log(
+            idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
+            xi=h.xi, rho=h.rho, seed=sim.seed_fund,
+            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
+        )
     s, v = traj["S"], traj["v"]
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, h.r, dtype)
@@ -254,20 +261,15 @@ def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> Pipel
     the reported phi/psi/V0 are scaled by ``ADJUSTMENT_FACTOR = N0 * premium``
     (RP.py:46, :230).
     """
-    _require_scan_engine(cfg.sim, "pension_hedge")
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
-    idx = path_indices(s.n_paths, mesh)
 
     sv = cfg.sv
-    traj = simulate_pension(
-        idx, grid,
+    sde_kw = dict(
         y0=m.y0, mu=m.mu, sigma=None if sv else m.sigma,
         l0=a.l0, mort_c=a.mort_c, eta=a.eta, n0=float(a.n0),
-        seed=s.seed,
-        scramble=s.scramble, store_every=s.rebalance_every, dtype=dtype,
-        binomial_mode=s.binomial_mode,
+        seed=s.seed, store_every=s.rebalance_every,
         sv=sv is not None,
         v0=sv.v0 if sv else 0.0,
         cir_a=sv.a if sv else 0.0,
@@ -275,6 +277,25 @@ def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> Pipel
         cir_c=sv.c if sv else 0.0,
         cir_drift_times_dt=sv.drift_times_dt if sv else False,
     )
+    if s.engine == "pallas":
+        _check_pallas(s, mesh, "pension_hedge")
+        if s.binomial_mode != "normal":
+            raise ValueError(
+                "pension_hedge: engine='pallas' supports binomial_mode='normal' "
+                "only (the exact stateless-binomial draw needs threefry and "
+                "stays on the scan path); got binomial_mode="
+                f"{s.binomial_mode!r}"
+            )
+        traj = pension_pallas(
+            s.n_paths, s.n_steps, dt=grid.dt,
+            block_paths=min(1024, s.n_paths), **sde_kw,
+        )
+    else:
+        idx = path_indices(s.n_paths, mesh)
+        traj = simulate_pension(
+            idx, grid, scramble=s.scramble, dtype=dtype,
+            binomial_mode=s.binomial_mode, **sde_kw,
+        )
     y, lam, pop = traj["Y"], traj["lam"], traj["N"]
     coarse = grid.reduced(s.rebalance_every)
     b = bond_curve(coarse, m.r, dtype)
@@ -356,7 +377,9 @@ def _cfg_from_params(params: dict, sv_c: float | None = None) -> HedgeRunConfig:
             a=float(params.get("a", StochVolConfig.a)),
             b=float(params.get("b", StochVolConfig.b)),
             c=float(sv_c),
-            v0=float(params.get("v0", params.get("sigma", StochVolConfig.v0))),
+            # the SV notebook names the initial vol 's0' (Multi#32); accept the
+            # explicit keys first, then fall back to the constant-vol 'sigma'
+            v0=float(params.get("v0", params.get("s0", params.get("sigma", StochVolConfig.v0)))),
         )
     return HedgeRunConfig(
         market=MarketConfig(

@@ -8,6 +8,9 @@ output for scripting. Commands mirror the reference's four entry shapes:
 - ``pension``   pension-liability hedge (Replicating_Portfolio / Multi notebook;
                 ``--sv`` for the stochastic-vol variant, ``--single-step`` for
                 the Single Time Step shape)
+- ``heston``    European hedge under risk-neutral Heston stochastic vol
+                (the corrected-SV companion; no notebook analogue — the
+                reference's SV lives inside ``Replicating_Portfolio_SV``)
 - ``sweep``     sigma sweep             (Multi Time Step.ipynb#29-30)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 """
@@ -81,6 +84,31 @@ def cmd_euro(args):
     _emit(args, res.report)
 
 
+def cmd_heston(args):
+    from orp_tpu.api import HestonConfig, SimConfig, heston_hedge
+    from orp_tpu.utils.heston import heston_call, heston_put
+
+    h = HestonConfig(
+        s0=args.s0, strike=args.strike, r=args.r, v0=args.v0, kappa=args.kappa,
+        theta=args.theta, xi=args.xi, rho=args.rho, option_type=args.option_type,
+    )
+    res = heston_hedge(
+        h,
+        SimConfig(
+            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+            rebalance_every=args.rebalance_every, engine=args.engine,
+        ),
+        _train_cfg(args, "mse_only"),
+    )
+    pricer = heston_call if h.option_type == "call" else heston_put
+    oracle = pricer(h.s0, h.strike, h.r, args.T, v0=h.v0, kappa=h.kappa,
+                    theta=h.theta, xi=h.xi, rho=h.rho)
+    err_bp = (res.report.v0_cv - oracle) / oracle * 1e4
+    _emit(args, res.report, extra={"oracle": oracle, "cv_err_bp": err_bp})
+    if not args.json:
+        print(f"CF oracle = {oracle:,.4f}  (v0_cv off by {err_bp:+.1f} bp)")
+
+
 def cmd_pension(args):
     from orp_tpu.api import (
         HedgeRunConfig, MarketConfig, SimConfig, StochVolConfig, pension_hedge,
@@ -93,6 +121,10 @@ def cmd_pension(args):
         sim=SimConfig(
             n_paths=args.paths, T=args.T, dt=args.T / n_steps,
             rebalance_every=n_steps if args.single_step else args.rebalance_every,
+            engine=args.engine,
+            # the fused kernel draws the population via the moment-matched
+            # normal approximation (pipelines._check_pallas rejects 'exact')
+            binomial_mode="normal" if args.engine == "pallas" else "exact",
         ),
         train=_train_cfg(args, "separate"),
     )
@@ -165,6 +197,25 @@ def main(argv=None):
     _add_train_flags(pe)
     pe.set_defaults(fn=cmd_euro)
 
+    ph = sub.add_parser("heston", help="European hedge under Heston stochastic vol")
+    ph.add_argument("--paths", type=int, default=1 << 16)
+    ph.add_argument("--steps", type=int, default=364)
+    ph.add_argument("--rebalance-every", type=int, default=7)
+    ph.add_argument("--T", type=float, default=1.0)
+    ph.add_argument("--s0", type=float, default=100.0)
+    ph.add_argument("--strike", type=float, default=100.0)
+    ph.add_argument("--r", type=float, default=0.08)
+    ph.add_argument("--v0", type=float, default=0.0225)
+    ph.add_argument("--kappa", type=float, default=1.5)
+    ph.add_argument("--theta", type=float, default=0.0225)
+    ph.add_argument("--xi", type=float, default=0.25)
+    ph.add_argument("--rho", type=float, default=-0.6)
+    ph.add_argument("--option-type", choices=["call", "put"], default="call")
+    ph.add_argument("--engine", choices=["scan", "pallas"], default="scan",
+                    help="path simulator: XLA scan or fused Pallas kernel")
+    _add_train_flags(ph)
+    ph.set_defaults(fn=cmd_heston)
+
     pp = sub.add_parser("pension", help="pension-liability hedge")
     pp.add_argument("--paths", type=int, default=4096)
     pp.add_argument("--steps", type=int, default=1000)
@@ -176,6 +227,9 @@ def main(argv=None):
     pp.add_argument("--sv", action="store_true", help="CIR stochastic-vol fund")
     pp.add_argument("--single-step", action="store_true",
                     help="one rebalance interval (Single Time Step shape)")
+    pp.add_argument("--engine", choices=["scan", "pallas"], default="scan",
+                    help="path simulator: XLA scan (exact binomial) or fused "
+                         "Pallas kernel (normal-approx binomial)")
     _add_train_flags(pp)
     pp.set_defaults(fn=cmd_pension)
 

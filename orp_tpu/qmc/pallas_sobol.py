@@ -89,38 +89,51 @@ def _ndtri_f32(u):
     return jnp.where(jnp.abs(q) <= 0.425, central, tail)
 
 
-def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
-                seed, c0, vol_sdt, log_s0):
-    """One grid instance: evolve ``block_paths`` paths through all steps."""
+def _block_indices(block_paths):
+    """Global path indices for this grid instance, (rows, 128) uint32."""
     pid = pl.program_id(0)
     rows = block_paths // _LANES
     base = pid.astype(jnp.uint32) * _u32(block_paths)
-    # global path indices for this block, shaped (rows, 128) uint32; keep every
-    # operand uint32 — promotion to signed/wider ints breaks the bit kernels
-    idx = (base
-           + _u32(_LANES) * jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 0)
-           + jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1))
+    # keep every operand uint32 — promotion to signed/wider ints breaks the
+    # bit kernels
+    return (base
+            + _u32(_LANES) * jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 0)
+            + jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1))
+
+
+def _sobol_z(idx, dirs_ref, dim, seed):
+    """One factor's N(0,1) block for Sobol dimension ``dim`` (traced int32).
+
+    The full chain: Sobol integer (32-term XOR of direction entries where the
+    index bit is set — unrolled statically, Mosaic has no dynamic array
+    indexing; a lane/row/base bit-decomposition was measured at parity since
+    the VPU cost is dominated by the inverse normal, not the XOR chain), Owen
+    scramble keyed by hash(seed, dim), 23-bit bucket-centred uint32->(0,1)
+    (cast via int32 — the value is < 2^23 so the signed cast is exact; Mosaic
+    lacks uint32->f32), AS241 inverse normal.
+    """
+    # direction row for this dimension: dynamic sublane load, (1, 32) uint32
+    drow = dirs_ref[pl.dslice(dim, 1), :]
+    x = jnp.zeros(idx.shape, jnp.uint32)
+    for k in range(32):
+        bit = ((idx >> _u32(k)) & _u32(1)).astype(jnp.bool_)
+        x = x ^ jnp.where(bit, drow[0, k], _u32(0))
+    dim_seed = _hash_combine(_u32(seed), dim.astype(jnp.uint32))
+    x = _reverse_bits32(_laine_karras(_reverse_bits32(x), dim_seed))
+    u = ((x >> _u32(9)).astype(jnp.int32).astype(jnp.float32) + 0.5) * jnp.float32(2.0**-23)
+    return _ndtri_f32(u)
+
+
+def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
+                seed, c0, vol_sdt, log_s0):
+    """One grid instance: evolve ``block_paths`` paths through all steps."""
+    rows = block_paths // _LANES
+    idx = _block_indices(block_paths)
 
     out_ref[0, :, :] = jnp.full((rows, _LANES), log_s0, jnp.float32)
 
     def step(t, logs):
-        # direction row for dimension t-1: dynamic sublane load, (1, 32) uint32
-        drow = dirs_ref[pl.dslice(t - 1, 1), :]
-        # Sobol integer: XOR of direction entries where the index bit is set;
-        # the 32-term reduction is unrolled statically (Mosaic has no dynamic
-        # array indexing). A lane/row/base bit-decomposition was measured at
-        # parity with this — the VPU cost here is dominated by the inverse
-        # normal, not the XOR chain.
-        x = jnp.zeros((rows, _LANES), jnp.uint32)
-        for k in range(32):
-            bit = ((idx >> _u32(k)) & _u32(1)).astype(jnp.bool_)
-            x = x ^ jnp.where(bit, drow[0, k], _u32(0))
-        dim_seed = _hash_combine(_u32(seed), (t - 1).astype(jnp.uint32))
-        x = _reverse_bits32(_laine_karras(_reverse_bits32(x), dim_seed))
-        # 23-bit bucket-centred mapping (f32); cast via int32 — the value is
-        # < 2^23 so the signed cast is exact (Mosaic lacks uint32->f32)
-        u = ((x >> _u32(9)).astype(jnp.int32).astype(jnp.float32) + 0.5) * jnp.float32(2.0**-23)
-        z = _ndtri_f32(u)
+        z = _sobol_z(idx, dirs_ref, t - 1, seed)
         logs = logs + c0 + vol_sdt * z
 
         @pl.when(t % store_every == 0)

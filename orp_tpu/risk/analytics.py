@@ -154,6 +154,7 @@ class HedgeReport:
     v0_plain: float | None = None
     v0_cv: float | None = None
     cv_std: float | None = None  # per-path std of the CV estimator
+    times: np.ndarray | None = None  # rebalance-knot times (n_dates+1,)
 
     def summary(self) -> str:
         qs = ", ".join(
@@ -225,4 +226,46 @@ def build_report(
         train_mae=result.train_mae,
         train_mape=result.train_mape,
         epochs_ran=result.epochs_ran,
+        times=np.asarray(times),
     )
+
+
+def to_frames(report: HedgeReport) -> dict:
+    """Pandas-frame edge for notebook-style consumers (the shapes of
+    ``Multi Time Step.ipynb#22-26``): VaR-by-date, holdings-by-date, fan-chart
+    bands, and per-date training errors, all indexed by rebalance time.
+
+    Pandas is imported here only — the analytics hot path stays array-native.
+    """
+    import pandas as pd
+
+    times = report.times
+    date_times = times[:-1] if times is not None else np.arange(len(report.train_loss))
+    knot_times = times if times is not None else np.arange(report.fan.bands.shape[0])
+    var = pd.DataFrame(
+        report.var_by_date,
+        index=pd.Index(date_times, name="time"),
+        columns=[f"VaR_{q:g}" for q in report.var_qs],
+    )
+    holdings = pd.DataFrame(
+        {
+            "phi": report.holdings["phi_by_date"],
+            "psi": report.holdings["psi_by_date"],
+        },
+        index=pd.Index(date_times, name="time"),
+    )
+    fan = pd.DataFrame(
+        np.column_stack([report.fan.bands, report.fan.mean]),
+        index=pd.Index(knot_times, name="time"),
+        columns=[f"q{q:g}" for q in report.fan.qs] + ["mean"],
+    )
+    errors = pd.DataFrame(
+        {
+            "loss": report.train_loss,
+            "mae": report.train_mae,
+            "mape": report.train_mape,
+            "epochs": report.epochs_ran,
+        },
+        index=pd.Index(date_times, name="time"),
+    )
+    return {"var": var, "holdings": holdings, "fan": fan, "errors": errors}

@@ -197,22 +197,34 @@ def backward_induction(
         # results. checkpoint_dir itself is excluded — the same directory
         # spelled differently ('ckpts' vs './ckpts') must still resume.
         fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None)
+        # the format tag versions the on-disk state layout: a dir written by
+        # the pre-increment format (full ledgers per step) refuses cleanly here
+        # instead of failing deep in the replay with a KeyError
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
-            f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model}",
+            f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
+            "ckpt_format=increment-v2",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
-            st = ckpt.load_checkpoint(cfg.checkpoint_dir, last)
+            # each step holds only its own date's increment (O(1) columns);
+            # replay 0..last to rebuild the ledgers — a missing middle step
+            # raises in the loader rather than resuming silently wrong
+            for i, st in enumerate(
+                ckpt.load_checkpoints(cfg.checkpoint_dir, range(last + 1))
+            ):
+                t_i = n_dates - 1 - i
+                values = values.at[:, t_i].set(jnp.asarray(st["v_col"], dtype))
+                phi_cols.append(jnp.asarray(st["phi_col"]))
+                psi_cols.append(jnp.asarray(st["psi_col"]))
+                var_cols.append(jnp.asarray(st["var_col"]))
+                tl.append(float(st["train_loss"]))
+                tmae.append(float(st["train_mae"]))
+                tmape.append(float(st["train_mape"]))
+                eps_ran.append(int(st["epochs_ran"]))
             params1, params2 = st["params1"], st["params2"]
             if cfg.dual_mode == "shared":
                 params2 = params1
-            values = jnp.asarray(st["values"], dtype)
-            phi_cols = [jnp.asarray(c) for c in st["phi_cols"]]
-            psi_cols = [jnp.asarray(c) for c in st["psi_cols"]]
-            var_cols = [jnp.asarray(c) for c in st["var_cols"]]
-            tl, tmae = list(st["train_loss"]), list(st["train_mae"])
-            tmape, eps_ran = list(st["train_mape"]), list(st["epochs_ran"])
             start_step = last + 1
 
     for step_i, t in enumerate(range(n_dates - 1, -1, -1)):
@@ -271,20 +283,23 @@ def backward_induction(
         if cfg.checkpoint_dir is not None:
             from orp_tpu.utils import checkpoint as ckpt
 
+            # per-date increment only — params + this date's ledger columns.
+            # Saving the accumulated state instead is O(walk^2) cumulative I/O
+            # (~TB at 1M paths x 520 dates); increments keep each save O(paths)
             ckpt.save_checkpoint(
                 cfg.checkpoint_dir,
                 step_i,
                 {
                     "params1": params1,
                     "params2": params2,
-                    "values": values,
-                    "phi_cols": phi_cols,
-                    "psi_cols": psi_cols,
-                    "var_cols": var_cols,
-                    "train_loss": tl,
-                    "train_mae": tmae,
-                    "train_mape": tmape,
-                    "epochs_ran": eps_ran,
+                    "v_col": v_t,
+                    "phi_col": comb[:, 0],
+                    "psi_col": comb[:, 1],
+                    "var_col": var_resid,
+                    "train_loss": tl[-1],
+                    "train_mae": tmae[-1],
+                    "train_mape": tmape[-1],
+                    "epochs_ran": eps_ran[-1],
                 },
             )
 

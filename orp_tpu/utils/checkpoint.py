@@ -3,7 +3,7 @@
 The reference has no disk checkpointing (SURVEY.md §5): its only recovery
 mechanisms are Keras best-weight restoration inside one ``fit`` and the warm
 start across dates. This module adds the missing piece for long TPU jobs —
-persist ``(params, values, ledgers, date index)`` after each backward step so a
+persist ``(params, this date's ledger columns)`` after each backward step so a
 preempted run resumes at the next date instead of re-simulating/retraining.
 
 Built on ``orbax.checkpoint.CheckpointManager`` (the supported step-management
@@ -23,12 +23,13 @@ _FPRINT = "run_fingerprint.txt"
 
 
 def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
-    # resume only ever reads latest_step, so retain just the newest two steps
-    # (two, not one: the previous step survives until the new save finalises) —
-    # unbounded retention is O(n_dates * state) disk on long walks
+    # every step is retained: saves are per-date *increments* (one ledger
+    # column each), so resume replays all of them — total disk is the ledger
+    # size itself, and cumulative write I/O stays O(n_dates * paths) instead of
+    # the O(n_dates^2 * paths) that re-saving accumulated state would cost
     return ocp.CheckpointManager(
         pathlib.Path(directory).absolute(),
-        options=ocp.CheckpointManagerOptions(max_to_keep=2),
+        options=ocp.CheckpointManagerOptions(max_to_keep=None),
     )
 
 
@@ -72,3 +73,15 @@ def load_checkpoint(directory: str | pathlib.Path, step: int):
     """Restore the pytree saved at ``step``."""
     with _manager(directory) as mgr:
         return mgr.restore(step)
+
+
+def load_checkpoints(directory: str | pathlib.Path, steps):
+    """Yield the pytrees saved at each of ``steps`` from ONE open manager.
+
+    Resume replays every per-date increment; constructing a manager per step
+    would re-enumerate the whole directory each time (quadratic in walk length
+    now that all steps are retained).
+    """
+    with _manager(directory) as mgr:
+        for step in steps:
+            yield mgr.restore(step)

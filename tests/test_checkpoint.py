@@ -60,6 +60,23 @@ def test_resume_reproduces_uninterrupted_run(tmp_path):
         )
 
 
+def test_checkpoint_saves_constant_size_increments(tmp_path):
+    """Each step persists only its own date's columns — the fix for the
+    O(walk^2) cumulative I/O of re-saving accumulated ledgers every date."""
+    model, feats, y, b, term = _setup(n_paths=512, n_steps=3)
+    ckdir = str(tmp_path / "incr")
+    backward_induction(
+        model, feats, y, b, term,
+        BackwardConfig(epochs_first=20, epochs_warm=10, dual_mode="mse_only",
+                       batch_size=512, checkpoint_dir=ckdir),
+    )
+    first, last = load_checkpoint(ckdir, 0), load_checkpoint(ckdir, 2)
+    for st in (first, last):
+        assert np.asarray(st["v_col"]).shape == (512,)
+        assert np.asarray(st["phi_col"]).shape == (512,)
+        assert "values" not in st and "phi_cols" not in st
+
+
 def test_resume_refuses_mismatched_config(tmp_path):
     import pytest
 

@@ -29,6 +29,17 @@ def test_pension_single_step(capsys):
     assert out["v0"] > 0
 
 
+def test_heston_json(capsys):
+    cli.main([
+        "heston", "--paths", "512", "--steps", "8", "--rebalance-every", "2",
+        "--epochs-first", "30", "--epochs-warm", "15", "--batch-size", "512",
+        "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) >= {"v0", "v0_cv", "oracle", "cv_err_bp"}
+    assert np.isfinite(out["v0_cv"]) and out["oracle"] > 0
+
+
 def test_calibrate_csv(tmp_path, capsys):
     rng = np.random.default_rng(0)
     prices = 100 * np.exp(np.cumsum(rng.normal(0.0003, 0.01, size=400)))

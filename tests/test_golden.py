@@ -73,3 +73,27 @@ def test_golden_euro_flagship_hedge():
     v99, v995 = res.report.var_overall[1], res.report.var_overall[2]
     assert 1.5 < v99 < 8.0, v99
     assert v995 > v99
+
+
+def test_golden_pension_multi_step_shared_mode():
+    # Multi#25-26(out): V0=981,038; phi0=643,687/psi0=350,888 at 4096 paths,
+    # dt=1/100, quarterly, under the reference's accidental weight sharing
+    # (RP.py:172 -> dual_mode="shared") and its phi-combine sign (RP.py:114 ->
+    # holdings_combine="py"). Tolerance 3.5% on V0: the reference's own rerun
+    # of this config gave 967,729 (Multi#30(out) row 0.15, -1.4%), our seed
+    # spread is +-0.5% and backend (f32 CPU vs TPU) spread ~0.6%, around a
+    # measured mean of -1.9% (PARITY.md). phi/psi individually are
+    # seed-sensitive (each run lands on the OLS split of its own V1 column;
+    # see PARITY.md) so only their sum — which equals V0 at Y0=B0=1 — is
+    # pinned tightly; the individual legs get wide sanity bands spanning the
+    # measured seed range and the reference value.
+    from orp_tpu.api import HedgeRunConfig, pension_hedge
+
+    res = pension_hedge(HedgeRunConfig(
+        sim=SimConfig(n_paths=4096, T=10.0, dt=0.01, rebalance_every=25),
+        train=TrainConfig(dual_mode="shared", holdings_combine="py"),
+    ))
+    assert abs(res.v0 - 981_038) / 981_038 < 0.035, res.v0
+    assert abs((res.phi0 + res.psi0) - res.v0) / res.v0 < 0.02
+    assert 600_000 < res.phi0 < 780_000, res.phi0
+    assert 200_000 < res.psi0 < 380_000, res.psi0

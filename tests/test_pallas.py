@@ -43,3 +43,95 @@ def test_pallas_gbm_validates_shapes():
     with pytest.raises(ValueError):
         gbm_log_pallas(1024, 7, s0=1, drift=0, sigma=0.1, dt=0.1, store_every=2,
                        interpret=True)
+
+
+def test_pallas_heston_matches_xla_scan():
+    from orp_tpu.qmc.pallas_mf import heston_log_pallas
+    from orp_tpu.sde import simulate_heston_log
+
+    n_paths, n_steps, store = 512, 16, 4
+    grid = TimeGrid(1.0, n_steps)
+    kw = dict(s0=100.0, mu=0.08, v0=0.0225, kappa=1.5, theta=0.0225,
+              xi=0.25, rho=-0.6)
+    ref = simulate_heston_log(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, seed=1235,
+        store_every=store, **kw,
+    )
+    got = heston_log_pallas(
+        n_paths, n_steps, dt=grid.dt, seed=1235, store_every=store,
+        block_paths=256, interpret=True, **kw,
+    )
+    for k in ("S", "v"):
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(ref[k]), rtol=3e-5, atol=3e-6
+        )
+
+
+def test_pallas_pension_matches_xla_scan():
+    from orp_tpu.qmc.pallas_mf import pension_pallas
+    from orp_tpu.sde import simulate_pension
+
+    n_paths, n_steps, store = 512, 40, 10
+    grid = TimeGrid(10.0, n_steps)
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075,
+              eta=0.000597, n0=10000.0)
+    ref = simulate_pension(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, seed=1234,
+        store_every=store, binomial_mode="normal", **kw,
+    )
+    got = pension_pallas(
+        n_paths, n_steps, dt=grid.dt, seed=1234, store_every=store,
+        block_paths=256, interpret=True, **kw,
+    )
+    np.testing.assert_allclose(np.asarray(got["Y"]), np.asarray(ref["Y"]), rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(got["lam"]), np.asarray(ref["lam"]),
+                               rtol=3e-5, atol=3e-8)
+    # the thinned population is integer-valued: the moment-matched draws must
+    # agree exactly, not just to roundoff
+    np.testing.assert_array_equal(np.asarray(got["N"]), np.asarray(ref["N"]))
+
+
+def test_pallas_sv_pension_matches_xla_scan():
+    from orp_tpu.qmc.pallas_mf import pension_pallas
+    from orp_tpu.sde import simulate_pension
+
+    n_paths, n_steps, store = 512, 40, 10
+    grid = TimeGrid(10.0, n_steps)
+    kw = dict(y0=1.0, mu=0.0962, sigma=None, l0=0.01, mort_c=0.075,
+              eta=0.000597, n0=10000.0, sv=True, v0=0.16679,
+              cir_a=0.00333, cir_b=0.15629, cir_c=0.01583)
+    ref = simulate_pension(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, seed=1234,
+        store_every=store, binomial_mode="normal", **kw,
+    )
+    got = pension_pallas(
+        n_paths, n_steps, dt=grid.dt, seed=1234, store_every=store,
+        block_paths=256, interpret=True, **kw,
+    )
+    for k in ("Y", "v", "lam"):
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(ref[k]), rtol=3e-5, atol=3e-7
+        )
+    np.testing.assert_array_equal(np.asarray(got["N"]), np.asarray(ref["N"]))
+
+
+def test_pension_pipeline_pallas_engine_matches_scan():
+    from orp_tpu.api import HedgeRunConfig, SimConfig, TrainConfig, pension_hedge
+
+    train = TrainConfig(epochs_first=30, epochs_warm=15, batch_size=512,
+                        dual_mode="mse_only")
+    base = dict(T=2.0, dt=0.25, rebalance_every=4, n_paths=512,
+                binomial_mode="normal")
+    a = pension_hedge(HedgeRunConfig(sim=SimConfig(**base), train=train))
+    b = pension_hedge(HedgeRunConfig(sim=SimConfig(engine="pallas", **base), train=train))
+    np.testing.assert_allclose(a.v0, b.v0, rtol=1e-3)
+
+
+def test_pension_pipeline_pallas_rejects_exact_binomial():
+    from orp_tpu.api import HedgeRunConfig, SimConfig, pension_hedge
+
+    with pytest.raises(ValueError, match="binomial_mode"):
+        pension_hedge(HedgeRunConfig(sim=SimConfig(
+            T=1.0, dt=0.25, rebalance_every=1, n_paths=512, engine="pallas",
+            binomial_mode="exact",
+        )))

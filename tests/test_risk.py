@@ -65,3 +65,39 @@ def test_discounted_payoff_compare_lines():
     np.testing.assert_allclose(out["mean_value"], 5.0)
     np.testing.assert_allclose(out["discounted_payoff"][-1], 7.0, rtol=1e-6)
     np.testing.assert_allclose(out["discounted_payoff"][0], 7.0 * np.exp(-0.1), rtol=1e-6)
+
+
+def _tiny_report():
+    """A real build_report over a synthetic 3-date BackwardResult."""
+    from orp_tpu.risk.analytics import build_report
+    from orp_tpu.train.backward import BackwardResult
+
+    rng = np.random.default_rng(2)
+    n, d = 256, 3
+    res = BackwardResult(
+        values=jnp.asarray(rng.normal(1.0, 0.1, size=(n, d + 1))),
+        phi=jnp.asarray(rng.normal(0.5, 0.1, size=(n, d))),
+        psi=jnp.asarray(rng.normal(0.5, 0.1, size=(n, d))),
+        var_residuals=jnp.asarray(rng.normal(0.0, 0.05, size=(n, d))),
+        train_loss=np.array([3e-3, 2e-3, 1e-3]),
+        train_mae=np.array([0.03, 0.02, 0.01]),
+        train_mape=np.array([3.0, 2.0, 1.0]),
+        epochs_ran=np.array([30, 20, 20]),
+    )
+    payoff = jnp.asarray(rng.normal(1.0, 0.2, size=n))
+    return build_report(
+        res, terminal_payoff=payoff, r=0.03, times=np.linspace(0.0, 1.0, d + 1)
+    )
+
+
+def test_to_frames_shapes():
+    from orp_tpu.risk.analytics import to_frames
+
+    report = _tiny_report()
+    frames = to_frames(report)
+    assert set(frames) == {"var", "holdings", "fan", "errors"}
+    n_dates = len(report.train_loss)
+    assert frames["var"].shape == (n_dates, len(report.var_qs))
+    assert list(frames["holdings"].columns) == ["phi", "psi"]
+    assert frames["fan"].shape[0] == report.fan.bands.shape[0]
+    assert frames["errors"]["epochs"].tolist() == report.epochs_ran.tolist()
