@@ -110,6 +110,14 @@ class TrainConfig:
     lr: float | None = None
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist/resume per backward date
+    shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)
+    fused: bool = False  # whole walk as one XLA program (BackwardConfig.fused)
+
+    def __post_init__(self):
+        # fail at config construction, not after an expensive 1M-path sim
+        from orp_tpu.train.fit import validate_shuffle
+
+        object.__setattr__(self, "shuffle", validate_shuffle(self.shuffle))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -99,6 +99,8 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         lr=t.lr,
         seed=t.seed,
         checkpoint_dir=t.checkpoint_dir,
+        shuffle=t.shuffle,
+        fused=t.fused,
     )
 
 

@@ -51,7 +51,8 @@ import numpy as np
 
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.train import losses as L
-from orp_tpu.train.fit import FitConfig, fit
+from orp_tpu.train.fit import FitConfig, fit, fit_core
+from orp_tpu.train.fit import validate_shuffle as _validate_shuffle
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -59,10 +60,7 @@ def _value(model, params, feats, prices):
     return model.value(params, feats, prices)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("model", "dual_mode", "holdings_combine")
-)
-def _date_outputs(
+def _date_outputs_core(
     model, params1, params2, feats_t, prices_t, prices_t1, target,
     cost_of_capital, g_pre, *, dual_mode, holdings_combine,
 ):
@@ -103,6 +101,47 @@ def _date_outputs(
     return v_t, comb, var_resid
 
 
+_date_outputs = functools.partial(
+    jax.jit, static_argnames=("model", "dual_mode", "holdings_combine")
+)(_date_outputs_core)
+
+
+def _date_body(
+    model, cfg, params1, params2, feats_t, prices_t, prices_t1, target,
+    ka, kb, fit_cfg, mse, q_loss, metric_fns, *, fit_fn, value_fn, outputs_fn,
+):
+    """One backward date: MSE fit, optional quantile fit (``dual_mode``
+    semantics incl. the shared-weights ``g_pre`` snapshot, RP.py:212-217 order),
+    then the per-date outputs. The ONE definition of the date body — the host
+    loop passes the jitted pieces (``fit``/``_value``/``_date_outputs``), the
+    fused walk the traceable cores; only the dispatch structure differs."""
+    params1, aux1 = fit_fn(
+        params1, feats_t, prices_t1, target, ka,
+        value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
+    )
+    g_pre = jnp.zeros((), model.dtype)  # only read in shared mode
+    if cfg.dual_mode == "mse_only":
+        params2 = params1
+    else:
+        if cfg.dual_mode == "shared":
+            # snapshot the MSE-fit prediction before the quantile fit mutates
+            # the shared weights (reference order, RP.py:212-217)
+            g_pre = value_fn(model, params1, feats_t, prices_t)
+            params2 = params1
+        params2, _ = fit_fn(
+            params2, feats_t, prices_t1, target, kb,
+            value_fn=model.value, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
+        )
+        if cfg.dual_mode == "shared":
+            params1 = params2
+    v_t, comb, var_resid = outputs_fn(
+        model, params1, params2, feats_t, prices_t, prices_t1, target,
+        cfg.cost_of_capital, g_pre,
+        dual_mode=cfg.dual_mode, holdings_combine=cfg.holdings_combine,
+    )
+    return params1, params2, v_t, comb, var_resid, aux1
+
+
 @dataclasses.dataclass(frozen=True)
 class BackwardConfig:
     epochs_first: int = 500
@@ -123,6 +162,15 @@ class BackwardConfig:
     # (the naive reading) floors per-step MSE ~20x higher
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist state per date; resume if present
+    shuffle: bool | str = True  # per-epoch row shuffling policy (FitConfig.shuffle):
+    # True/"full" Keras parity; "blocks" zero-copy batch-order shuffle for 1M+ paths
+    fused: bool = False  # run the whole walk as ONE XLA program (first-date fit
+    # then lax.scan over the warm dates, inside a single jit) instead of a host
+    # loop with per-date dispatch/sync. Same math, same key stream; incompatible
+    # with checkpoint_dir (per-date persistence needs the host between dates)
+
+    def __post_init__(self):
+        object.__setattr__(self, "shuffle", _validate_shuffle(self.shuffle))
 
 
 @dataclasses.dataclass
@@ -146,6 +194,100 @@ class BackwardResult:
     def v0(self) -> jax.Array:
         """t=0 portfolio value per path; mean is the price estimate."""
         return self.values[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("model", "cfg"))
+def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, kas, kbs):
+    """The whole backward walk as ONE XLA program: the first (latest-time)
+    date's fit, then ``lax.scan`` over the remaining dates.
+
+    Same math and key stream as the host loop in ``backward_induction`` — the
+    dates are still strictly sequential (date t's target is date t+1's output,
+    RP.py:221) — but the host never intervenes between dates, so the per-date
+    dispatch/sync cost of the host loop (which dominates wall time on a
+    tunneled device: ~50 programs x several round trips each) collapses to a
+    single dispatch. Ledger columns come out scan-stacked ``(n_dates-1,
+    n_paths)`` and are reassembled date-ascending here.
+    """
+    dtype = model.dtype
+    q_loss = L.make_loss(cfg.quantile_loss, q=cfg.quantile)
+    mse = L.make_loss("mse")
+    metric_fns = (L.mae, L.mape)
+    n_dates = prices_all.shape[1] - 1
+    terminal = terminal.astype(dtype)
+
+    first_cfg = FitConfig(
+        n_epochs=cfg.epochs_first, batch_size=cfg.batch_size,
+        patience=cfg.patience_first, lr=cfg.lr, shuffle=cfg.shuffle,
+    )
+    warm_cfg = FitConfig(
+        n_epochs=cfg.epochs_warm, batch_size=cfg.batch_size,
+        patience=cfg.patience_warm,
+        lr=cfg.lr if cfg.lr is not None else cfg.warm_lr,
+        shuffle=cfg.shuffle,
+    )
+
+    def one_date(params1, params2, target, t, ka, kb, fit_cfg):
+        return _date_body(
+            model, cfg, params1, params2,
+            features[:, t], prices_all[:, t], prices_all[:, t + 1], target,
+            ka, kb, fit_cfg, mse, q_loss, metric_fns,
+            fit_fn=fit_core,
+            value_fn=lambda m, p, f, pr: m.value(p, f, pr),
+            outputs_fn=_date_outputs_core,
+        )
+
+    params1, params2, v_first, comb_first, var_first, aux_first = one_date(
+        params1, params2, terminal, n_dates - 1, kas[0], kbs[0], first_cfg
+    )
+    scalar = lambda aux: (
+        aux["final_loss"], aux["mae"], aux["mape"], aux["n_epochs_ran"]
+    )
+
+    if n_dates == 1:
+        values = jnp.concatenate([v_first[:, None], terminal[:, None]], axis=1)
+        stack1 = lambda x: x[:, None]
+        return (
+            values, stack1(comb_first[:, 0]), stack1(comb_first[:, 1]),
+            stack1(var_first),
+            tuple(jnp.asarray(s)[None] for s in scalar(aux_first)),
+            params1, params2,
+        )
+
+    def body(carry, xs):
+        p1, p2, target = carry
+        t, ka, kb = xs
+        p1, p2, v_t, comb, var_resid, aux1 = one_date(
+            p1, p2, target, t, ka, kb, warm_cfg
+        )
+        ys = (v_t, comb[:, 0], comb[:, 1], var_resid, *scalar(aux1))
+        return (p1, p2, v_t), ys
+
+    ts = jnp.arange(n_dates - 2, -1, -1)
+    (params1, params2, _), ys = jax.lax.scan(
+        body, (params1, params2, v_first), (ts, kas[1:], kbs[1:])
+    )
+    v_cols, phi_cols, psi_cols, var_cols, tls, tmaes, tmapes, eps = ys
+    asc = lambda cols, first_col: jnp.concatenate(
+        [jnp.flip(cols, 0).T, first_col[:, None]], axis=1
+    )
+    values = jnp.concatenate(
+        [jnp.flip(v_cols, 0).T, v_first[:, None], terminal[:, None]], axis=1
+    )
+    first_scalars = scalar(aux_first)
+    metrics = tuple(
+        jnp.concatenate([jnp.flip(col, 0), jnp.asarray(f)[None]])
+        for col, f in zip((tls, tmaes, tmapes, eps), first_scalars)
+    )
+    return (
+        values,
+        asc(phi_cols, comb_first[:, 0]),
+        asc(psi_cols, comb_first[:, 1]),
+        asc(var_cols, var_first),
+        metrics,
+        params1,
+        params2,
+    )
 
 
 def backward_induction(
@@ -173,18 +315,49 @@ def backward_induction(
     mse = L.make_loss("mse")
     metric_fns = (L.mae, L.mape)
 
-    values = jnp.zeros((n_paths, n_knots), dtype)
-    values = values.at[:, -1].set(terminal_values.astype(dtype))
-
-    phi_cols, psi_cols, var_cols = [], [], []
-    tl, tmae, tmape, eps_ran = [], [], [], []
-
     b_prices = jnp.asarray(b_prices, dtype)
     # all (Y_t, B_t) price pairs materialised once — per-date eager stacks at
     # 1M paths cost ~0.5s/date in dispatch on a tunneled device
     prices_all = jax.jit(
         lambda y, b: jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
     )(y_prices.astype(dtype), b_prices)
+
+    if cfg.fused:
+        if cfg.checkpoint_dir is not None:
+            raise ValueError(
+                "fused=True runs the whole walk device-side; per-date "
+                "checkpointing needs the host loop (fused=False)"
+            )
+        # identical key stream to the host loop below: each date consumes one
+        # (kfit, ka, kb) split in walk order
+        kas, kbs = [], []
+        for _ in range(n_dates):
+            kfit, ka, kb = jax.random.split(kfit, 3)
+            kas.append(ka)
+            kbs.append(kb)
+        # features pass through uncast, exactly like the host loop — the model
+        # casts to its dtype internally (HedgeMLP.holdings), so both walks see
+        # identical numerics
+        # seed is consumed above into the key arrays; normalise it out of the
+        # static cfg so multi-seed runs reuse one compiled walk
+        values, phi, psi, var, metrics, params1, params2 = _fused_walk(
+            model, dataclasses.replace(cfg, seed=0), params1, params2,
+            jnp.asarray(features), prices_all, terminal_values,
+            jnp.stack(kas), jnp.stack(kbs),
+        )
+        tl, tmae, tmape, eps_ran = (np.asarray(jax.device_get(m)) for m in metrics)
+        return BackwardResult(
+            values=values, phi=phi, psi=psi, var_residuals=var,
+            train_loss=tl, train_mae=tmae, train_mape=tmape,
+            epochs_ran=eps_ran.astype(np.int64),
+            params1=params1, params2=params2,
+        )
+
+    values = jnp.zeros((n_paths, n_knots), dtype)
+    values = values.at[:, -1].set(terminal_values.astype(dtype))
+
+    phi_cols, psi_cols, var_cols = [], [], []
+    tl, tmae, tmape, eps_ran = [], [], [], []
 
     # resume from the last completed date if a checkpoint exists (SURVEY.md §5:
     # the reference can only rerun by hand; here a preempted TPU job continues)
@@ -196,14 +369,16 @@ def backward_induction(
         # training policy mismatches would otherwise return stale/garbled
         # results. checkpoint_dir itself is excluded — the same directory
         # spelled differently ('ckpts' vs './ckpts') must still resume.
-        fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None)
-        # the format tag versions the on-disk state layout: a dir written by
-        # the pre-increment format (full ledgers per step) refuses cleanly here
-        # instead of failing deep in the replay with a KeyError
+        # fused is normalised out: it cannot be True here (guarded above) and
+        # does not change the math, so it must not churn the fingerprint
+        fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None, fused=False)
+        # the format tag versions the on-disk state layout AND the config
+        # field set: v3 = BackwardConfig grew shuffle/fused (r3). A dir from an
+        # older field set refuses cleanly here instead of failing in replay
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
-            "ckpt_format=increment-v2",
+            "ckpt_format=increment-v3",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
@@ -237,38 +412,15 @@ def backward_induction(
             batch_size=cfg.batch_size,
             patience=cfg.patience_first if first else cfg.patience_warm,
             lr=cfg.lr if (first or cfg.lr is not None) else cfg.warm_lr,
+            shuffle=cfg.shuffle,
         )
-        feats_t = features[:, t]
-        prices_t = prices_all[:, t]
-        prices_t1 = prices_all[:, t + 1]
-        target = values[:, t + 1]
-
-        params1, aux1 = fit(
-            params1, feats_t, prices_t1, target, ka,
-            value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
-        )
-        g_pre = jnp.zeros((), dtype)  # only read in shared mode
-        if cfg.dual_mode == "mse_only":
-            params2 = params1
-        else:
-            if cfg.dual_mode == "shared":
-                # snapshot the MSE-fit prediction before the quantile fit
-                # mutates the shared weights (reference order, RP.py:212-217)
-                g_pre = _value(model, params1, feats_t, prices_t)
-                params2 = params1
-            params2, _ = fit(
-                params2, feats_t, prices_t1, target, kb,
-                value_fn=model.value, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
-            )
-            if cfg.dual_mode == "shared":
-                params1 = params2
-
-        # values combine + holdings/VaR ledgers (RP.py:103-125, :221) — one
-        # fused program per date
-        v_t, comb, var_resid = _date_outputs(
-            model, params1, params2, feats_t, prices_t, prices_t1, target,
-            cfg.cost_of_capital, g_pre,
-            dual_mode=cfg.dual_mode, holdings_combine=cfg.holdings_combine,
+        # one date = MSE fit + dual-mode quantile fit + fused outputs program
+        # (RP.py:103-125, :221) via the shared body, with jitted pieces
+        params1, params2, v_t, comb, var_resid, aux1 = _date_body(
+            model, cfg, params1, params2,
+            features[:, t], prices_all[:, t], prices_all[:, t + 1],
+            values[:, t + 1], ka, kb, fit_cfg, mse, q_loss, metric_fns,
+            fit_fn=fit, value_fn=_value, outputs_fn=_date_outputs,
         )
         values = values.at[:, t].set(v_t)
         phi_cols.append(comb[:, 0])

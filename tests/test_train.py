@@ -1,6 +1,8 @@
 """Oracles for L4/L5: model shapes vs reference, fit convergence, early stopping,
 backward induction vs Black–Scholes (SURVEY.md §4 items 2-4)."""
 
+import dataclasses
+
 import numpy as np
 
 import jax
@@ -208,3 +210,64 @@ def test_backward_shared_mode_g_predates_quantile_fit():
     # quantile training moved the shared weights, so the stored t=0 values
     # (pure g_pre at cc=0) must differ from the post-quantile prediction
     assert float(jnp.abs(res.values[:, 0] - post).max()) > 1e-4
+
+
+def test_fused_walk_matches_host_loop():
+    # the fused (single-XLA-program) walk must reproduce the host loop exactly:
+    # same key stream, same math — only the dispatch structure differs
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=512, n_steps=4)
+    model = HedgeMLP(n_features=1)
+    for mode in ("mse_only", "separate", "shared"):
+        cfg = BackwardConfig(
+            epochs_first=40, epochs_warm=20, dual_mode=mode, batch_size=256,
+        )
+        args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
+        host = backward_induction(*args, cfg)
+        fused = backward_induction(*args, dataclasses.replace(cfg, fused=True))
+        np.testing.assert_allclose(
+            np.asarray(fused.values), np.asarray(host.values), rtol=2e-5, atol=2e-6,
+            err_msg=mode,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused.phi), np.asarray(host.phi), rtol=2e-5, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused.psi), np.asarray(host.psi), rtol=2e-5, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused.var_residuals), np.asarray(host.var_residuals),
+            rtol=2e-4, atol=2e-5,
+        )
+        np.testing.assert_allclose(fused.train_loss, host.train_loss, rtol=1e-4)
+        assert (fused.epochs_ran == host.epochs_ran).all()
+
+
+def test_fused_single_date_walk():
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=512, n_steps=1)
+    model = HedgeMLP(n_features=1)
+    cfg = BackwardConfig(epochs_first=40, dual_mode="separate", batch_size=256)
+    args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
+    host = backward_induction(*args, cfg)
+    fused = backward_induction(*args, dataclasses.replace(cfg, fused=True))
+    np.testing.assert_allclose(
+        np.asarray(fused.values), np.asarray(host.values), rtol=2e-5, atol=2e-6
+    )
+    assert fused.phi.shape == host.phi.shape == (512, 1)
+
+
+def test_blocks_shuffle_converges():
+    # "blocks" shuffle (zero-copy batch-order permutation) must still learn.
+    # batch 600 does NOT divide 2048 -> exercises the sliding tail window
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=2048, n_steps=2)
+    model = HedgeMLP(n_features=1, constrain_self_financing=True)
+    cfg = BackwardConfig(
+        epochs_first=200, epochs_warm=80, dual_mode="mse_only",
+        batch_size=600, lr=1e-3, shuffle="blocks", fused=True,
+    )
+    res = backward_induction(
+        model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0, cfg,
+        bias_init=(float(payoff.mean()) / S0, 0.0),
+    )
+    v0 = float(res.v0.mean()) * S0
+    bs, _ = bs_call(S0, K, r, sigma, T)
+    assert abs(v0 - bs) / bs < 0.15, (v0, bs)

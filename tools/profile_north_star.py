@@ -1,0 +1,170 @@
+"""Stage-level wall-clock breakdown of the north-star hedge (1M-path, 52-date
+European call). Answers VERDICT r2 weak-#1: where do the ~170s go?
+
+Stages timed with explicit block_until_ready barriers:
+  sim          - Pallas Sobol log-GBM path generation
+  prep         - payoff, bond curve, price stacking
+  fit_first    - the first (latest-date) fit: compile + run (run isolated via a
+                 second call on fresh params)
+  fits_warm    - the 51 warm-date fits + per-date outputs + host syncs
+  report       - risk analytics + CV price
+
+Usage: python tools/profile_north_star.py [n_paths_log2=20]
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+from orp_tpu.api.pipelines import _backward_cfg
+from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
+from orp_tpu.sde import TimeGrid, bond_curve, payoffs
+from orp_tpu.train.backward import _date_outputs
+from orp_tpu.train.fit import FitConfig, fit
+from orp_tpu.train import losses as L
+
+
+def main(n_log2=20):
+    jax.config.update("jax_compilation_cache_dir", str(
+        pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"))
+    n_paths = 1 << n_log2
+    euro = EuropeanConfig(constrain_self_financing=False)
+    sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 364, rebalance_every=7)
+    train = TrainConfig(
+        dual_mode="mse_only", epochs_first=120, epochs_warm=30,
+        batch_size=max(n_paths // 64, 512), lr=1e-3,
+    )
+    stamps = {}
+    t_all = time.perf_counter()
+
+    t0 = time.perf_counter()
+    grid = TimeGrid(sim.T, sim.n_steps)
+    s = gbm_log_pallas(
+        sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
+        dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
+        block_paths=min(2048, sim.n_paths),
+    )
+    s.block_until_ready()
+    stamps["sim"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, euro.r, jnp.float32)
+    payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
+    s0v = euro.s0
+    sn = s / s0v
+    features = sn[:, :, None]
+    bn = jnp.asarray(b / s0v, jnp.float32)
+    prices_all = jnp.stack(
+        [sn, jnp.broadcast_to(bn[None, :], sn.shape)], axis=-1)
+    terminal = payoff / s0v
+    e_payoff_n = float(jnp.mean(payoff)) / s0v
+    prices_all.block_until_ready()
+    stamps["prep"] = time.perf_counter() - t0
+
+    cfg = _backward_cfg(train)
+    model = HedgeMLP(n_features=1, constrain_self_financing=False)
+    key = jax.random.key(cfg.seed)
+    k1, k2, kfit = jax.random.split(key, 3)
+    params1 = model.init(k1, bias_init=(e_payoff_n, 0.0))
+    mse = L.make_loss("mse")
+    metric_fns = (L.mae, L.mape)
+
+    n_knots = sn.shape[1]
+    n_dates = n_knots - 1
+
+    # --- first date fit: compile+run, then isolate the run with fresh params
+    fit_cfg_first = FitConfig(
+        n_epochs=cfg.epochs_first, batch_size=cfg.batch_size,
+        patience=cfg.patience_first, lr=cfg.lr,
+    )
+    t = n_dates - 1
+    kfit, ka, kb = jax.random.split(kfit, 3)
+    t0 = time.perf_counter()
+    p1_first, aux1 = fit(
+        params1, features[:, t], prices_all[:, t + 1], terminal, ka,
+        value_fn=model.value, loss_fn=mse, cfg=fit_cfg_first,
+        metric_fns=metric_fns,
+    )
+    jax.block_until_ready(p1_first)
+    stamps["fit_first_cold"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    p1_warmrun, _ = fit(
+        params1, features[:, t], prices_all[:, t + 1], terminal, ka,
+        value_fn=model.value, loss_fn=mse, cfg=fit_cfg_first,
+        metric_fns=metric_fns,
+    )
+    jax.block_until_ready(p1_warmrun)
+    stamps["fit_first_run"] = time.perf_counter() - t0
+    params1 = p1_first
+
+    # first date outputs
+    t0 = time.perf_counter()
+    values_next = terminal
+    v_t, comb, var_resid = _date_outputs(
+        model, params1, params1, features[:, t], prices_all[:, t],
+        prices_all[:, t + 1], values_next, cfg.cost_of_capital,
+        jnp.zeros(()), dual_mode="mse_only", holdings_combine="single",
+    )
+    jax.block_until_ready((v_t, comb, var_resid))
+    stamps["outputs_first_cold"] = time.perf_counter() - t0
+    values_next = v_t
+
+    # --- warm dates
+    fit_cfg_warm = FitConfig(
+        n_epochs=cfg.epochs_warm, batch_size=cfg.batch_size,
+        patience=cfg.patience_warm, lr=cfg.lr,
+    )
+    fit_s = out_s = sync_s = 0.0
+    warm_cold = None
+    t_warm = time.perf_counter()
+    for step_i, t in enumerate(range(n_dates - 2, -1, -1)):
+        kfit, ka, kb = jax.random.split(kfit, 3)
+        t0 = time.perf_counter()
+        params1, aux1 = fit(
+            params1, features[:, t], prices_all[:, t + 1], values_next, ka,
+            value_fn=model.value, loss_fn=mse, cfg=fit_cfg_warm,
+            metric_fns=metric_fns,
+        )
+        jax.block_until_ready(params1)
+        dt_fit = time.perf_counter() - t0
+        if step_i == 0:
+            warm_cold = dt_fit
+        fit_s += dt_fit
+        t0 = time.perf_counter()
+        v_t, comb, var_resid = _date_outputs(
+            model, params1, params1, features[:, t], prices_all[:, t],
+            prices_all[:, t + 1], values_next, cfg.cost_of_capital,
+            jnp.zeros(()), dual_mode="mse_only", holdings_combine="single",
+        )
+        jax.block_until_ready((v_t, comb, var_resid))
+        out_s += time.perf_counter() - t0
+        values_next = v_t
+        t0 = time.perf_counter()
+        _ = (float(aux1["final_loss"]), float(aux1["mae"]), float(aux1["mape"]),
+             int(aux1["n_epochs_ran"]))
+        sync_s += time.perf_counter() - t0
+    stamps["fits_warm_total"] = time.perf_counter() - t_warm
+    stamps["warm_first_cold"] = warm_cold
+    stamps["warm_fit_sum"] = fit_s
+    stamps["warm_outputs_sum"] = out_s
+    stamps["warm_sync_sum"] = sync_s
+    stamps["warm_fit_each_warmed"] = (fit_s - warm_cold) / max(n_dates - 2, 1)
+
+    stamps["total"] = time.perf_counter() - t_all
+    stamps = {k: round(v, 3) for k, v in stamps.items()}
+    stamps["n_paths"] = n_paths
+    stamps["platform"] = jax.devices()[0].platform
+    print(json.dumps(stamps))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 20)
