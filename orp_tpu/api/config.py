@@ -118,6 +118,11 @@ class TrainConfig:
         from orp_tpu.train.fit import validate_shuffle
 
         object.__setattr__(self, "shuffle", validate_shuffle(self.shuffle))
+        if self.fused and self.checkpoint_dir is not None:
+            raise ValueError(
+                "fused=True runs the whole walk device-side; per-date "
+                "checkpointing needs the host loop (fused=False)"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

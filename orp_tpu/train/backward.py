@@ -60,6 +60,26 @@ def _value(model, params, feats, prices):
     return model.value(params, feats, prices)
 
 
+@functools.lru_cache(maxsize=None)
+def _model_value_fn(model: HedgeMLP):
+    """The model's ``value`` bound method, interned per model *value*.
+
+    Bound methods of equal-but-distinct frozen-dataclass instances compare
+    UNEQUAL (CPython method eq is identity-based on ``__self__``), so passing
+    ``model.value`` straight into ``fit``'s static ``value_fn`` silently
+    recompiled every fit program on every pipeline run (one fresh HedgeMLP per
+    run). Interning by the hashable model value restores jit cache hits.
+    """
+    return model.value
+
+
+@jax.jit
+def _stack_prices(y, b):
+    # module-level jit (not an inline lambda): a fresh jit object per walk
+    # would recompile this stack on every pipeline run
+    return jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
+
+
 def _date_outputs_core(
     model, params1, params2, feats_t, prices_t, prices_t1, target,
     cost_of_capital, g_pre, *, dual_mode, holdings_combine,
@@ -115,9 +135,10 @@ def _date_body(
     then the per-date outputs. The ONE definition of the date body — the host
     loop passes the jitted pieces (``fit``/``_value``/``_date_outputs``), the
     fused walk the traceable cores; only the dispatch structure differs."""
+    vfn = _model_value_fn(model)  # interned: stable static-arg identity
     params1, aux1 = fit_fn(
         params1, feats_t, prices_t1, target, ka,
-        value_fn=model.value, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
+        value_fn=vfn, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
     )
     g_pre = jnp.zeros((), model.dtype)  # only read in shared mode
     if cfg.dual_mode == "mse_only":
@@ -130,7 +151,7 @@ def _date_body(
             params2 = params1
         params2, _ = fit_fn(
             params2, feats_t, prices_t1, target, kb,
-            value_fn=model.value, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
+            value_fn=vfn, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
         )
         if cfg.dual_mode == "shared":
             params1 = params2
@@ -171,6 +192,11 @@ class BackwardConfig:
 
     def __post_init__(self):
         object.__setattr__(self, "shuffle", _validate_shuffle(self.shuffle))
+        if self.fused and self.checkpoint_dir is not None:
+            raise ValueError(
+                "fused=True runs the whole walk device-side; per-date "
+                "checkpointing needs the host loop (fused=False)"
+            )
 
 
 @dataclasses.dataclass
@@ -318,16 +344,10 @@ def backward_induction(
     b_prices = jnp.asarray(b_prices, dtype)
     # all (Y_t, B_t) price pairs materialised once — per-date eager stacks at
     # 1M paths cost ~0.5s/date in dispatch on a tunneled device
-    prices_all = jax.jit(
-        lambda y, b: jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
-    )(y_prices.astype(dtype), b_prices)
+    prices_all = _stack_prices(y_prices.astype(dtype), b_prices)
 
     if cfg.fused:
-        if cfg.checkpoint_dir is not None:
-            raise ValueError(
-                "fused=True runs the whole walk device-side; per-date "
-                "checkpointing needs the host loop (fused=False)"
-            )
+        # (fused + checkpoint_dir is rejected at BackwardConfig construction)
         # identical key stream to the host loop below: each date consumes one
         # (kfit, ka, kb) split in walk order
         kas, kbs = [], []

@@ -14,6 +14,8 @@ global ``pmean``-style reduction that XLA lowers onto ICI automatically.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -53,8 +55,15 @@ def smoothed_pinball(
     return jnp.mean(0.5 * rho + (q - 0.5) * e)
 
 
+@functools.lru_cache(maxsize=None)
 def make_loss(name: str, q: float = 0.99, delta: float = 1e-3):
-    """Loss factory: 'mse' | 'pinball' | 'smoothed_pinball'."""
+    """Loss factory: 'mse' | 'pinball' | 'smoothed_pinball'.
+
+    Cached so repeated calls return the SAME function object: the loss is a
+    static jit argument of ``fit`` — a fresh closure per walk would silently
+    retrace/recompile every fit program on every pipeline run (e.g. once per
+    sigma in ``sigma_sweep``).
+    """
     if name == "mse":
         return mse
     if name == "pinball":

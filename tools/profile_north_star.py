@@ -1,13 +1,13 @@
 """Stage-level wall-clock breakdown of the north-star hedge (1M-path, 52-date
-European call). Answers VERDICT r2 weak-#1: where do the ~170s go?
+European call): where do the seconds go?
 
-Stages timed with explicit block_until_ready barriers:
-  sim          - Pallas Sobol log-GBM path generation
-  prep         - payoff, bond curve, price stacking
-  fit_first    - the first (latest-date) fit: compile + run (run isolated via a
-                 second call on fresh params)
-  fits_warm    - the 51 warm-date fits + per-date outputs + host syncs
-  report       - risk analytics + CV price
+Profiles BOTH walk variants:
+  - the unfused host-loop baseline (per-date dispatch/sync — the r2 code path
+    whose 172.8s BENCH_r02 record this explains), staged with explicit
+    block_until_ready barriers: sim / prep / first fit cold+run / warm fits
+    (fit vs outputs vs host syncs);
+  - the fused single-XLA-program walk with "blocks" shuffle — the path
+    benchmarks/north_star.py actually runs now — cold (compile+run) and warm.
 
 Usage: python tools/profile_north_star.py [n_paths_log2=20]
 """
@@ -159,7 +159,26 @@ def main(n_log2=20):
     stamps["warm_sync_sum"] = sync_s
     stamps["warm_fit_each_warmed"] = (fit_s - warm_cold) / max(n_dates - 2, 1)
 
-    stamps["total"] = time.perf_counter() - t_all
+    stamps["host_walk_total"] = time.perf_counter() - t_all
+
+    # --- the fused walk (what benchmarks/north_star.py runs): cold vs warm
+    from orp_tpu.train.backward import backward_induction
+    import dataclasses
+
+    fused_cfg = dataclasses.replace(
+        _backward_cfg(train), fused=True, shuffle="blocks"
+    )
+    model_f = HedgeMLP(n_features=1, constrain_self_financing=False)
+    args = (model_f, features, sn, bn, terminal)
+    t0 = time.perf_counter()
+    res = backward_induction(*args, fused_cfg, bias_init=(e_payoff_n, 0.0))
+    jax.block_until_ready(res.values)
+    stamps["fused_walk_cold"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = backward_induction(*args, fused_cfg, bias_init=(e_payoff_n, 0.0))
+    jax.block_until_ready(res.values)
+    stamps["fused_walk_warm"] = time.perf_counter() - t0
+
     stamps = {k: round(v, 3) for k, v in stamps.items()}
     stamps["n_paths"] = n_paths
     stamps["platform"] = jax.devices()[0].platform
