@@ -120,28 +120,31 @@ def config_4_heston():
 
 
 def config_5_basket(n_paths=1 << 20):
-    """5-asset correlated-GBM basket call at 1M paths, path-sharded mesh."""
-    from orp_tpu.parallel import make_mesh, path_indices
-    from orp_tpu.sde import TimeGrid, payoffs, simulate_gbm_basket
+    """5-asset correlated-GBM basket-call HEDGE at 1M paths: the trained
+    (n_features=5) net hedging with (basket, bond), CV price vs the
+    moment-matched-lognormal oracle (orp_tpu/utils/basket.py)."""
+    from orp_tpu.api import BasketConfig, SimConfig, TrainConfig, basket_hedge
+    from orp_tpu.parallel import make_mesh
 
     mesh = make_mesh() if len(__import__("jax").devices()) > 1 else None
-    grid = TimeGrid(1.0, 52)
-    A = 5
-    corr = np.full((A, A), 0.3)
-    np.fill_diagonal(corr, 1.0)
-    s = simulate_gbm_basket(
-        path_indices(n_paths, mesh), grid,
-        s0=jnp.full(A, 100.0), drift=jnp.full(A, 0.08),
-        sigma=jnp.asarray([0.1, 0.12, 0.15, 0.18, 0.2]), corr=jnp.asarray(corr),
-        seed=1235, store_every=52,
+    basket = BasketConfig()
+    res = basket_hedge(
+        basket,
+        SimConfig(n_paths=n_paths, T=1.0, dt=1 / 52, rebalance_every=1),
+        TrainConfig(
+            batch_size=max(n_paths // 64, 512), fused=mesh is None,
+            shuffle="blocks", **FAST,
+        ),
+        mesh=mesh,
     )
-    w = jnp.full(A, 1.0 / A)
-    payoff = payoffs.basket_call(s[:, -1], w, 100.0)
-    price = float(payoff.mean()) * exp(-0.08)
+    r = res.report
     return {
-        "config": f"basket5_call_{n_paths // 1000}k",
-        "price_qmc": round(price, 4),
-        "mean_basket_T": round(float((s[:, -1] @ w).mean()), 4),
+        "config": f"basket5_call_hedge_{n_paths // 1000}k",
+        "v0_cv": round(r.v0_cv, 4),
+        "oracle_mm": round(r.oracle_mm, 4),
+        "mm_diff_bp": round((r.v0_cv - r.oracle_mm) / r.oracle_mm * 1e4, 2),
+        "cv_std": round(r.cv_std, 4),
+        "v0_plain": round(r.v0_plain, 4),
     }
 
 

@@ -2,6 +2,7 @@
 
 from orp_tpu.api.config import (
     ActuarialConfig,
+    BasketConfig,
     EuropeanConfig,
     HedgeRunConfig,
     HestonConfig,
@@ -11,6 +12,7 @@ from orp_tpu.api.config import (
     TrainConfig,
 )
 from orp_tpu.api.pipelines import (
+    basket_hedge,
     european_hedge,
     heston_hedge,
     pension_hedge,
@@ -21,6 +23,7 @@ from orp_tpu.api.pipelines import (
 
 __all__ = [
     "ActuarialConfig",
+    "BasketConfig",
     "EuropeanConfig",
     "HedgeRunConfig",
     "HestonConfig",
@@ -28,6 +31,7 @@ __all__ = [
     "SimConfig",
     "StochVolConfig",
     "TrainConfig",
+    "basket_hedge",
     "european_hedge",
     "heston_hedge",
     "pension_hedge",

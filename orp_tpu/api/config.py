@@ -155,6 +155,48 @@ class HestonConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BasketConfig:
+    """A-asset correlated-GBM basket call (BASELINE.json config 5 — no
+    reference analogue; the multi-asset extension of the European pipeline).
+    Tuples keep the config hashable for jit static use."""
+
+    s0: tuple = (100.0, 100.0, 100.0, 100.0, 100.0)
+    weights: tuple = (0.2, 0.2, 0.2, 0.2, 0.2)
+    strike: float = 100.0
+    r: float = 0.08
+    sigmas: tuple = (0.1, 0.12, 0.15, 0.18, 0.2)
+    rho: float = 0.3  # uniform pairwise correlation
+
+    def __post_init__(self):
+        a = len(self.s0)
+        if not (len(self.weights) == len(self.sigmas) == a):
+            raise ValueError(
+                f"s0/weights/sigmas lengths differ: {a}/"
+                f"{len(self.weights)}/{len(self.sigmas)}"
+            )
+        # equicorrelation is PSD on [-1/(A-1), 1], but the ENDPOINTS are
+        # singular — jnp.linalg.cholesky returns silent NaNs there, so the
+        # simulator config demands strict definiteness. (The analytic oracle
+        # basket_call_mm has no such restriction: rho=1 is its exact-BS
+        # degeneracy, tested directly against the matrix, not this config.)
+        lo = -1.0 / (a - 1) if a > 1 else -1.0
+        if a > 1 and not (lo < self.rho < 1.0):
+            raise ValueError(
+                f"rho={self.rho} outside the positive-definite range "
+                f"({lo:.3f}, 1) — the endpoints are singular and Cholesky "
+                "would yield NaN paths"
+            )
+
+    def corr(self):
+        import numpy as np
+
+        a = len(self.s0)
+        m = np.full((a, a), self.rho)
+        np.fill_diagonal(m, 1.0)
+        return m
+
+
+@dataclasses.dataclass(frozen=True)
 class HedgeRunConfig:
     """Top-level run config: market + actuarial + optional SV + sim + train."""
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from orp_tpu.api.config import (
     ActuarialConfig,
+    BasketConfig,
     EuropeanConfig,
     HedgeRunConfig,
     HestonConfig,
@@ -46,6 +47,7 @@ from orp_tpu.sde import (
     TimeGrid,
     bond_curve,
     payoffs,
+    simulate_gbm_basket,
     simulate_gbm_log,
     simulate_heston_log,
     simulate_pension,
@@ -65,6 +67,14 @@ def _check_pallas(sim: SimConfig, mesh, name: str) -> None:
         raise ValueError(
             f"{name}: engine='pallas' generates Owen-scrambled float32 paths only; "
             f"got scramble={sim.scramble!r} dtype={sim.dtype!r}"
+        )
+
+
+def _check_quantile_method(quantile_method: str) -> None:
+    """Fail before the sim/training spend, not inside build_report at the end."""
+    if quantile_method not in ("sort", "histogram"):
+        raise ValueError(
+            f"quantile_method={quantile_method!r}: expected 'sort' or 'histogram'"
         )
 
 
@@ -137,6 +147,7 @@ def european_hedge(
     train: TrainConfig = TrainConfig(dual_mode="mse_only"),
     *,
     mesh=None,
+    quantile_method: str = "sort",
 ) -> PipelineResult:
     """Weekly-rebalanced European option hedge (``European Options.ipynb``).
 
@@ -146,6 +157,7 @@ def european_hedge(
     364 daily steps -> exactly 52 weekly rebalance dates (the reference's
     [::7] slice of 366 knots silently drops day 365; see module docstring).
     """
+    _check_quantile_method(quantile_method)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     if sim.engine == "pallas":
@@ -191,6 +203,7 @@ def european_hedge(
         times=times,
         adjustment_factor=s0,
         holdings_adjustment=1.0,
+        quantile_method=quantile_method,
     )
     _attach_cv_price(report, res, s, payoff, euro.r, times)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
@@ -202,12 +215,14 @@ def heston_hedge(
     train: TrainConfig = TrainConfig(dual_mode="mse_only"),
     *,
     mesh=None,
+    quantile_method: str = "sort",
 ) -> PipelineResult:
     """European hedge under risk-neutral Heston stochastic vol (BASELINE.json
     config 4). The hedge net sees features ``(S_t/S0, v_t)`` — the variance
     state is observable to the hedger, unlike the reference's SV pension where
     only ``(Y, N, lambda)`` feed the net (RP.py:300s). Reports include the
     unbiased CV price (discounted S is still a Q-martingale under Heston)."""
+    _check_quantile_method(quantile_method)
     h = heston or HestonConfig()
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
@@ -244,9 +259,75 @@ def heston_hedge(
     report = build_report(
         res, terminal_payoff=payoff / s0, r=h.r, times=times,
         adjustment_factor=s0, holdings_adjustment=1.0,
+        quantile_method=quantile_method,
     )
     _attach_cv_price(report, res, s, payoff, h.r, times)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
+
+
+def basket_hedge(
+    basket: BasketConfig = BasketConfig(),
+    sim: SimConfig = SimConfig(n_paths=1 << 17, T=1.0, dt=1 / 52, rebalance_every=1),
+    train: TrainConfig = TrainConfig(dual_mode="mse_only"),
+    *,
+    mesh=None,
+    quantile_method: str = "sort",
+) -> PipelineResult:
+    """A-asset basket-call hedge (BASELINE.json config 5; no reference
+    analogue — the multi-asset extension of ``European Options.ipynb``).
+
+    The net sees all A normalised prices as features and hedges with the
+    tradeable basket itself plus the bond: ``V = phi * B_t + psi * bond`` where
+    ``B_t = sum_i w_i S_i(t)``. Discounted ``B_t`` is a Q-martingale, so the
+    control-variate price stays unbiased; the analytic comparison line is the
+    moment-matched lognormal oracle (``orp_tpu.utils.basket.basket_call_mm``),
+    stored on the report as ``oracle_mm``. Scan engine only (the Pallas kernels
+    cover the single-asset systems)."""
+    _check_quantile_method(quantile_method)
+    if sim.engine == "pallas":
+        raise ValueError("basket_hedge: engine='pallas' not available; use 'scan'")
+    dtype = jnp.dtype(sim.dtype)
+    grid = TimeGrid(sim.T, sim.n_steps)
+    A = len(basket.s0)
+    idx = path_indices(sim.n_paths, mesh)
+    s = simulate_gbm_basket(
+        idx, grid, s0=jnp.asarray(basket.s0), drift=jnp.full(A, basket.r),
+        sigma=jnp.asarray(basket.sigmas), corr=jnp.asarray(basket.corr()),
+        seed=sim.seed_fund, scramble=sim.scramble,
+        store_every=sim.rebalance_every, dtype=dtype,
+    )
+    w = jnp.asarray(basket.weights, dtype)
+    bkt = s @ w  # (n, knots) tradeable basket price
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, basket.r, dtype)
+    payoff = payoffs.basket_call(s[:, -1], w, basket.strike)
+
+    norm = basket.strike  # normalise all values/prices to strike units
+    model = HedgeMLP(n_features=A)
+    e_payoff_n = float(jnp.mean(payoff)) / norm
+    res = backward_induction(
+        model,
+        s / jnp.asarray(basket.s0, dtype),  # (n, knots, A) per-asset moneyness
+        bkt / norm,
+        b / norm,
+        payoff / norm,
+        _backward_cfg(train),
+        bias_init=(e_payoff_n, 0.0),
+    )
+    times = np.asarray(coarse.times())
+    report = build_report(
+        res, terminal_payoff=payoff / norm, r=basket.r, times=times,
+        adjustment_factor=norm, holdings_adjustment=1.0,
+        quantile_method=quantile_method,
+    )
+    _attach_cv_price(report, res, bkt, payoff, basket.r, times)
+    from orp_tpu.utils.basket import basket_call_mm
+
+    report.oracle_mm = basket_call_mm(
+        basket.s0, basket.weights, basket.strike, basket.r,
+        basket.sigmas, basket.corr(), sim.T,
+    )[0]
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm)
 
 
 # ---------------------------------------------------------------------------
@@ -254,7 +335,9 @@ def heston_hedge(
 # ---------------------------------------------------------------------------
 
 
-def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> PipelineResult:
+def pension_hedge(
+    cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None, quantile_method: str = "sort"
+) -> PipelineResult:
     """Dynamic pension-liability hedge (``Replicating_Portfolio.py:29-235``; SV
     variant per ``:237-459`` when ``cfg.sv`` is set).
 
@@ -263,6 +346,7 @@ def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> Pipel
     the reported phi/psi/V0 are scaled by ``ADJUSTMENT_FACTOR = N0 * premium``
     (RP.py:46, :230).
     """
+    _check_quantile_method(quantile_method)
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
@@ -322,6 +406,7 @@ def pension_hedge(cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None) -> Pipel
         r=m.r,
         times=times,
         adjustment_factor=adjustment,
+        quantile_method=quantile_method,
     )
     return PipelineResult(
         report=report, backward=res, times=times, adjustment_factor=adjustment

@@ -12,6 +12,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 (the corrected-SV companion; no notebook analogue — the
                 reference's SV lives inside ``Replicating_Portfolio_SV``)
 - ``sweep``     sigma sweep             (Multi Time Step.ipynb#29-30)
+- ``basket``    multi-asset basket-call hedge vs the moment-matched-lognormal
+                oracle (BASELINE.json config 5; no reference analogue)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 """
 
@@ -47,6 +49,14 @@ def _add_train_flags(p):
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 
+def _add_quantile_flag(p):
+    # only on commands whose output carries VaR/fan quantiles (NOT sweep,
+    # which reports phi/psi rows only — a flag there would be silently ignored)
+    p.add_argument("--quantile-method", choices=["sort", "histogram"], default="sort",
+                   help="VaR/fan quantile estimator: exact sharded sort, or the "
+                        "two-pass histogram (O(bins) comms; for 1M+ paths)")
+
+
 def _emit(args, report, extra=None):
     if args.json:
         out = {
@@ -80,6 +90,7 @@ def cmd_euro(args):
             rebalance_every=args.rebalance_every, engine=args.engine,
         ),
         _train_cfg(args, "mse_only"),
+        quantile_method=args.quantile_method,
     )
     _emit(args, res.report)
 
@@ -99,6 +110,7 @@ def cmd_heston(args):
             rebalance_every=args.rebalance_every, engine=args.engine,
         ),
         _train_cfg(args, "mse_only"),
+        quantile_method=args.quantile_method,
     )
     pricer = heston_call if h.option_type == "call" else heston_put
     oracle = pricer(h.s0, h.strike, h.r, args.T, v0=h.v0, kappa=h.kappa,
@@ -128,7 +140,7 @@ def cmd_pension(args):
         ),
         train=_train_cfg(args, "separate"),
     )
-    res = pension_hedge(cfg)
+    res = pension_hedge(cfg, quantile_method=args.quantile_method)
     _emit(args, res.report)
 
 
@@ -140,7 +152,8 @@ def cmd_sweep(args):
         HedgeRunConfig(
             sim=SimConfig(
                 n_paths=args.paths, T=args.T, dt=args.T / args.steps,
-                rebalance_every=args.rebalance_every,
+                rebalance_every=args.rebalance_every, engine=args.engine,
+                binomial_mode="normal" if args.engine == "pallas" else "exact",
             ),
             train=_train_cfg(args, "separate"),
         ),
@@ -151,6 +164,34 @@ def cmd_sweep(args):
         print(f"{'sigma':>8} {'phi0':>14} {'psi0':>14} {'total':>14}")
         for r in rows:
             print(f"{r['sigma']:8.2f} {r['phi']:14,.0f} {r['psi']:14,.0f} {r['total']:14,.0f}")
+
+
+def cmd_basket(args):
+    from orp_tpu.api import BasketConfig, SimConfig, basket_hedge
+
+    res = basket_hedge(
+        BasketConfig(
+            sigmas=tuple(float(x) for x in args.sigmas.split(",")),
+            s0=tuple(float(x) for x in args.s0.split(",")),
+            weights=tuple(float(x) for x in args.weights.split(",")),
+            strike=args.strike, r=args.r, rho=args.rho,
+        ),
+        SimConfig(
+            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+            rebalance_every=args.rebalance_every,
+        ),
+        _train_cfg(args, "mse_only"),
+        quantile_method=args.quantile_method,
+    )
+    rep = res.report
+    extra = {
+        "oracle_mm": rep.oracle_mm,
+        "mm_diff_bp": (rep.v0_cv - rep.oracle_mm) / rep.oracle_mm * 1e4,
+    }
+    _emit(args, rep, extra=extra)
+    if not args.json:
+        print(f"mm-lognormal oracle = {rep.oracle_mm:,.4f}  "
+              f"(v0_cv off by {extra['mm_diff_bp']:+.1f} bp, approx-method error included)")
 
 
 def cmd_calibrate(args):
@@ -195,6 +236,7 @@ def main(argv=None):
     pe.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(pe)
+    _add_quantile_flag(pe)
     pe.set_defaults(fn=cmd_euro)
 
     ph = sub.add_parser("heston", help="European hedge under Heston stochastic vol")
@@ -214,6 +256,7 @@ def main(argv=None):
     ph.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(ph)
+    _add_quantile_flag(ph)
     ph.set_defaults(fn=cmd_heston)
 
     pp = sub.add_parser("pension", help="pension-liability hedge")
@@ -231,6 +274,7 @@ def main(argv=None):
                     help="path simulator: XLA scan (exact binomial) or fused "
                          "Pallas kernel (normal-approx binomial)")
     _add_train_flags(pp)
+    _add_quantile_flag(pp)
     pp.set_defaults(fn=cmd_pension)
 
     ps = sub.add_parser("sweep", help="sigma sweep")
@@ -239,8 +283,26 @@ def main(argv=None):
     ps.add_argument("--steps", type=int, default=1000)
     ps.add_argument("--rebalance-every", type=int, default=25)
     ps.add_argument("--T", type=float, default=10.0)
+    ps.add_argument("--engine", choices=["scan", "pallas"], default="scan",
+                    help="path simulator: XLA scan (exact binomial) or fused "
+                         "Pallas kernel (normal-approx binomial)")
     _add_train_flags(ps)
     ps.set_defaults(fn=cmd_sweep)
+
+    pb = sub.add_parser("basket", help="multi-asset basket-call hedge")
+    pb.add_argument("--paths", type=int, default=1 << 17)
+    pb.add_argument("--steps", type=int, default=52)
+    pb.add_argument("--rebalance-every", type=int, default=1)
+    pb.add_argument("--T", type=float, default=1.0)
+    pb.add_argument("--s0", default="100,100,100,100,100")
+    pb.add_argument("--weights", default="0.2,0.2,0.2,0.2,0.2")
+    pb.add_argument("--sigmas", default="0.1,0.12,0.15,0.18,0.2")
+    pb.add_argument("--strike", type=float, default=100.0)
+    pb.add_argument("--r", type=float, default=0.08)
+    pb.add_argument("--rho", type=float, default=0.3)
+    _add_train_flags(pb)
+    _add_quantile_flag(pb)
+    pb.set_defaults(fn=cmd_basket)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
     pc.add_argument("csv")

@@ -155,6 +155,8 @@ class HedgeReport:
     v0_cv: float | None = None
     cv_std: float | None = None  # per-path std of the CV estimator
     times: np.ndarray | None = None  # rebalance-knot times (n_dates+1,)
+    oracle_mm: float | None = None  # moment-matched-lognormal basket oracle
+    # (basket_hedge only; orp_tpu/utils/basket.py)
 
     def summary(self) -> str:
         qs = ", ".join(

@@ -1,0 +1,55 @@
+"""Approximate analytic oracle for arithmetic basket calls.
+
+Moment-matched lognormal ("Levy") approximation: the arithmetic basket
+``B_T = sum_i w_i S_i(T)`` of correlated GBMs has no closed-form law, but its
+first two moments do. Matching them to a lognormal gives a Black-formula price
+that is exact in both degenerate limits —
+
+- A = 1: the basket IS a single GBM -> Black-Scholes exactly;
+- rho = 1 with equal sigmas: all assets are comonotone copies -> the basket is
+  a single lognormal on the basket spot -> Black-Scholes exactly —
+
+which makes those limits *executable oracles* for the implementation (see
+``tests/test_basket.py``), while at moderate correlations the approximation is
+good to ~10bp for typical equity-basket parameters (the QMC estimator in
+``benchmarks/baseline_configs.py`` config 5 is compared against it).
+
+Reference anchor: the reference has no basket machinery at all — this oracle
+backs BASELINE.json config 5 (5-asset basket-call hedge), the TPU build's
+multi-asset extension of ``European Options.ipynb``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from orp_tpu.utils.black_scholes import _N
+
+
+def basket_call_mm(
+    s0, weights, strike: float, r: float, sigmas, corr, T: float
+) -> tuple[float, float]:
+    """Moment-matched lognormal price of a European arithmetic basket call.
+
+    Returns ``(price, effective_vol)`` where ``effective_vol`` is the matched
+    lognormal's annualised vol ``sqrt(ln(m2/m1^2)/T)``.
+    """
+    s0 = np.asarray(s0, np.float64)
+    w = np.asarray(weights, np.float64)
+    sig = np.asarray(sigmas, np.float64)
+    rho = np.asarray(corr, np.float64)
+
+    fwd = w * s0 * np.exp(r * T)                     # per-asset forwards
+    m1 = fwd.sum()
+    # E[B^2] = sum_ij w_i w_j S_i0 S_j0 exp(2rT + rho_ij sig_i sig_j T)
+    cov = rho * np.outer(sig, sig) * T
+    m2 = float(np.outer(fwd, fwd).ravel() @ np.exp(cov).ravel())
+
+    v2 = np.log(m2 / (m1 * m1))                      # matched total variance
+    if v2 <= 0:  # numerically degenerate (zero vol)
+        return float(np.exp(-r * T) * max(m1 - strike, 0.0)), 0.0
+    v = np.sqrt(v2)
+    d1 = (np.log(m1 / strike) + 0.5 * v2) / v
+    d2 = d1 - v
+    price = float(np.exp(-r * T) * (m1 * _N(float(d1)) - strike * _N(float(d2))))
+    return price, float(v / np.sqrt(T))
