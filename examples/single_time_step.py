@@ -14,9 +14,13 @@ def main():
     n_steps = 120  # monthly over 10y (Single#5: dt=1/12)
     cfg = HedgeRunConfig(
         sim=SimConfig(n_paths=8192, T=10.0, dt=10.0 / n_steps, rebalance_every=n_steps),
-        # one date -> only the from-scratch 500-epoch phase runs; the reference
-        # combines with cost_of_capital = 0.1*dt there (Single#16)
-        train=TrainConfig(cost_of_capital=0.1 * (10.0 / n_steps)),
+        # one date -> only the from-scratch 500-epoch phase runs. The
+        # reference's `cost_of_capital = 0.1*dt` (Single#16) executes AFTER the
+        # grid reduction rescales dt to the 10y interval (Single#11:
+        # `dt = dt*reduction`), so i = 0.1*10 = 1.0 — the combine collapses to
+        # the PURE quantile model (V0 = h, phi = phi2), which is what the
+        # recorded 1,076,847 / 819,539 / 257,308 are
+        train=TrainConfig(cost_of_capital=1.0),
     )
     res = pension_hedge(cfg)
     print(res.report.summary())

@@ -471,7 +471,15 @@ def _cfg_from_params(params: dict, sv_c: float | None = None) -> HedgeRunConfig:
     return HedgeRunConfig(
         market=MarketConfig(
             y0=float(params["Y"]), mu=float(params["mu"]),
-            r=float(params["r"]), sigma=float(params["sigma"]),
+            r=float(params["r"]),
+            # the reference's SV dict (Multi#32) carries NO 'sigma' key at all
+            # (the constant vol is unused under SV) — default it there so that
+            # exact dict round-trips. The constant-vol path keeps the KeyError:
+            # sigma is load-bearing and a silent default would price wrong.
+            sigma=float(
+                params.get("sigma", MarketConfig.sigma) if sv_c is not None
+                else params["sigma"]
+            ),
         ),
         actuarial=ActuarialConfig(
             n0=int(params["N"]), premium=float(params["P"]),

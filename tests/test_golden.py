@@ -69,10 +69,17 @@ def test_golden_euro_flagship_hedge():
     assert abs(res.phi0 - 0.10456) < 0.02, res.phi0
     assert abs(res.psi0 - 0.89544) < 0.02, res.psi0
     assert abs(res.report.discounted_payoff - 10.479) / 10.479 < 0.02
-    # Euro#16(out): overall VaR 99%: 4.05 EUR, 99.5%: 4.59 EUR (x S0 units)
+    # Tightened r3 pins (VERDICT r2 weak-4) from the same run:
+    # Euro#16(out) overall VaR99=4.05 (99.5%: 4.59); Euro#15(out) terminal
+    # residual mean -0.1675 / std 1.7504 (EUR, x S0). Measured r3: var99=3.91
+    # (-3.3%), std=1.81 (+3.4%), mean=-0.13 — spread is train-seed + backend
+    # noise on tail statistics, so the bands are +-25% / +-15% / +-0.15 abs.
     v99, v995 = res.report.var_overall[1], res.report.var_overall[2]
-    assert 1.5 < v99 < 8.0, v99
+    assert 4.05 * 0.75 < v99 < 4.05 * 1.25, v99
     assert v995 > v99
+    resid_T = np.asarray(res.backward.var_residuals[:, -1]) * 100.0
+    assert abs(resid_T.std() - 1.7504) / 1.7504 < 0.15, resid_T.std()
+    assert abs(resid_T.mean() - (-0.1675)) < 0.15, resid_T.mean()
 
 
 def test_golden_pension_multi_step_shared_mode():
@@ -97,3 +104,69 @@ def test_golden_pension_multi_step_shared_mode():
     assert abs((res.phi0 + res.psi0) - res.v0) / res.v0 < 0.02
     assert 600_000 < res.phi0 < 780_000, res.phi0
     assert 200_000 < res.psi0 < 380_000, res.psi0
+
+
+def test_golden_pension_single_step():
+    # Single#23-24(out): phi0=819,539 / psi0=257,308, V0=1,076,846.8 at 8,192
+    # paths, ONE 10y step, both models from scratch. Single#16's
+    # cost_of_capital=0.1*dt executes AFTER Single#11 rescales dt to 10.0, so
+    # i=1.0 and the goldens are the PURE quantile model's allocation.
+    # Measured r3: V0 +0.22%, phi0 -1.0%, psi0 +4.2% (PARITY.md). Config is
+    # shared with the measurement battery (tools/parity_runs.py) so tool and
+    # pin can never drift apart.
+    from orp_tpu.api import pension_hedge
+    from tools.parity_runs import single_step_cfg
+
+    res = pension_hedge(single_step_cfg())
+    assert abs(res.v0 - 1_076_846.8) / 1_076_846.8 < 0.02, res.v0
+    assert abs(res.phi0 - 819_539) / 819_539 < 0.05, res.phi0
+    assert abs(res.psi0 - 257_308) / 257_308 < 0.20, res.psi0
+
+
+def test_golden_sigma_sweep_values():
+    # Multi#30(out) totals at the as-executed params (mu=0.09464 — cell #9
+    # rebound mu before #28 ran): sigma=.15 -> 967,728.6; sigma=.30 ->
+    # 1,222,431. Measured r3: -0.6% and -6.7% (PARITY.md) — the high-sigma
+    # quantile uplift is the most seed-sensitive statistic in the repo, hence
+    # the asymmetric bands.
+    from orp_tpu.api import replicating_portfolio
+    from tools.parity_runs import MULTI28_PARAMS, REF_SHARED
+
+    train = REF_SHARED
+    phi15, psi15 = replicating_portfolio(
+        dict(MULTI28_PARAMS, sigma=0.15), train=train)
+    assert abs((phi15 + psi15) - 967_728.6) / 967_728.6 < 0.03, phi15 + psi15
+    phi30, psi30 = replicating_portfolio(
+        dict(MULTI28_PARAMS, sigma=0.30), train=train)
+    assert abs((phi30 + psi30) - 1_222_431) / 1_222_431 < 0.10, phi30 + psi30
+    assert phi30 + psi30 > phi15 + psi15  # vol monotonicity (Multi#30 table)
+
+
+def test_golden_sv_pension():
+    # Multi#32(out): Replicating_Portfolio_SV -> phi0=626,123 / psi0=371,854
+    # (total 997,977). The reference dict passes 'c' twice (0.01583 then
+    # 0.075; Python keeps the later) AND RP.py:249/:257 overwrite it again —
+    # either way its CIR vol-of-vol ran at 0.075, reproduced via sv_c=0.075.
+    # Measured r3: total +0.2% (PARITY.md); the phi/psi split is the usual
+    # seed-sensitive OLS split, so only the total is pinned.
+    from orp_tpu.api import replicating_portfolio_sv
+    from tools.parity_runs import REF_SHARED, SV_PARAMS
+
+    phi, psi = replicating_portfolio_sv(SV_PARAMS, sv_c=0.075, train=REF_SHARED)
+    assert abs((phi + psi) - 997_977) / 997_977 < 0.03, phi + psi
+
+
+def test_golden_pension_three_seed_mean():
+    # VERDICT r2 weak-3: a 3-seed MEAN pin catches drift a single wide band
+    # cannot. Multi#26(out) single-seed reference: V0=981,038. Measured r3
+    # means: -1.2% (CPU, sim+train seeds varied); r2 recorded -1.9% (TPU,
+    # train seed varied) — both inside the +-2.5% band around the reference.
+    from orp_tpu.api import pension_hedge
+    from tools.parity_runs import seeds3_cfg
+
+    v0s = []
+    for seed in (1234, 7, 99):
+        res = pension_hedge(seeds3_cfg(seed))
+        v0s.append(res.v0)
+    mean = float(np.mean(v0s))
+    assert abs(mean - 981_038) / 981_038 < 0.025, (v0s, mean)

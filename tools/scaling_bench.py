@@ -1,0 +1,139 @@
+"""Measured scaling evidence for the path-sharded hedge walk (VERDICT r2
+item 1b: replace the "follows from path-sharding" assertion with data).
+
+Two experiments, one JSON line each:
+
+  devices  - the SAME global problem (paths, dates, epochs) run on a 1-device
+             vs n-device ("paths",) mesh. Each device count runs in a fresh
+             subprocess (the virtual CPU mesh must be provisioned before JAX
+             initialises). On virtual CPU devices all "chips" share the same
+             cores, so the honest reading is sharding/collective OVERHEAD
+             (ratio ~1.0 = the sharded program costs nothing extra), not
+             speedup; on a real pod slice the same harness reads as speedup.
+  paths    - wall time of the fused walk vs path count on the current backend:
+             if doubling paths doesn't double wall time the walk is
+             latency/dispatch-bound and more chips buy little for the fit
+             stage (the sim stage stays embarrassingly parallel).
+
+Usage:
+  python tools/scaling_bench.py devices [--paths 131072] [--devices 1,2,4,8]
+  python tools/scaling_bench.py paths   [--paths-list 65536,262144,1048576]
+  python tools/scaling_bench.py child <n_devices> <n_paths>   (internal)
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _walk(n_paths: int, mesh=None, epochs=(30, 10), n_dates=8, warm=True,
+          fused=False):
+    """One european walk; returns (cold_s, warm_s, v0_cv).
+
+    ``fused`` must be held FIXED within an experiment: the devices sweep runs
+    the host walk everywhere (so the 1-vs-n ratio isolates sharding/collective
+    cost, not the fused-vs-host program delta); the paths sweep runs the fused
+    walk (the single-chip fast path whose latency-vs-compute split it probes).
+    """
+    sys.path.insert(0, str(HERE))
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+    from orp_tpu.api.pipelines import european_hedge
+
+    euro = EuropeanConfig(constrain_self_financing=False)
+    sim = SimConfig(
+        n_paths=n_paths, T=1.0, dt=1 / (4 * n_dates), rebalance_every=4
+    )
+    train = TrainConfig(
+        dual_mode="mse_only", epochs_first=epochs[0], epochs_warm=epochs[1],
+        batch_size=max(n_paths // 16, 512), lr=1e-3,
+        fused=fused, shuffle="blocks",
+    )
+    t0 = time.perf_counter()
+    res = european_hedge(euro, sim, train, mesh=mesh)
+    cold = time.perf_counter() - t0
+    warm_s = None
+    if warm:
+        t0 = time.perf_counter()
+        res = european_hedge(euro, sim, train, mesh=mesh)
+        warm_s = time.perf_counter() - t0
+    return cold, warm_s, res.report.v0_cv
+
+
+def cmd_child(n_devices: int, n_paths: int):
+    import jax
+
+    mesh = None
+    if n_devices > 1:
+        from orp_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_devices)
+    cold, warm, v0 = _walk(n_paths, mesh=mesh, fused=False)
+    print(json.dumps({
+        "n_devices": n_devices, "n_paths": n_paths,
+        "cold_s": round(cold, 2), "warm_s": round(warm, 2),
+        "v0_cv": round(v0, 5), "platform": jax.devices()[0].platform,
+    }))
+
+
+def cmd_devices(args):
+    rows = []
+    for n in [int(x) for x in args.devices.split(",")]:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split() if "device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["PYTHONPATH"] = str(HERE) + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, __file__, "child", str(n), str(args.paths)],
+            env=env, capture_output=True, text=True, cwd=str(HERE),
+        )
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else None
+        if out.returncode != 0 or line is None:
+            rows.append({"n_devices": n, "error": out.stderr[-300:]})
+        else:
+            rows.append(json.loads(line))
+    print(json.dumps({"experiment": "devices", "rows": rows}))
+
+
+def cmd_paths(args):
+    import jax
+
+    rows = []
+    for n in [int(x) for x in args.paths_list.split(",")]:
+        cold, warm, v0 = _walk(n, fused=True)
+        rows.append({
+            "n_paths": n, "cold_s": round(cold, 2), "warm_s": round(warm, 2),
+            "v0_cv": round(v0, 5),
+        })
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "experiment": "paths", "platform": jax.devices()[0].platform, "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("devices")
+    d.add_argument("--paths", type=int, default=1 << 17)
+    d.add_argument("--devices", default="1,2,4,8")
+    p = sub.add_parser("paths")
+    p.add_argument("--paths-list", default="65536,262144,1048576")
+    c = sub.add_parser("child")
+    c.add_argument("n_devices", type=int)
+    c.add_argument("n_paths", type=int)
+    a = ap.parse_args()
+    if a.cmd == "child":
+        cmd_child(a.n_devices, a.n_paths)
+    elif a.cmd == "devices":
+        cmd_devices(a)
+    else:
+        cmd_paths(a)
