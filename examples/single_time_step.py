@@ -7,22 +7,19 @@ phi0=819,539 stocks / psi0=257,308 bonds, V0=1,076,847 EUR.
 Run: env -u PALLAS_AXON_POOL_IPS python examples/single_time_step.py
 """
 
-from orp_tpu.api import HedgeRunConfig, SimConfig, TrainConfig, pension_hedge
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from orp_tpu.api import pension_hedge
+from tools.parity_runs import single_step_cfg  # ONE config definition shared
+# with the measurement battery and the golden pin (incl. the i=1.0 semantics
+# of Single#16's post-reduction cost_of_capital; see single_step_cfg)
 
 
 def main():
-    n_steps = 120  # monthly over 10y (Single#5: dt=1/12)
-    cfg = HedgeRunConfig(
-        sim=SimConfig(n_paths=8192, T=10.0, dt=10.0 / n_steps, rebalance_every=n_steps),
-        # one date -> only the from-scratch 500-epoch phase runs. The
-        # reference's `cost_of_capital = 0.1*dt` (Single#16) executes AFTER the
-        # grid reduction rescales dt to the 10y interval (Single#11:
-        # `dt = dt*reduction`), so i = 0.1*10 = 1.0 — the combine collapses to
-        # the PURE quantile model (V0 = h, phi = phi2), which is what the
-        # recorded 1,076,847 / 819,539 / 257,308 are
-        train=TrainConfig(cost_of_capital=1.0),
-    )
-    res = pension_hedge(cfg)
+    res = pension_hedge(single_step_cfg())
     print(res.report.summary())
 
 

@@ -73,7 +73,9 @@ class SimConfig:
     seed: int = 1234
     seed_fund: int = 1235        # distinct Sobol stream for the fund (RP.py:60 vs :72)
     scramble: str = "owen"
-    binomial_mode: str = "exact"  # "exact" | "normal" (orp_tpu.sde.kernels)
+    binomial_mode: str = "exact"  # "exact" (threefry binomial) | "inversion"
+    # (exact-in-law Sobol-driven CDF inversion, ~10x faster) | "normal"
+    # (moment-matched approx) — orp_tpu.sde.kernels._binomial_step
     dtype: str = "float32"
     engine: str = "scan"  # "scan" (XLA, any pipeline/mesh) | "pallas" (fused
     # kernel, ~3.8x sim speedup; single-chip log-GBM pipelines only)

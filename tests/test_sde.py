@@ -174,3 +174,58 @@ def test_pension_exact_binomial_is_index_addressed():
     full = simulate_pension(IDX(64), grid, **kw)
     part = simulate_pension(jnp.arange(32, 64, dtype=jnp.uint32), grid, **kw)
     assert np.array_equal(np.asarray(full["N"][32:]), np.asarray(part["N"]))
+
+
+def test_pension_binomial_inversion_matches_exact_law():
+    # the fused Sobol-inversion sampler is exact IN LAW: terminal N moments
+    # must agree with the threefry-exact mode within MC noise, and every draw
+    # must be a feasible integer count
+    grid = TimeGrid(T=10.0, n_steps=40)
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+              n0=10_000.0, seed=1234, dtype=jnp.float64)
+    a = simulate_pension(IDX(4096), grid, binomial_mode="exact", **kw)
+    c = simulate_pension(IDX(4096), grid, binomial_mode="inversion", **kw)
+    n_a, n_c = np.asarray(a["N"][:, -1]), np.asarray(c["N"][:, -1])
+    assert abs(n_a.mean() - n_c.mean()) < 30, (n_a.mean(), n_c.mean())
+    assert abs(n_a.std() - n_c.std()) < 30, (n_a.std(), n_c.std())
+    assert np.all(n_c == np.round(n_c))  # integer counts
+    assert np.all(n_c >= 0) and np.all(n_c <= 10_000)
+    # monotone per path: N can only shrink (checked on the stored knots)
+    n_path = np.asarray(c["N"])
+    assert np.all(np.diff(n_path, axis=1) <= 0)
+
+
+def test_pension_inversion_binomial_is_index_addressed():
+    # Sobol-driven -> shard-local generation equals monolithic, path-for-path
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+              n0=10_000.0, seed=1234, binomial_mode="inversion")
+    grid = TimeGrid(10.0, 20)
+    full = simulate_pension(IDX(64), grid, **kw)
+    part = simulate_pension(jnp.arange(32, 64, dtype=jnp.uint32), grid, **kw)
+    assert np.array_equal(np.asarray(full["N"][32:]), np.asarray(part["N"]))
+
+
+def test_pension_binomial_mode_validated():
+    import pytest
+
+    with pytest.raises(ValueError):
+        simulate_pension(
+            IDX(8), TimeGrid(1.0, 2), y0=1.0, mu=0.08, sigma=0.15, l0=0.01,
+            mort_c=0.075, eta=0.000597, n0=100.0, binomial_mode="exactt",
+        )
+
+
+def test_pension_binomial_inversion_coarse_grid_clt_branch():
+    # mean deaths per step >> _INVERSION_MEAN_MAX (TimeGrid(10, 10): n*lam*dt
+    # ~ 100+): the walk cannot reach these counts — the CLT branch must take
+    # over instead of silently railing at the trip cap
+    grid = TimeGrid(T=10.0, n_steps=10)
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075, eta=0.000597,
+              n0=10_000.0, seed=1234, dtype=jnp.float64)
+    a = simulate_pension(IDX(4096), grid, binomial_mode="exact", **kw)
+    c = simulate_pension(IDX(4096), grid, binomial_mode="inversion", **kw)
+    n_a, n_c = np.asarray(a["N"][:, -1]), np.asarray(c["N"][:, -1])
+    assert abs(n_a.mean() - n_c.mean()) < 30, (n_a.mean(), n_c.mean())
+    assert abs(n_a.std() - n_c.std()) < 30, (n_a.std(), n_c.std())
+    # the railing failure mode returned n0 - 128 * n_steps for EVERY path
+    assert n_c.std() > 20

@@ -1,0 +1,85 @@
+"""Binomial population sampling benchmark across all three modes
+(VERDICT r2 item 6): sim-only wall time of the pension path system at scale.
+
+``exact`` draws ``N_t ~ Binomial(N_{t-1}, p)`` statelessly per (path, step)
+via per-path folded threefry keys (the TPU re-design of RP.py:78-84's
+re-seeded ``np.random.binomial``); ``inversion`` is the exact-in-law fused
+Sobol-CDF-inversion sampler (kernels._binomial_step — no threefry, fixed-trip
+walk, CLT branch for coarse grids); ``normal`` is the moment-matched
+approximation (cheapest, but its no-births clip biases survivor counts ~1%
+low at fine grids — compare the emitted mean_N_T columns). The exact mode is
+the only one that cannot ride the fused Pallas kernels, so the ratios locate
+where it starts to dominate and what switching to ``inversion`` buys.
+
+Emits one JSON line per (mode, n_paths, n_steps) with path-steps/s.
+
+Usage: python tools/binomial_bench.py [--paths-list 65536,262144] [--steps 3650]
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--paths-list", default="65536,262144")
+    ap.add_argument("--steps", type=int, default=3650)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    from orp_tpu.sde import TimeGrid, simulate_pension
+
+    grid = TimeGrid(10.0, args.steps)
+    rows = []
+    for n in [int(x) for x in args.paths_list.split(",")]:
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        for mode in ("normal", "inversion", "exact"):
+            def run():
+                traj = simulate_pension(
+                    idx, grid, y0=1.0, mu=0.08, sigma=0.15, l0=0.01,
+                    mort_c=0.075, eta=0.000597, n0=1e4, seed=1234,
+                    store_every=args.steps, binomial_mode=mode,
+                )
+                jax.block_until_ready(traj)
+                return traj
+
+            t0 = time.perf_counter()
+            traj = run()
+            cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(args.repeats):
+                traj = run()
+            warm = (time.perf_counter() - t0) / args.repeats
+            mean_nt = float(traj["N"][:, -1].mean())
+            row = {
+                "mode": mode, "n_paths": n, "n_steps": args.steps,
+                "cold_s": round(cold, 2), "warm_s": round(warm, 3),
+                "path_steps_per_s": round(n * args.steps / warm),
+                "mean_N_T": round(mean_nt, 1),  # oracle ~8615 at these params
+                "platform": jax.devices()[0].platform,
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    if len(rows) >= 3:
+        by = {(r["mode"], r["n_paths"]): r["warm_s"] for r in rows}
+        for n in [int(x) for x in args.paths_list.split(",")]:
+            print(json.dumps({
+                "n_paths": n,
+                "exact_over_normal": round(by[("exact", n)] / by[("normal", n)], 2),
+                "inversion_over_normal": round(
+                    by[("inversion", n)] / by[("normal", n)], 2),
+                "exact_over_inversion": round(
+                    by[("exact", n)] / by[("inversion", n)], 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
