@@ -365,16 +365,17 @@ def pension_hedge(
     )
     if s.engine == "pallas":
         _check_pallas(s, mesh, "pension_hedge")
-        if s.binomial_mode != "normal":
+        if s.binomial_mode == "exact":
             raise ValueError(
-                "pension_hedge: engine='pallas' supports binomial_mode='normal' "
-                "only (the exact stateless-binomial draw needs threefry and "
-                "stays on the scan path); got binomial_mode="
-                f"{s.binomial_mode!r}"
+                "pension_hedge: engine='pallas' supports binomial_mode "
+                "'normal' or 'inversion' (the exact stateless-binomial draw "
+                "needs threefry and stays on the scan path); got "
+                f"binomial_mode={s.binomial_mode!r}"
             )
         traj = pension_pallas(
             s.n_paths, s.n_steps, dt=grid.dt,
-            block_paths=min(1024, s.n_paths), **sde_kw,
+            block_paths=min(1024, s.n_paths),
+            binomial_mode=s.binomial_mode, **sde_kw,
         )
     else:
         idx = path_indices(s.n_paths, mesh)

@@ -34,19 +34,28 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from orp_tpu.qmc.pallas_sobol import _LANES, _block_indices, _sobol_z
+from orp_tpu.qmc.pallas_sobol import (
+    _LANES,
+    _block_indices,
+    _ndtri_f32,
+    _sobol_u,
+    _sobol_z,
+)
 from orp_tpu.qmc.sobol import direction_numbers
 
 
 def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
-               n_factors, used_factors, step_fn, init_vals, out_slots):
+               n_factors, used_factors, step_fn, init_vals, out_slots,
+               uniform_factors=()):
     """Generic multi-factor driver: one grid instance evolves ``block_paths``
     paths through all steps, storing ``state[out_slots[j]]`` to ``out_refs[j]``
     at every ``store_every``-th step.
 
     ``step_fn(state, z, t) -> state`` where ``z`` maps factor id -> (rows, 128)
-    normals; only ``used_factors`` are generated (unused factors of the layout
-    cost nothing, unlike the scan path where XLA DCE does the same job).
+    normals — except factors listed in ``uniform_factors``, delivered as the
+    raw scrambled-Sobol UNIFORM (for inversion-style samplers). Only
+    ``used_factors`` are generated (unused factors of the layout cost nothing,
+    unlike the scan path where XLA DCE does the same job).
     """
     rows = block_paths // _LANES
     idx = _block_indices(block_paths)
@@ -59,7 +68,9 @@ def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
 
     def step(t, state):
         z = {
-            f: _sobol_z(idx, dirs_ref, (t - 1) * n_factors + f, seed)
+            f: (_sobol_u if f in uniform_factors else _sobol_z)(
+                idx, dirs_ref, (t - 1) * n_factors + f, seed
+            )
             for f in used_factors
         }
         state = step_fn(state, z, t)
@@ -75,7 +86,8 @@ def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
 
 
 def _run_mf(n_paths, n_steps, *, store_every, block_paths, seed, n_factors,
-            used_factors, step_fn, init_vals, out_slots, interpret):
+            used_factors, step_fn, init_vals, out_slots, interpret,
+            uniform_factors=()):
     if interpret is None:
         # Mosaic lowering needs a real TPU; anywhere else run the interpreter
         interpret = jax.default_backend() != "tpu"
@@ -95,6 +107,7 @@ def _run_mf(n_paths, n_steps, *, store_every, block_paths, seed, n_factors,
         n_steps=n_steps, store_every=store_every, block_paths=block_paths,
         seed=seed, n_factors=n_factors, used_factors=used_factors,
         step_fn=step_fn, init_vals=init_vals, out_slots=out_slots,
+        uniform_factors=uniform_factors,
     )
     out_struct = jax.ShapeDtypeStruct(
         (n_knots, n_paths // _LANES, _LANES), jnp.float32
@@ -165,6 +178,7 @@ def heston_log_pallas(
         "n_paths", "n_steps", "store_every", "seed", "block_paths", "interpret",
         "y0", "mu", "sigma", "l0", "mort_c", "eta", "n0", "dt",
         "sv", "v0", "cir_a", "cir_b", "cir_c", "cir_drift_times_dt",
+        "binomial_mode",
     ),
 )
 def pension_pallas(
@@ -189,18 +203,44 @@ def pension_pallas(
     cir_b: float = 0.0,
     cir_c: float = 0.0,
     cir_drift_times_dt: bool = False,
+    binomial_mode: str = "normal",
 ) -> dict[str, jax.Array]:
     """Fused coupled pension system, semantics identical to
-    ``simulate_pension(binomial_mode="normal")`` (the population draw is the
-    moment-matched Sobol-normal approximation — the right mode at 1M-path
-    scale). Returns ``{"Y", "lam", "N"}`` (+ ``"v"`` when ``sv``)."""
+    ``simulate_pension(binomial_mode="normal" | "inversion")``: the population
+    draw is either the moment-matched Sobol-normal approximation or the
+    exact-in-law Sobol-CDF-inversion sampler (sde/kernels._binomial_step —
+    here the inversion consumes factor 3's raw uniform, skipping the
+    ndtri/ndtr round trip, and ``pmf(0) = p^n = exp(-n lam dt)`` needs no log
+    since ``p = exp(-lam dt)`` by construction). The threefry ``exact`` mode
+    stays on the scan path. Returns ``{"Y", "lam", "N"}`` (+ ``"v"`` when
+    ``sv``)."""
     if not sv and sigma is None:
         raise ValueError("sigma is required when sv=False (constant-vol fund)")
+    if binomial_mode not in ("normal", "inversion"):
+        raise ValueError(
+            f"pension_pallas: binomial_mode={binomial_mode!r} not in "
+            "('normal', 'inversion') — 'exact' needs threefry (scan path)"
+        )
     sdt = math.sqrt(dt)
+    inv = binomial_mode == "inversion"
+
+    from orp_tpu.sde.kernels import binomial_inversion_deaths
 
     def step_mortality_pop(lam, pop, z):
         lam = lam + mort_c * lam * dt + eta * sdt * z[1]
         p = jnp.exp(-lam * dt)
+        if inv:
+            # shared walk (sde.kernels.binomial_inversion_deaths); only the
+            # inputs are engine-specific: u is factor 3's RAW Sobol uniform
+            # (no ndtri/ndtr round trip), pmf0 = p^n = exp(-n lam dt) is
+            # log-free since p = exp(-lam dt) by construction, and the CLT
+            # normal comes from inverting u in-kernel
+            u = z[3]
+            q = 1.0 - p
+            pmf0 = jnp.exp(-pop * lam * dt)
+            deaths = binomial_inversion_deaths(u, pop, q, pmf0, _ndtri_f32(u))
+            pop = jnp.maximum(pop - deaths, 0.0)
+            return lam, pop
         mean = pop * p
         var = pop * p * (1 - p)
         draw = jnp.round(mean + jnp.sqrt(jnp.maximum(var, 0.0)) * z[3])
@@ -225,7 +265,7 @@ def pension_pallas(
             n_paths, n_steps, store_every=store_every, block_paths=block_paths,
             seed=seed, n_factors=4, used_factors=(0, 1, 2, 3), step_fn=step,
             init_vals=(math.log(y0), v0, l0, n0), out_slots=(0, 1, 2, 3),
-            interpret=interpret,
+            interpret=interpret, uniform_factors=(3,) if inv else (),
         )
         return {"Y": jnp.exp(logy), "v": v, "lam": lam, "N": pop}
 
@@ -239,5 +279,6 @@ def pension_pallas(
         n_paths, n_steps, store_every=store_every, block_paths=block_paths,
         seed=seed, n_factors=4, used_factors=(0, 1, 3), step_fn=step,
         init_vals=(y0, l0, n0), out_slots=(0, 1, 2), interpret=interpret,
+        uniform_factors=(3,) if inv else (),
     )
     return {"Y": y, "lam": lam, "N": pop}

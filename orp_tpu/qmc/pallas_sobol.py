@@ -101,16 +101,17 @@ def _block_indices(block_paths):
             + jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1))
 
 
-def _sobol_z(idx, dirs_ref, dim, seed):
-    """One factor's N(0,1) block for Sobol dimension ``dim`` (traced int32).
-
-    The full chain: Sobol integer (32-term XOR of direction entries where the
-    index bit is set — unrolled statically, Mosaic has no dynamic array
-    indexing; a lane/row/base bit-decomposition was measured at parity since
-    the VPU cost is dominated by the inverse normal, not the XOR chain), Owen
-    scramble keyed by hash(seed, dim), 23-bit bucket-centred uint32->(0,1)
-    (cast via int32 — the value is < 2^23 so the signed cast is exact; Mosaic
-    lacks uint32->f32), AS241 inverse normal.
+def _sobol_u(idx, dirs_ref, dim, seed):
+    """One factor's scrambled-Sobol uniform block for dimension ``dim``
+    (traced int32) — the chain of ``_sobol_z`` up to (0,1): Sobol integer
+    (32-term XOR of direction entries where the index bit is set — unrolled
+    statically, Mosaic has no dynamic array indexing; a lane/row/base
+    bit-decomposition was measured at parity since the VPU cost is dominated
+    by the inverse normal, not the XOR chain), Owen scramble keyed by
+    hash(seed, dim), 23-bit bucket-centred uint32->(0,1) (cast via int32 —
+    the value is < 2^23 so the signed cast is exact; Mosaic lacks
+    uint32->f32). Exposed separately so samplers that consume the UNIFORM
+    (the binomial CDF inversion) skip the ndtri/ndtr round trip.
     """
     # direction row for this dimension: dynamic sublane load, (1, 32) uint32
     drow = dirs_ref[pl.dslice(dim, 1), :]
@@ -120,8 +121,12 @@ def _sobol_z(idx, dirs_ref, dim, seed):
         x = x ^ jnp.where(bit, drow[0, k], _u32(0))
     dim_seed = _hash_combine(_u32(seed), dim.astype(jnp.uint32))
     x = _reverse_bits32(_laine_karras(_reverse_bits32(x), dim_seed))
-    u = ((x >> _u32(9)).astype(jnp.int32).astype(jnp.float32) + 0.5) * jnp.float32(2.0**-23)
-    return _ndtri_f32(u)
+    return ((x >> _u32(9)).astype(jnp.int32).astype(jnp.float32) + 0.5) * jnp.float32(2.0**-23)
+
+
+def _sobol_z(idx, dirs_ref, dim, seed):
+    """One factor's N(0,1) block: ``_sobol_u`` through the AS241 inverse normal."""
+    return _ndtri_f32(_sobol_u(idx, dirs_ref, dim, seed))
 
 
 def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,

@@ -192,7 +192,40 @@ _INVERSION_MEAN_MAX = 45.0  # per-element switchover: the walk handles
 # ~ 1e-11 relative — unlike the small-mean regime where it biases ~1%
 
 
-def _binomial_step(key, t, indices, n_prev, p, z, mode):
+def binomial_inversion_deaths(u, n, q, pmf0, z_clt):
+    """Shared core of the ``inversion`` sampler: invert the death count
+    ``D ~ Binomial(n, q)`` from uniform ``u`` by the fixed-trip CDF walk, with
+    the CLT branch for elements beyond the walk's reach.
+
+    THE single definition — called by the scan path (``_binomial_step``) and
+    by the Pallas pension kernel (``orp_tpu/qmc/pallas_mf.py``), whose draws
+    must stay boundary-synchronised; only ``u``/``pmf0``/``z_clt`` sourcing
+    differs per engine (ndtr round trip vs raw Sobol uniform). Pure
+    elementwise jnp + ``fori_loop``: traces identically under jit and inside
+    a Pallas kernel body.
+    """
+    mean_d = n * q
+    ratio = q / jnp.maximum(1.0 - q, jnp.asarray(1e-30, u.dtype))
+    cdf = pmf0
+    deaths = jnp.zeros_like(n)
+
+    def body(k, carry):
+        pmf, cdf, deaths = carry
+        kf = jnp.asarray(k, u.dtype)
+        pmf = jnp.maximum(pmf * (n - (kf - 1.0)) / kf * ratio, 0.0)
+        deaths = jnp.where(cdf < u, kf, deaths)
+        cdf = cdf + pmf
+        return pmf, cdf, deaths
+
+    _, _, deaths = jax.lax.fori_loop(
+        1, _INVERSION_K + 1, body, (pmf0, cdf, deaths)
+    )
+    sd_d = jnp.sqrt(jnp.maximum(n * q * (1.0 - q), 0.0))
+    deaths_clt = jnp.clip(jnp.round(mean_d + sd_d * z_clt), 0.0, n)
+    return jnp.where(mean_d <= _INVERSION_MEAN_MAX, deaths, deaths_clt)
+
+
+def _binomial_step(key, t, indices, n_prev, p, z, mode, neg_log_p=None):
     """One population-thinning step: ``N_t ~ Binomial(N_{t-1}, p)``.
 
     ``exact``: stateless ``jax.random.binomial`` under keys folded by *(step,
@@ -228,31 +261,16 @@ def _binomial_step(key, t, indices, n_prev, p, z, mode):
         u = jax.scipy.special.ndtr(z)
         n = n_prev.astype(z.dtype)  # counts <= 1e4: exact in f32
         q = jnp.clip(1.0 - p, 0.0, 1.0)
-        mean_d = n * q
-        ratio = q / jnp.maximum(1.0 - q, jnp.asarray(1e-30, z.dtype))
-        pmf = jnp.exp(n * jnp.log1p(-q))  # P(D=0) = p^n
-        cdf = pmf
-        deaths = jnp.zeros_like(n)
-
-        def body(k, carry):
-            pmf, cdf, deaths = carry
-            kf = jnp.asarray(k, z.dtype)
-            pmf = pmf * (n - (kf - 1.0)) / kf * ratio
-            pmf = jnp.maximum(pmf, 0.0)  # k > n: support exhausted
-            deaths = jnp.where(cdf < u, kf, deaths)
-            cdf = cdf + pmf
-            return pmf, cdf, deaths
-
-        _, _, deaths = jax.lax.fori_loop(
-            1, _INVERSION_K + 1, body, (pmf, cdf, deaths)
-        )
-        # CLT branch for elements the walk cannot reach (mean deaths beyond
-        # the trip count, where pmf(0) also approaches f32 underflow): there
-        # the normal draw on the DEATH count is accurate to ~1e-11, and the
-        # masked-out walk lanes would otherwise silently rail at K
-        sd_d = jnp.sqrt(jnp.maximum(n * q * (1.0 - q), 0.0))
-        deaths_clt = jnp.clip(jnp.round(mean_d + sd_d * z), 0.0, n)
-        deaths = jnp.where(mean_d <= _INVERSION_MEAN_MAX, deaths, deaths_clt)
+        # P(D=0) = p^n. When the caller knows -log(p) analytically (the
+        # pension thinning has p = exp(-lam dt), so -log p = lam dt EXACTLY),
+        # use it: exp(n*log1p(-q)) loses ~4 digits of the exponent through the
+        # 1-p cancellation, which is enough to move CDF boundaries and
+        # de-synchronise draws from the Pallas kernel's log-free walk
+        if neg_log_p is None:
+            pmf0 = jnp.exp(n * jnp.log1p(-q))
+        else:
+            pmf0 = jnp.exp(-n * neg_log_p.astype(z.dtype))
+        deaths = binomial_inversion_deaths(u, n, q, pmf0, z_clt=z)
         return jnp.maximum(n - deaths, 0.0).astype(n_prev.dtype)
     mean = n_prev * p
     var = n_prev * p * (1 - p)
@@ -340,7 +358,9 @@ def simulate_pension(
         p = jnp.exp(-lam * dt)
         # normal/inversion consume a dedicated Sobol factor; exact ignores z
         zpop = z[:, 3] if binomial_mode in ("normal", "inversion") else z[:, 0]
-        pop = _binomial_step(key, t, indices, pop, p, zpop, binomial_mode)
+        pop = _binomial_step(
+            key, t, indices, pop, p, zpop, binomial_mode, neg_log_p=lam * dt
+        )
         return (logy, v_new, lam, pop) if sv else (y, lam, pop)
 
     if sv:

@@ -135,3 +135,66 @@ def test_pension_pipeline_pallas_rejects_exact_binomial():
             T=1.0, dt=0.25, rebalance_every=1, n_paths=512, engine="pallas",
             binomial_mode="exact",
         )))
+
+
+def test_pallas_pension_inversion_matches_xla_scan():
+    # the Pallas inversion sampler consumes factor 3's RAW uniform while the
+    # scan path round-trips ndtr(ndtri(u)) — draws may differ by one unit on
+    # the ~1e-7-wide CDF boundary sliver, so: Y/lam to roundoff, N exactly
+    # equal on >=99.9% of knots and never off by more than 1 death
+    from orp_tpu.qmc.pallas_mf import pension_pallas
+    from orp_tpu.sde import simulate_pension
+
+    n_paths, n_steps, store = 512, 40, 10
+    grid = TimeGrid(10.0, n_steps)
+    kw = dict(y0=1.0, mu=0.08, sigma=0.15, l0=0.01, mort_c=0.075,
+              eta=0.000597, n0=10000.0)
+    ref = simulate_pension(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, seed=1234,
+        store_every=store, binomial_mode="inversion", **kw,
+    )
+    got = pension_pallas(
+        n_paths, n_steps, dt=grid.dt, seed=1234, store_every=store,
+        block_paths=256, interpret=True, binomial_mode="inversion", **kw,
+    )
+    np.testing.assert_allclose(np.asarray(got["Y"]), np.asarray(ref["Y"]), rtol=3e-5)
+    n_ref, n_got = np.asarray(ref["N"]), np.asarray(got["N"])
+    mismatch = n_ref != n_got
+    assert mismatch.mean() < 1e-3, mismatch.mean()
+    assert np.abs(n_ref - n_got).max() <= 1.0
+
+
+def test_pallas_pension_rejects_exact_mode():
+    from orp_tpu.qmc.pallas_mf import pension_pallas
+
+    with pytest.raises(ValueError):
+        pension_pallas(
+            256, 4, dt=0.25, y0=1.0, mu=0.08, sigma=0.15, l0=0.01,
+            mort_c=0.075, eta=0.000597, n0=100.0, block_paths=256,
+            interpret=True, binomial_mode="exact",
+        )
+
+
+def test_pallas_sv_pension_inversion_matches_xla_scan():
+    # the sv (4-factor) branch wires inversion through uniform_factors too —
+    # a factor-3 uniform-delivery regression specific to that layout must fail
+    from orp_tpu.qmc.pallas_mf import pension_pallas
+    from orp_tpu.sde import simulate_pension
+
+    n_paths, n_steps, store = 512, 40, 10
+    grid = TimeGrid(10.0, n_steps)
+    kw = dict(y0=1.0, mu=0.0962, sigma=None, l0=0.01, mort_c=0.075,
+              eta=0.000597, n0=10000.0, sv=True, v0=0.16679,
+              cir_a=0.00333, cir_b=0.15629, cir_c=0.01583)
+    ref = simulate_pension(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, seed=1234,
+        store_every=store, binomial_mode="inversion", **kw,
+    )
+    got = pension_pallas(
+        n_paths, n_steps, dt=grid.dt, seed=1234, store_every=store,
+        block_paths=256, interpret=True, binomial_mode="inversion", **kw,
+    )
+    np.testing.assert_allclose(np.asarray(got["Y"]), np.asarray(ref["Y"]), rtol=3e-5)
+    n_ref, n_got = np.asarray(ref["N"]), np.asarray(got["N"])
+    assert (n_ref != n_got).mean() < 1e-3
+    assert np.abs(n_ref - n_got).max() <= 1.0
