@@ -27,7 +27,11 @@ def bs_call(s0, k, r, sigma, T):
     return _bs_call(s0, k, r, sigma, T)[0]
 
 
-FAST = dict(dual_mode="mse_only", epochs_first=150, epochs_warm=40, lr=1e-3)
+FAST = dict(dual_mode="mse_only", epochs_first=150, epochs_warm=40, lr=1e-3,
+            fused=True, shuffle="blocks")  # single-program walk + zero-copy
+# shuffle: the benched single-chip fast path (see SCALING.md). config_5 is the
+# one config that may run under a mesh: it overrides fused there (the mesh
+# walk is benchmarked through the host-loop programs, as in the device sweep)
 
 
 def config_1_single_step():
@@ -132,8 +136,8 @@ def config_5_basket(n_paths=1 << 20):
         basket,
         SimConfig(n_paths=n_paths, T=1.0, dt=1 / 52, rebalance_every=1),
         TrainConfig(
-            batch_size=max(n_paths // 64, 512), fused=mesh is None,
-            shuffle="blocks", **FAST,
+            batch_size=max(n_paths // 64, 512),
+            **{**FAST, "fused": mesh is None},
         ),
         mesh=mesh,
     )

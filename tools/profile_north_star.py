@@ -47,12 +47,27 @@ def main(n_log2=20):
 
     t0 = time.perf_counter()
     grid = TimeGrid(sim.T, sim.n_steps)
-    s = gbm_log_pallas(
-        sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
-        dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
-        block_paths=min(2048, sim.n_paths),
-    )
-    s.block_until_ready()
+    try:
+        s = gbm_log_pallas(
+            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
+            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
+            block_paths=min(2048, sim.n_paths),
+        )
+        s.block_until_ready()
+        stamps["sim_engine"] = "pallas"
+    except Exception as e:  # device fault at large grids over the tunnel
+        from orp_tpu.sde import simulate_gbm_log
+
+        print(f"pallas sim failed ({type(e).__name__}); scan fallback",
+              file=sys.stderr)
+        stamps["sim_pallas_failed_after"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()  # don't bill the Pallas fault to the scan
+        s = simulate_gbm_log(
+            jnp.arange(sim.n_paths, dtype=jnp.uint32), grid, euro.s0, euro.r,
+            euro.sigma, sim.seed_fund, store_every=sim.rebalance_every,
+        )
+        s.block_until_ready()
+        stamps["sim_engine"] = "scan"
     stamps["sim"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -179,7 +194,9 @@ def main(n_log2=20):
     jax.block_until_ready(res.values)
     stamps["fused_walk_warm"] = time.perf_counter() - t0
 
-    stamps = {k: round(v, 3) for k, v in stamps.items()}
+    stamps = {
+        k: round(v, 3) if isinstance(v, float) else v for k, v in stamps.items()
+    }
     stamps["n_paths"] = n_paths
     stamps["platform"] = jax.devices()[0].platform
     print(json.dumps(stamps))
