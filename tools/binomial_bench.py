@@ -28,12 +28,12 @@ import jax
 import jax.numpy as jnp
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--paths-list", default="65536,262144")
     ap.add_argument("--steps", type=int, default=3650)
     ap.add_argument("--repeats", type=int, default=3)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     from orp_tpu.sde import TimeGrid, simulate_pension
 

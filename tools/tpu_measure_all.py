@@ -1,0 +1,110 @@
+"""One-shot TPU measurement battery: everything the round's perf story needs,
+in ONE process (the axon tunnel grants the chip per interpreter, and flaky
+tunnels make many short processes risky — see .claude/skills/verify).
+
+Runs, in order, appending one JSON line each to the output file:
+  1. north_star (fused walk)   - the headline 1M-path 52-date hedge
+  2. profile                   - stage breakdown incl. fused cold/warm
+  3. scaling paths-sweep       - fused walk wall vs path count
+  4. binomial bench            - sampler crossover on the chip
+  5. baseline configs 1,2,4    - quick oracle-checked configs
+
+Usage: python tools/tpu_measure_all.py [out=TPU_MEASURE.jsonl]
+Partial results survive a mid-run tunnel death: each stage flushes its line
+before the next starts, and a stage exception is recorded as its own line.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+
+def main(out_path):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    out = open(out_path, "a")
+
+    def emit(name, payload):
+        payload = {"stage": name, **payload}
+        out.write(json.dumps(payload) + "\n")
+        out.flush()
+        print(json.dumps(payload), flush=True)
+
+    emit("env", {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+    })
+
+    def stage(name, fn):
+        t0 = time.perf_counter()
+        try:
+            payload = fn() or {}
+            payload["stage_wall_s"] = round(time.perf_counter() - t0, 1)
+            emit(name, payload)
+        except Exception as e:  # record and continue — partial data > none
+            emit(name, {"error": f"{type(e).__name__}: {e}"[:300],
+                        "stage_wall_s": round(time.perf_counter() - t0, 1)})
+
+    def north():
+        from benchmarks.north_star import main as ns
+
+        return ns(quiet=True)
+
+    def profile():
+        import io
+        from contextlib import redirect_stdout
+
+        from tools.profile_north_star import main as prof
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            prof(20)
+        return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+    def paths_sweep():
+        from tools.scaling_bench import _walk
+
+        rows = []
+        for n in (1 << 16, 1 << 18, 1 << 20):
+            cold, warm, v0 = _walk(n, fused=True)
+            rows.append({"n_paths": n, "cold_s": round(cold, 2),
+                         "warm_s": round(warm, 2), "v0_cv": round(v0, 5)})
+        return {"rows": rows}
+
+    def binom():
+        # reuse the module in-process to stay in one interpreter
+        import io
+        from contextlib import redirect_stdout
+
+        from tools import binomial_bench
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            binomial_bench.main([
+                "--paths-list", "262144,1048576", "--steps", "3650",
+                "--repeats", "2",
+            ])
+        return {"rows": [json.loads(l) for l in buf.getvalue().splitlines()]}
+
+    def baselines():
+        from benchmarks import baseline_configs as bc
+
+        return {"rows": [bc.config_1_single_step(), bc.config_2_multi_step_100k(),
+                         bc.config_4_heston()]}
+
+    stage("north_star", north)
+    stage("profile", profile)
+    stage("paths_sweep", paths_sweep)
+    stage("binomial", binom)
+    stage("baselines", baselines)
+    out.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else str(HERE / "TPU_MEASURE.jsonl"))
