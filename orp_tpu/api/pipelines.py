@@ -84,11 +84,16 @@ def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times) -> None:
     ``sum_t phi_t (disc_{t+1} S_{t+1} - disc_t S_t)`` changes no mean and
     removes the delta-hedgeable variance. The network-predicted ``report.v0``
     keeps the reference's (biased) estimator for parity; this is the
-    framework-native price."""
+    framework-native price.
+
+    ``s`` is ``(n, knots)`` for a single hedge instrument or ``(n, knots, A)``
+    for a vector hedge (``res.phi`` then carries the matching trailing axis);
+    the martingale increments of every instrument are subtracted."""
     disc = jnp.exp(-r * jnp.asarray(times, s.dtype))
-    d_mart = disc[1:] * s[:, 1:] - disc[:-1] * s[:, :-1]
+    d = disc.reshape((1, -1) + (1,) * (s.ndim - 2))
+    d_mart = d[:, 1:] * s[:, 1:] - d[:, :-1] * s[:, :-1]
     plain = disc[-1] * payoff
-    cv = plain - jnp.sum(res.phi * d_mart, axis=1)
+    cv = plain - jnp.sum(res.phi * d_mart, axis=tuple(range(1, s.ndim)))
     report.v0_plain = float(jnp.mean(plain))
     report.v0_cv = float(jnp.mean(cv))
     report.cv_std = float(jnp.std(cv))
@@ -272,20 +277,35 @@ def basket_hedge(
     *,
     mesh=None,
     quantile_method: str = "sort",
+    instruments: str = "basket",
 ) -> PipelineResult:
     """A-asset basket-call hedge (BASELINE.json config 5; no reference
     analogue — the multi-asset extension of ``European Options.ipynb``).
 
-    The net sees all A normalised prices as features and hedges with the
-    tradeable basket itself plus the bond: ``V = phi * B_t + psi * bond`` where
-    ``B_t = sum_i w_i S_i(t)``. Discounted ``B_t`` is a Q-martingale, so the
-    control-variate price stays unbiased; the analytic comparison line is the
-    moment-matched lognormal oracle (``orp_tpu.utils.basket.basket_call_mm``),
-    stored on the report as ``oracle_mm``. Scan engine only (the Pallas kernels
-    cover the single-asset systems)."""
+    The net sees all A normalised prices as features. Hedge instruments:
+
+    - ``instruments="basket"``: the tradeable basket itself plus the bond —
+      ``V = phi * B_t + psi * bond`` with ``B_t = sum_i w_i S_i(t)`` (the
+      2-instrument head, reference-shaped);
+    - ``instruments="assets"``: a VECTOR hedge — one phi per asset plus the
+      bond (``HedgeMLP.n_hedge_assets=A``). Per-asset deltas differ whenever
+      sigmas differ, so this cuts the control-variate std below the basket
+      hedge at identical cost per step; ``res.backward.phi`` is then
+      ``(n, dates, A)`` and the report's scalar phi is the value-equivalent
+      basket holding ``sum_i phi_i S_i / B_t``.
+
+    Discounted prices are Q-martingales either way, so the CV price stays
+    unbiased; the analytic comparison line is the moment-matched lognormal
+    oracle (``orp_tpu.utils.basket.basket_call_mm``), stored on the report as
+    ``oracle_mm``. Scan engine only (the Pallas kernels cover the
+    single-asset systems)."""
     _check_quantile_method(quantile_method)
     if sim.engine == "pallas":
         raise ValueError("basket_hedge: engine='pallas' not available; use 'scan'")
+    if instruments not in ("basket", "assets"):
+        raise ValueError(
+            f"instruments={instruments!r}: expected 'basket' or 'assets'"
+        )
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     A = len(basket.s0)
@@ -303,24 +323,49 @@ def basket_hedge(
     payoff = payoffs.basket_call(s[:, -1], w, basket.strike)
 
     norm = basket.strike  # normalise all values/prices to strike units
-    model = HedgeMLP(n_features=A)
+    # A=1: the "vector" hedge IS the basket hedge (one risky leg + bond), and
+    # the 2-output head's ledgers are scalar — route it through the basket
+    # branch instead of crashing on a phantom asset axis
+    vector = instruments == "assets" and A > 1
     e_payoff_n = float(jnp.mean(payoff)) / norm
+    if vector:
+        model = HedgeMLP(n_features=A, n_hedge_assets=A)
+        hedge_prices = s / norm           # (n, knots, A)
+        # normalised prices are ~s0_i/norm at t=0: spread the expected payoff
+        # evenly across the A risky legs
+        bias = tuple(
+            e_payoff_n / (A * s0_i / norm) for s0_i in basket.s0
+        ) + (0.0,)
+    else:
+        model = HedgeMLP(n_features=A)
+        hedge_prices = bkt / norm         # (n, knots)
+        bias = (e_payoff_n, 0.0)
     res = backward_induction(
         model,
         s / jnp.asarray(basket.s0, dtype),  # (n, knots, A) per-asset moneyness
-        bkt / norm,
+        hedge_prices,
         b / norm,
         payoff / norm,
         _backward_cfg(train),
-        bias_init=(e_payoff_n, 0.0),
+        bias_init=bias,
     )
     times = np.asarray(coarse.times())
+    if vector:
+        # scalar ledger view for the report: the value-equivalent basket
+        # holding (same portfolio value, expressed in basket units)
+        phi_eq = jnp.sum(res.phi * (s[:, :-1] / norm), axis=-1) / (
+            bkt[:, :-1] / norm
+        )
+        res_view = dataclasses.replace(res, phi=phi_eq)
+    else:
+        res_view = res
     report = build_report(
-        res, terminal_payoff=payoff / norm, r=basket.r, times=times,
+        res_view, terminal_payoff=payoff / norm, r=basket.r, times=times,
         adjustment_factor=norm, holdings_adjustment=1.0,
         quantile_method=quantile_method,
     )
-    _attach_cv_price(report, res, bkt, payoff, basket.r, times)
+    # per-asset martingale CV under the vector hedge; basket martingale else
+    _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r, times)
     from orp_tpu.utils.basket import basket_call_mm
 
     report.oracle_mm = basket_call_mm(

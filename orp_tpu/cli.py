@@ -182,6 +182,7 @@ def cmd_basket(args):
         ),
         _train_cfg(args, "mse_only"),
         quantile_method=args.quantile_method,
+        instruments=args.instruments,
     )
     rep = res.report
     extra = {
@@ -300,6 +301,9 @@ def main(argv=None):
     pb.add_argument("--strike", type=float, default=100.0)
     pb.add_argument("--r", type=float, default=0.08)
     pb.add_argument("--rho", type=float, default=0.3)
+    pb.add_argument("--instruments", choices=["basket", "assets"], default="basket",
+                    help="hedge with the tradeable basket + bond, or a VECTOR "
+                         "hedge (one phi per asset + bond; lower CV variance)")
     _add_train_flags(pb)
     _add_quantile_flag(pb)
     pb.set_defaults(fn=cmd_basket)

@@ -45,15 +45,34 @@ class HedgeMLP:
     constrain_self_financing: bool = False  # psi = 1 - phi (Euro#12)
     init_scale: float = 0.1
     dtype: Any = jnp.float32
+    n_hedge_assets: int = 1  # >1: VECTOR hedge — one phi per tradeable asset
+    # plus the bond (no reference analogue; the multi-instrument extension for
+    # the basket pipeline, where per-asset deltas differ by sigma_i)
+
+    def __post_init__(self):
+        if self.constrain_self_financing and self.n_hedge_assets != 1:
+            raise ValueError(
+                "psi = 1 - phi is a two-instrument normalisation; "
+                f"n_hedge_assets={self.n_hedge_assets} needs the free head"
+            )
 
     @property
     def n_outputs(self) -> int:
-        return 1 if self.constrain_self_financing else 2
+        if self.constrain_self_financing:
+            return 1
+        return self.n_hedge_assets + 1
 
-    def init(self, key: jax.Array, bias_init: tuple[float, float] | None = None) -> Params:
-        """Initialise params. ``bias_init=(phi0, psi0)`` warm-starts the output bias
-        with a moneyness-informed allocation (the RP.py:158-166 trick); for the
-        constrained model only ``phi0`` is used."""
+    def init(self, key: jax.Array, bias_init: tuple[float, ...] | None = None) -> Params:
+        """Initialise params. ``bias_init`` warm-starts the output bias with a
+        moneyness-informed allocation (the RP.py:158-166 trick): ``(phi0,
+        psi0)`` for the 2-instrument head (only ``phi0`` is used by the
+        constrained model), one value per output — A risky legs then the
+        bond — for a vector hedge."""
+        if bias_init is not None and len(bias_init) < self.n_outputs:
+            raise ValueError(
+                f"bias_init has {len(bias_init)} entries; this head needs "
+                f"{self.n_outputs} (one per output)"
+            )
         sizes = (self.n_features, *self.hidden, self.n_outputs)
         params = {}
         for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):

@@ -76,7 +76,12 @@ def _model_value_fn(model: HedgeMLP):
 @jax.jit
 def _stack_prices(y, b):
     # module-level jit (not an inline lambda): a fresh jit object per walk
-    # would recompile this stack on every pipeline run
+    # would recompile this stack on every pipeline run.
+    # y: (n, knots) single risky asset -> (n, knots, 2); or (n, knots, A)
+    # vector-hedge instruments -> (n, knots, A+1); bond is always last
+    if y.ndim == 3:
+        bcol = jnp.broadcast_to(b[None, :, None], (*y.shape[:2], 1))
+        return jnp.concatenate([y, bcol], axis=-1)
     return jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
 
 
@@ -163,6 +168,15 @@ def _date_body(
     return params1, params2, v_t, comb, var_resid, aux1
 
 
+def _split_holdings(comb):
+    """``(n, k)`` holdings -> (phi, psi): scalar phi for the 2-instrument
+    head (ledger shape ``(n,)``, reference semantics), per-asset phi
+    ``(n, A)`` for a vector hedge; the bond leg is always last."""
+    if comb.shape[-1] == 2:
+        return comb[..., 0], comb[..., 1]
+    return comb[..., :-1], comb[..., -1]
+
+
 @dataclasses.dataclass(frozen=True)
 class BackwardConfig:
     epochs_first: int = 500
@@ -206,7 +220,8 @@ class BackwardResult:
     """
 
     values: jax.Array          # (n_paths, n_dates+1) portfolio values incl. terminal
-    phi: jax.Array             # (n_paths, n_dates) combined stock holdings
+    phi: jax.Array             # (n_paths, n_dates) combined stock holdings —
+    # or (n_paths, n_dates, A) under a vector hedge (HedgeMLP.n_hedge_assets>1)
     psi: jax.Array             # (n_paths, n_dates) combined bond holdings
     var_residuals: jax.Array   # (n_paths, n_dates) next-date replication residuals
     train_loss: np.ndarray     # (n_dates,) final fit loss per date (model1)
@@ -270,12 +285,13 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         aux["final_loss"], aux["mae"], aux["mape"], aux["n_epochs_ran"]
     )
 
+    phi_first, psi_first = _split_holdings(comb_first)
+
     if n_dates == 1:
         values = jnp.concatenate([v_first[:, None], terminal[:, None]], axis=1)
-        stack1 = lambda x: x[:, None]
+        stack1 = lambda x: x[:, None] if x.ndim == 1 else x[:, None, :]
         return (
-            values, stack1(comb_first[:, 0]), stack1(comb_first[:, 1]),
-            stack1(var_first),
+            values, stack1(phi_first), stack1(psi_first), stack1(var_first),
             tuple(jnp.asarray(s)[None] for s in scalar(aux_first)),
             params1, params2,
         )
@@ -286,7 +302,8 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         p1, p2, v_t, comb, var_resid, aux1 = one_date(
             p1, p2, target, t, ka, kb, warm_cfg
         )
-        ys = (v_t, comb[:, 0], comb[:, 1], var_resid, *scalar(aux1))
+        phi, psi = _split_holdings(comb)
+        ys = (v_t, phi, psi, var_resid, *scalar(aux1))
         return (p1, p2, v_t), ys
 
     ts = jnp.arange(n_dates - 2, -1, -1)
@@ -294,9 +311,14 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         body, (params1, params2, v_first), (ts, kas[1:], kbs[1:])
     )
     v_cols, phi_cols, psi_cols, var_cols, tls, tmaes, tmapes, eps = ys
-    asc = lambda cols, first_col: jnp.concatenate(
-        [jnp.flip(cols, 0).T, first_col[:, None]], axis=1
-    )
+
+    def asc(cols, first_col):
+        # scan-stacked (n_warm, n_paths[, A]) walk-order -> date-ascending
+        # (n_paths, n_dates[, A]) with the first (latest) date appended last
+        cols = jnp.moveaxis(jnp.flip(cols, 0), 0, 1)
+        first = first_col[:, None] if first_col.ndim == 1 else first_col[:, None, :]
+        return jnp.concatenate([cols, first], axis=1)
+
     values = jnp.concatenate(
         [jnp.flip(v_cols, 0).T, v_first[:, None], terminal[:, None]], axis=1
     )
@@ -307,8 +329,8 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
     )
     return (
         values,
-        asc(phi_cols, comb_first[:, 0]),
-        asc(psi_cols, comb_first[:, 1]),
+        asc(phi_cols, phi_first),
+        asc(psi_cols, psi_first),
         asc(var_cols, var_first),
         metrics,
         params1,
@@ -319,16 +341,17 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
 def backward_induction(
     model: HedgeMLP,
     features: jax.Array,   # (n_paths, n_dates+1, n_features) per rebalance knot
-    y_prices: jax.Array,   # (n_paths, n_dates+1) risky-asset price at knots
+    y_prices: jax.Array,   # (n_paths, n_dates+1) risky-asset price at knots —
+    # or (n_paths, n_dates+1, A) vector-hedge instrument prices
     b_prices: jax.Array,   # (n_dates+1,) bond price at knots
     terminal_values: jax.Array,  # (n_paths,) normalised terminal condition
     cfg: BackwardConfig,
     *,
-    bias_init: tuple[float, float] | None = None,
+    bias_init: tuple[float, ...] | None = None,
 ) -> BackwardResult:
     """Run the backward hedge-training walk. All arrays may be device-sharded over
     the path axis; parameters stay replicated."""
-    n_paths, n_knots = y_prices.shape
+    n_paths, n_knots = y_prices.shape[:2]
     n_dates = n_knots - 1
     dtype = model.dtype
 
@@ -443,8 +466,9 @@ def backward_induction(
             fit_fn=fit, value_fn=_value, outputs_fn=_date_outputs,
         )
         values = values.at[:, t].set(v_t)
-        phi_cols.append(comb[:, 0])
-        psi_cols.append(comb[:, 1])
+        phi_t, psi_t = _split_holdings(comb)
+        phi_cols.append(phi_t)
+        psi_cols.append(psi_t)
         var_cols.append(var_resid)
 
         tl.append(float(aux1["final_loss"]))
@@ -465,8 +489,8 @@ def backward_induction(
                     "params1": params1,
                     "params2": params2,
                     "v_col": v_t,
-                    "phi_col": comb[:, 0],
-                    "psi_col": comb[:, 1],
+                    "phi_col": phi_t,
+                    "psi_col": psi_t,
                     "var_col": var_resid,
                     "train_loss": tl[-1],
                     "train_mae": tmae[-1],
