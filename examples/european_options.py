@@ -9,6 +9,11 @@ Black-Scholes ~10.39.
 Run: env -u PALLAS_AXON_POOL_IPS python examples/european_options.py [--paths 4096]
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import argparse
 
 from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge

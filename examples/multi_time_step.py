@@ -7,6 +7,11 @@ phi0=643,687 / psi0=350,888, VaR99=54.38 EUR; sigma sweep table at Multi#30.
 Run: env -u PALLAS_AXON_POOL_IPS python examples/multi_time_step.py [--sweep] [--sv]
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import argparse
 
 from orp_tpu.api import (

@@ -9,6 +9,11 @@ output to compare (Extra#8(out)): CIRParams(a=0.00336, b=0.15431, c=0.01583).
 Run: env -u PALLAS_AXON_POOL_IPS python examples/stochastic_vol_calibration.py [prices.csv]
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import sys
 
 import jax.numpy as jnp
