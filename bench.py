@@ -13,19 +13,44 @@ paths, wall unrecorded.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 BASELINE_PATH_STEPS_PER_SEC = 15e6  # BASELINE.md "implied sim throughput"
 
 
+def _device_alive(timeout_s: int = 150) -> bool:
+    """Probe the accelerator in a SUBPROCESS with a timeout: a dead axon
+    tunnel hangs `jax.devices()` indefinitely at interpreter start, which
+    would turn the whole bench run into a silent hang instead of a record.
+    The probe process exits cleanly, releasing the chip grant."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('plat=' + jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ),
+        )
+        # a healthy CPU-only JAX is NOT a live accelerator: full-size 1M-path
+        # runs on CPU are the hang-equivalent the fallback exists to avoid
+        return r.returncode == 0 and ("plat=tpu" in r.stdout or "plat=axon" in r.stdout)
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    import jax
+    import jax.numpy as jnp
+
     from orp_tpu.sde import TimeGrid, simulate_gbm_log
 
-    n_paths = 1 << 20
+    # CPU fallback (dead tunnel): shrink 8x so the artifact lands in minutes,
+    # clearly labelled — its purpose is "the code runs and here is the
+    # platform", not a TPU-comparable number
+    cpu_fallback = bool(os.environ.get("ORP_BENCH_CPU_FALLBACK"))
+    n_paths = 1 << 17 if cpu_fallback else 1 << 20
     n_steps = 3650  # the reference's largest fine grid (Multi#7: 4096 x 3651 knots)
     grid = TimeGrid(10.0, n_steps)
     idx = jnp.arange(n_paths, dtype=jnp.uint32)
@@ -79,6 +104,8 @@ def main():
         "vs_baseline": round(value / BASELINE_PATH_STEPS_PER_SEC, 2),
         "kernel": kernel,
     }
+    if cpu_fallback:
+        record["cpu_fallback"] = True  # NOT a TPU number; tunnel was dead
 
     # second perf axis: the end-to-end north-star hedge (1M paths, 52 weekly
     # dates, v0_cv vs Black-Scholes). Failures degrade to an error note rather
@@ -86,7 +113,7 @@ def main():
     try:
         from benchmarks.north_star import main as north_star
 
-        hedge = north_star(quiet=True)
+        hedge = north_star(n_paths=n_paths, quiet=True)
         record.update(
             hedge_bp_err=hedge["bp_err"],
             hedge_wall_s=hedge["wall_s"],
@@ -98,8 +125,21 @@ def main():
     except Exception as e:  # noqa: BLE001
         record.update(hedge_error=f"{type(e).__name__}: {e}")
 
+    record["platform"] = jax.devices()[0].platform
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("ORP_BENCH_NO_PROBE") or _device_alive():
+        main()
+    else:
+        # dead accelerator tunnel: re-exec on CPU so the round still records
+        # an artifact (clearly labelled; vs_baseline is then NOT a TPU number)
+        print("accelerator probe failed; falling back to CPU", file=sys.stderr)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ORP_BENCH_NO_PROBE"] = "1"
+        env["ORP_BENCH_CPU_FALLBACK"] = "1"
+        r = subprocess.run([sys.executable, __file__], env=env)
+        raise SystemExit(r.returncode)
