@@ -31,11 +31,15 @@ def _device_alive(timeout_s: int = 150) -> bool:
             [sys.executable, "-c",
              "import jax; print('plat=' + jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
-            env=dict(os.environ),
         )
-        # a healthy CPU-only JAX is NOT a live accelerator: full-size 1M-path
-        # runs on CPU are the hang-equivalent the fallback exists to avoid
-        return r.returncode == 0 and ("plat=tpu" in r.stdout or "plat=axon" in r.stdout)
+        # a healthy CPU-only JAX is NOT a live accelerator (full-size 1M-path
+        # runs on CPU are the hang-equivalent the fallback exists to avoid);
+        # any non-cpu platform (tpu/axon here, gpu elsewhere) counts as alive
+        return (
+            r.returncode == 0
+            and "plat=" in r.stdout
+            and "plat=cpu" not in r.stdout
+        )
     except subprocess.TimeoutExpired:
         return False
 
