@@ -1,0 +1,74 @@
+"""Tune the north-star walk's step-count/batch trade-off on the chip.
+
+The fused walk's wall is dominated by SEQUENTIAL Adam-step latency: at the r2
+defaults (batch = n/64 = 16k rows) the 1M-path walk executes
+120*64 + 51*30*64 = 105,600 dependent steps whose per-step MXU work (16k rows
+through a 97-param net) is microseconds — pure latency floor. Fewer, larger
+batches cut the step count near-linearly at zero MXU cost; this tool measures
+wall / bp-error / CV-std for a grid of (batch_div, epochs_first, epochs_warm)
+so the benchmark default is a measured optimum, not a guess.
+
+Each config appends one JSON line to stdout and the out file. Runs in ONE
+process (scan engine only — no Pallas, so no fault-poisoning risk) to reuse
+the persisted compilation cache across same-shape configs.
+
+Usage: python tools/north_star_tune.py [out=TUNE.jsonl] [--paths-log2 20]
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out", nargs="?", default=str(HERE / "TUNE.jsonl"))
+    ap.add_argument("--paths-log2", type=int, default=20)
+    ap.add_argument("--configs", default=None,
+                    help="semicolon list of batch_div,epochs_first,epochs_warm")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    from benchmarks.north_star import main as ns
+
+    if args.configs:
+        grid = [tuple(int(x) for x in c.split(","))
+                for c in args.configs.split(";")]
+    else:
+        grid = [
+            (8, 120, 30),    # 8x fewer steps than r2 defaults
+            (8, 150, 60),    # more epochs at the big batch
+            (16, 120, 30),
+            (4, 150, 60),
+            (64, 120, 30),   # the r2 default, for the like-for-like row
+        ]
+
+    out = open(args.out, "a")
+    for batch_div, e_first, e_warm in grid:
+        t0 = time.perf_counter()
+        try:
+            res = ns(n_paths=1 << args.paths_log2, epochs_first=e_first,
+                     epochs_warm=e_warm, batch_div=batch_div, quiet=True)
+            rec = {"batch_div": batch_div, "epochs_first": e_first,
+                   "epochs_warm": e_warm, **res}
+        except Exception as e:  # noqa: BLE001
+            rec = {"batch_div": batch_div, "epochs_first": e_first,
+                   "epochs_warm": e_warm,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        rec["total_s"] = round(time.perf_counter() - t0, 1)
+        rec["platform"] = jax.devices()[0].platform
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+        print(json.dumps(rec), flush=True)
+    out.close()
+
+
+if __name__ == "__main__":
+    main()

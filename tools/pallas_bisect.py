@@ -1,0 +1,57 @@
+"""Bisect the gbm_log_pallas TPU fault: run each config in a fresh subprocess
+(a device fault poisons the whole client process, so isolation is mandatory).
+
+Usage: python tools/pallas_bisect.py
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+
+PROBE = """
+import sys, time
+sys.path.insert(0, {root!r})
+from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
+t0 = time.time()
+out = gbm_log_pallas({n_paths}, {n_steps}, s0=100.0, drift=0.08, sigma=0.15,
+                     dt=1.0/364, seed=1235, store_every={store_every},
+                     block_paths={block_paths})
+out.block_until_ready()
+print("OK", out.shape, round(time.time() - t0, 1))
+"""
+
+
+def probe(n_paths, n_steps, store_every, block_paths, timeout=240):
+    code = PROBE.format(root=str(HERE), n_paths=n_paths, n_steps=n_steps,
+                        store_every=store_every, block_paths=block_paths)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+        ok = r.returncode == 0
+        note = r.stdout.strip().splitlines()[-1] if ok and r.stdout.strip() else \
+            (r.stderr.strip().splitlines()[-1][:120] if r.stderr.strip() else "?")
+    except subprocess.TimeoutExpired:
+        ok, note = False, "TIMEOUT"
+    rec = {"n_paths": n_paths, "n_steps": n_steps, "store_every": store_every,
+           "block_paths": block_paths, "ok": ok, "note": note}
+    print(json.dumps(rec), flush=True)
+    return ok
+
+
+if __name__ == "__main__":
+    cases = [
+        # (n_paths, n_steps, store_every, block_paths)
+        (1 << 20, 3650, 365, 2048),   # known good (bench shape)
+        (1 << 20, 364, 7, 2048),      # known bad (north-star shape)
+        (1 << 16, 364, 7, 2048),      # fewer paths, same knots
+        (1 << 20, 364, 14, 2048),     # 27 knots
+        (1 << 20, 364, 28, 2048),     # 14 knots
+        (1 << 20, 364, 7, 1024),      # smaller block
+        (1 << 20, 364, 364, 2048),    # 2 knots, same n_steps
+        (1 << 20, 3650, 73, 2048),    # 51 knots, long grid
+    ]
+    for c in cases:
+        probe(*c)
