@@ -24,7 +24,8 @@ from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
 from orp_tpu.utils import bs_call
 
 
-def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64, quiet=False):
+def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64,
+         final_solve=False, lr=1e-3, quiet=False):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(
@@ -38,9 +39,12 @@ def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64, quiet=
             epochs_first=epochs_first,
             epochs_warm=epochs_warm,
             batch_size=max(n_paths // batch_div, 512),
-            lr=1e-3,
+            lr=lr,
             fused=True,          # whole walk = one XLA program, no per-date dispatch
             shuffle="blocks",    # zero-copy shuffle at 16k-row batches
+            final_solve=final_solve,  # closed-form shrunk readout after each
+            # MSE fit — neutral at this well-trained default, pays when
+            # epochs are cut (SCALING.md §3a)
         ),
     )
     wall = time.perf_counter() - t0

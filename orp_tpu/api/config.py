@@ -110,6 +110,8 @@ class TrainConfig:
     dual_mode: str = "separate"     # "separate" | "shared" | "mse_only"
     holdings_combine: str = "single"
     lr: float | None = None
+    final_solve: bool = False  # closed-form ridge readout after each MSE fit
+    # (BackwardConfig.final_solve; HedgeMLP.solve_readout)
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist/resume per backward date
     shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)

@@ -112,6 +112,7 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         dual_mode=dual_mode or t.dual_mode,
         holdings_combine=t.holdings_combine,
         lr=t.lr,
+        final_solve=t.final_solve,
         seed=t.seed,
         checkpoint_dir=t.checkpoint_dir,
         shuffle=t.shuffle,

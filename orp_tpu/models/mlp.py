@@ -94,12 +94,8 @@ class HedgeMLP:
         (RP.py:103-112) — here it is just the natural intermediate of the pure
         forward, no graph surgery needed.
         """
-        x = features.astype(self.dtype)
-        n_layers = len(self.hidden) + 1
-        for i in range(n_layers):
-            x = x @ params[f"w{i}"] + params[f"b{i}"]
-            if i < n_layers - 1:
-                x = jnp.where(x >= 0, x, self.negative_slope * x)  # LeakyReLU
+        last = len(self.hidden)
+        x = self.last_hidden(params, features) @ params[f"w{last}"] + params[f"b{last}"]
         if self.constrain_self_financing:
             phi = x[..., 0]
             return jnp.stack([phi, 1.0 - phi], axis=-1)
@@ -112,6 +108,80 @@ class HedgeMLP:
         """
         h = self.holdings(params, features)
         return jnp.sum(h * prices.astype(self.dtype), axis=-1)
+
+    def last_hidden(self, params: Params, features: jax.Array) -> jax.Array:
+        """Activations feeding the final ('Phi_Psi') layer: ``(n, hidden[-1])``.
+
+        The ONE definition of the hidden forward — ``holdings`` adds the final
+        layer on top and ``solve_readout`` relies on these being exactly the
+        features that layer consumes (its linearity assumption).
+        """
+        x = features.astype(self.dtype)
+        for i in range(len(self.hidden)):
+            x = x @ params[f"w{i}"] + params[f"b{i}"]
+            x = jnp.where(x >= 0, x, self.negative_slope * x)  # LeakyReLU
+        return x
+
+    def solve_readout(
+        self,
+        params: Params,
+        features: jax.Array,
+        prices: jax.Array,
+        targets: jax.Array,
+        ridge: float = 1e-3,
+    ) -> Params:
+        """Closed-form least-squares for the final layer, hidden layers fixed,
+        shrunk toward the incoming readout.
+
+        ``value`` is LINEAR in the last layer's ``(w, b)``: with
+        ``hb = [last_hidden, 1]`` and readout ``Theta`` ((H+1, n_outputs)),
+        ``value = sum_j prices_j * (hb @ Theta[:, j])`` (the constrained head
+        folds to ``value = (hb @ Theta) * (p0 - p1) + p1``). So given the
+        fitted hidden features, the MSE-optimal readout is a closed-form
+        normal-equations solve — one path-shardable ``X^T X`` reduction of a
+        ((H+1)*k)^2 Gram matrix instead of thousands of tiny sequential Adam
+        steps.
+
+        The solve minimises ``|X theta - y|^2/n + lam |theta - theta0|^2``
+        where ``theta0`` is the CURRENT (typically Adam-fitted, warm-started)
+        readout and ``lam = ridge * tr(G)/dim``. Shrinking toward ``theta0``
+        rather than 0 matters: the Gram matrix is ill-conditioned (Y_t and
+        B_t are highly correlated across paths, exactly the date-0 OLS
+        degeneracy of PARITY.md), so the unshrunk optimum picks huge
+        cancelling (phi, psi) splits that fit the VALUE but hedge noisily;
+        the warm-started theta0 carries the temporally-smooth split. At the
+        penalised optimum ``MSE(theta) <= MSE(theta0)`` holds for ANY lam
+        (the penalty vanishes at theta0), so the step can never hurt the
+        training loss it replaces. No reference analogue; exposed via
+        ``FitConfig``'s ``solve_fn`` hook / ``TrainConfig.final_solve``.
+        """
+        dt = self.dtype
+        h = self.last_hidden(params, features)                   # (n, H)
+        p = prices.astype(dt)                                    # (n, k)
+        y = targets.astype(dt)
+        n = h.shape[0]
+        hb = jnp.concatenate([h, jnp.ones((n, 1), dt)], axis=1)  # (n, H+1)
+        if self.constrain_self_financing:
+            d = p[..., 0] - p[..., 1]
+            X = hb * d[:, None]                                  # (n, H+1)
+            y = y - p[..., 1]
+            out_cols = 1
+        else:
+            X = (hb[:, :, None] * p[:, None, :]).reshape(n, -1)  # (n, (H+1)k)
+            out_cols = p.shape[-1]
+        g = X.T @ X / n
+        c = X.T @ y / n
+        dim = g.shape[0]
+        last = len(self.hidden)
+        theta0 = jnp.concatenate(
+            [params[f"w{last}"], params[f"b{last}"][None, :]], axis=0
+        ).astype(dt).reshape(-1)                                 # (dim,) i-major
+        lam = ridge * (jnp.trace(g) / dim) + jnp.asarray(1e-12, dt)
+        theta = jnp.linalg.solve(
+            g + lam * jnp.eye(dim, dtype=dt), c + lam * theta0
+        )
+        theta = theta.reshape(dim // out_cols, out_cols)
+        return {**params, f"w{last}": theta[:-1], f"b{last}": theta[-1]}
 
     def n_params(self) -> int:
         sizes = (self.n_features, *self.hidden, self.n_outputs)

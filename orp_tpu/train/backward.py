@@ -61,6 +61,14 @@ def _value(model, params, feats, prices):
 
 
 @functools.lru_cache(maxsize=None)
+def _model_solve_fn(model: HedgeMLP):
+    """``model.solve_readout`` interned per model value (same jit-cache
+    rationale as ``_model_value_fn``: bound-method identity churn would
+    recompile every fit program per pipeline run)."""
+    return model.solve_readout
+
+
+@functools.lru_cache(maxsize=None)
 def _model_value_fn(model: HedgeMLP):
     """The model's ``value`` bound method, interned per model *value*.
 
@@ -141,9 +149,12 @@ def _date_body(
     loop passes the jitted pieces (``fit``/``_value``/``_date_outputs``), the
     fused walk the traceable cores; only the dispatch structure differs."""
     vfn = _model_value_fn(model)  # interned: stable static-arg identity
+    solve_fn = _model_solve_fn(model) if cfg.final_solve else None
     params1, aux1 = fit_fn(
         params1, feats_t, prices_t1, target, ka,
         value_fn=vfn, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
+        solve_fn=solve_fn,  # exact-readout step applies to the MSE model only
+        # (least squares is the MSE optimum; the quantile fit below stays Adam)
     )
     g_pre = jnp.zeros((), model.dtype)  # only read in shared mode
     if cfg.dual_mode == "mse_only":
@@ -195,6 +206,10 @@ class BackwardConfig:
     # `callabacks=[callback]` on warm steps), so later fits keep Adam at the
     # schedule's final 5e-4 — re-running the 1e-2 schedule each warm step
     # (the naive reading) floors per-step MSE ~20x higher
+    final_solve: bool = False  # after each MSE fit, replace the final layer
+    # with its closed-form ridge optimum given the learned hidden features
+    # (HedgeMLP.solve_readout) — training MSE monotonically improves; the
+    # quantile model is untouched (least squares is not the pinball optimum)
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist state per date; resume if present
     shuffle: bool | str = True  # per-epoch row shuffling policy (FitConfig.shuffle):
@@ -416,12 +431,13 @@ def backward_induction(
         # does not change the math, so it must not churn the fingerprint
         fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None, fused=False)
         # the format tag versions the on-disk state layout AND the config
-        # field set: v3 = BackwardConfig grew shuffle/fused (r3). A dir from an
-        # older field set refuses cleanly here instead of failing in replay
+        # field set: v3 = BackwardConfig grew shuffle/fused; v4 = final_solve
+        # (r3). A dir from an older field set refuses cleanly here instead of
+        # failing in replay
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
-            "ckpt_format=increment-v3",
+            "ckpt_format=increment-v4",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:

@@ -97,6 +97,7 @@ def fit_core(
     loss_fn: LossFn,
     cfg: FitConfig,
     metric_fns: tuple = (),
+    solve_fn: Callable | None = None,
 ) -> tuple[Params, dict[str, jax.Array]]:
     """Train ``params`` so ``value_fn(params, features, prices) ~ targets``.
 
@@ -208,18 +209,25 @@ def fit_core(
         epoch_body, init, (jnp.arange(cfg.n_epochs), keys)
     )
 
+    if solve_fn is not None:
+        # closed-form readout: given the Adam-shaped hidden layers, replace
+        # the final layer with its shrunk least-squares optimum — training
+        # MSE can only improve (HedgeMLP.solve_readout)
+        best_params = solve_fn(best_params, features, prices, targets)
     aux = {
-        "loss_history": loss_hist,
-        "best_loss": best_loss,
+        "loss_history": loss_hist,  # Adam epochs only (pre-solve)
         "n_epochs_ran": jnp.sum(jnp.isfinite(loss_hist)),
     }
     pred = value_fn(best_params, features, prices)
     aux["final_loss"] = loss_fn(pred, targets)
+    # best_loss = training loss of the params actually returned: the epoch
+    # minimum normally, the (never worse) post-solve loss when solve_fn ran
+    aux["best_loss"] = aux["final_loss"] if solve_fn is not None else best_loss
     for fn in metric_fns:
         aux[fn.__name__] = fn(pred, targets)
     return best_params, aux
 
 
 fit = functools.partial(
-    jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg")
+    jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
 )(fit_core)

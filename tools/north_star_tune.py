@@ -30,7 +30,8 @@ def main(argv=None):
     ap.add_argument("out", nargs="?", default=str(HERE / "TUNE.jsonl"))
     ap.add_argument("--paths-log2", type=int, default=20)
     ap.add_argument("--configs", default=None,
-                    help="semicolon list of batch_div,epochs_first,epochs_warm")
+                    help="semicolon list of batch_div,epochs_first,epochs_warm"
+                         "[,final_solve(0|1)[,lr]] (defaults: solve 0, lr 1e-3)")
     args = ap.parse_args(argv)
 
     import jax
@@ -39,29 +40,33 @@ def main(argv=None):
     from benchmarks.north_star import main as ns
 
     if args.configs:
-        grid = [tuple(int(x) for x in c.split(","))
+        grid = [tuple(float(x) if i == 4 else int(x)
+                      for i, x in enumerate(c.split(",")))
                 for c in args.configs.split(";")]
     else:
         grid = [
             (8, 120, 30),    # 8x fewer steps than r2 defaults
-            (8, 150, 60),    # more epochs at the big batch
-            (16, 120, 30),
-            (4, 150, 60),
-            (64, 120, 30),   # the r2 default, for the like-for-like row
+            (8, 240, 60, 0, 3e-3),  # big batch + LR compensation
+            (32, 120, 30),
+            (64, 60, 15),    # half the steps at the r2 batch
+            (64, 120, 30),   # the r2 default, the like-for-like row
         ]
+    # pad missing trailing fields: solve defaults 0, lr defaults 1e-3
+    grid = [c + (0, 1e-3)[len(c) - 3:] for c in grid]
 
     out = open(args.out, "a")
-    for batch_div, e_first, e_warm in grid:
+    for batch_div, e_first, e_warm, solve, lr in grid:
         t0 = time.perf_counter()
+        base = {"batch_div": batch_div, "epochs_first": e_first,
+                "epochs_warm": e_warm, "final_solve": bool(solve), "lr": lr,
+                "solve_variant": "shrink" if solve else None}
         try:
             res = ns(n_paths=1 << args.paths_log2, epochs_first=e_first,
-                     epochs_warm=e_warm, batch_div=batch_div, quiet=True)
-            rec = {"batch_div": batch_div, "epochs_first": e_first,
-                   "epochs_warm": e_warm, **res}
+                     epochs_warm=e_warm, batch_div=batch_div,
+                     final_solve=bool(solve), lr=lr, quiet=True)
+            rec = {**base, **res}
         except Exception as e:  # noqa: BLE001
-            rec = {"batch_div": batch_div, "epochs_first": e_first,
-                   "epochs_warm": e_warm,
-                   "error": f"{type(e).__name__}: {e}"[:200]}
+            rec = {**base, "error": f"{type(e).__name__}: {e}"[:200]}
         rec["total_s"] = round(time.perf_counter() - t0, 1)
         rec["platform"] = jax.devices()[0].platform
         out.write(json.dumps(rec) + "\n")
