@@ -119,8 +119,11 @@ def main():
 
         hedge = north_star(n_paths=n_paths, quiet=True)
         record.update(
-            hedge_bp_err=hedge["bp_err"],
+            hedge_bp_err=hedge["bp_err"],        # OLS-martingale estimator
             hedge_wall_s=hedge["wall_s"],
+            hedge_v0_acv=hedge["v0_acv"],
+            hedge_acv_std=hedge["acv_std"],
+            hedge_bp_err_cv=hedge["bp_err_cv"],  # plain hedged-CV, for the record
             hedge_v0_cv=hedge["v0_cv"],
             hedge_cv_std=hedge["cv_std"],
             hedge_bs=hedge["bs"],

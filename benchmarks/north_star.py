@@ -2,13 +2,17 @@
 converging to Black-Scholes within ±1bp, single chip, wall-clocked end-to-end.
 
 Emits one JSON line:
-  {"bs": ..., "v0_cv": ..., "bp_err": ..., "wall_s": ..., "paths": ...,
-   "v0_network": ...}
+  {"bs", "v0_acv", "bp_err", "acv_std", "v0_cv", "bp_err_cv", "cv_std",
+   "wall_s", "paths", "v0_network"}
 
-The framework-native price is the hedged-control-variate QMC estimator
-(unbiased; the network-predicted v0 reproduces the reference's biased
-estimator and is reported alongside). Training is deliberately light — the CV
-mean does not depend on hedge quality, only its variance does.
+The framework-native price (and the ``bp_err`` headline) is ``v0_acv``, the
+OLS-martingale-controlled QMC estimator (risk/controls.py) — seed-robust to
+~0.1-0.4bp at 1M paths. SCHEMA NOTE: in BENCH_r01/r02 records ``bp_err``
+measured the plain hedged-CV estimator, kept here as ``bp_err_cv``
+(its error is a ~1-2bp per-seed draw; SCALING.md §3b). The
+network-predicted ``v0_network`` reproduces the reference's biased
+estimator. Training is deliberately light — both unbiased estimators'
+means do not depend on hedge quality, only their variance does.
 """
 
 import json
@@ -51,8 +55,15 @@ def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64,
     bs, _ = bs_call(100.0, 100.0, 0.08, 0.15, 1.0)
     out = {
         "bs": round(bs, 6),
+        # headline: the OLS-martingale-controlled price (risk/controls.py) —
+        # per-date basis regression on top of the learned hedge; its error at
+        # 1M paths is ~0.1-0.4bp robustly vs the plain hedged-CV's ~1-2bp
+        # seed draw (SCALING.md §3b)
+        "v0_acv": round(res.report.v0_acv, 6),
+        "bp_err": round((res.report.v0_acv - bs) / bs * 1e4, 3),
+        "acv_std": round(res.report.acv_std, 4),
         "v0_cv": round(res.report.v0_cv, 6),
-        "bp_err": round((res.report.v0_cv - bs) / bs * 1e4, 3),
+        "bp_err_cv": round((res.report.v0_cv - bs) / bs * 1e4, 3),
         "cv_std": round(res.report.cv_std, 4),
         "wall_s": round(wall, 1),
         "paths": n_paths,

@@ -43,6 +43,7 @@ from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.parallel.mesh import path_indices
 from orp_tpu.risk.analytics import HedgeReport, build_report
+from orp_tpu.risk.controls import martingale_ols_price
 from orp_tpu.sde import (
     TimeGrid,
     bond_curve,
@@ -78,7 +79,8 @@ def _check_quantile_method(quantile_method: str) -> None:
         )
 
 
-def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times) -> None:
+def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times,
+                     strike_over_s0: float = 1.0) -> None:
     """Unbiased QMC price + learned-hedge control variate (risk-neutral sims
     only): ``disc_t S_t`` is a Q-martingale, so subtracting
     ``sum_t phi_t (disc_{t+1} S_{t+1} - disc_t S_t)`` changes no mean and
@@ -97,6 +99,11 @@ def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times) -> None:
     report.v0_plain = float(jnp.mean(plain))
     report.v0_cv = float(jnp.mean(cv))
     report.cv_std = float(jnp.std(cv))
+    # OLS-martingale-controlled estimator (risk/controls.py): per-date basis
+    # regression on top of the learned hedge — the seed-robust price
+    report.v0_acv, report.acv_std = martingale_ols_price(
+        s, payoff, r, times, strike_over_s0=strike_over_s0, phi=res.phi,
+    )
 
 
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
@@ -211,7 +218,8 @@ def european_hedge(
         holdings_adjustment=1.0,
         quantile_method=quantile_method,
     )
-    _attach_cv_price(report, res, s, payoff, euro.r, times)
+    _attach_cv_price(report, res, s, payoff, euro.r, times,
+                     strike_over_s0=euro.strike / euro.s0)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
 
 
@@ -267,7 +275,8 @@ def heston_hedge(
         adjustment_factor=s0, holdings_adjustment=1.0,
         quantile_method=quantile_method,
     )
-    _attach_cv_price(report, res, s, payoff, h.r, times)
+    _attach_cv_price(report, res, s, payoff, h.r, times,
+                     strike_over_s0=h.strike / h.s0)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
 
 
@@ -366,7 +375,12 @@ def basket_hedge(
         quantile_method=quantile_method,
     )
     # per-asset martingale CV under the vector hedge; basket martingale else
-    _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r, times)
+    # controls normalise each instrument by ITS OWN initial price, so the
+    # basis kink belongs at strike / initial-basket-level (norm is the
+    # strike itself, which would pin the kink at 1.0 regardless of moneyness)
+    b0 = float(jnp.dot(jnp.asarray(basket.s0, dtype), w))
+    _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r,
+                     times, strike_over_s0=basket.strike / b0)
     from orp_tpu.utils.basket import basket_call_mm
 
     report.oracle_mm = basket_call_mm(

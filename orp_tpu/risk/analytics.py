@@ -154,6 +154,11 @@ class HedgeReport:
     v0_plain: float | None = None
     v0_cv: float | None = None
     cv_std: float | None = None  # per-path std of the CV estimator
+    # v0_acv adds per-date OLS martingale controls on top of the learned
+    # hedge (risk/controls.py) — the seed-robust price; acv_std its
+    # per-path residual std
+    v0_acv: float | None = None
+    acv_std: float | None = None
     times: np.ndarray | None = None  # rebalance-knot times (n_dates+1,)
     oracle_mm: float | None = None  # moment-matched-lognormal basket oracle
     # (basket_hedge only; orp_tpu/utils/basket.py)
@@ -171,6 +176,11 @@ class HedgeReport:
             cv = (
                 f"\nunbiased QMC price = {self.v0_plain:,.4f}, "
                 f"hedged-CV price = {self.v0_cv:,.4f} (per-path std {self.cv_std:,.4f})"
+            )
+        if self.v0_acv is not None:
+            cv += (
+                f"\nOLS-martingale price = {self.v0_acv:,.4f} "
+                f"(per-path std {self.acv_std:,.4f})"
             )
         return (
             f"V0 = {self.v0:,.4f} (discounted E[payoff] = {self.discounted_payoff:,.4f}, "
