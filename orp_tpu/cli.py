@@ -30,12 +30,21 @@ import numpy as np
 def _train_cfg(args, default_dual: str):
     from orp_tpu.api import TrainConfig
 
+    if args.fused and args.checkpoint_dir is not None:
+        # clean CLI error instead of the TrainConfig ValueError traceback
+        raise SystemExit(
+            "error: --fused runs the whole walk device-side and cannot "
+            "checkpoint per date; drop --fused or --checkpoint-dir"
+        )
     return TrainConfig(
         epochs_first=args.epochs_first,
         epochs_warm=args.epochs_warm,
         batch_size=args.batch_size,
         dual_mode=args.dual_mode or default_dual,
         checkpoint_dir=args.checkpoint_dir,
+        fused=args.fused,
+        shuffle="blocks" if args.fused else True,
+        final_solve=args.final_solve,
     )
 
 
@@ -46,6 +55,11 @@ def _add_train_flags(p):
     p.add_argument("--dual-mode", choices=["separate", "shared", "mse_only"], default=None)
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist per-date state; rerun resumes automatically")
+    p.add_argument("--fused", action="store_true",
+                   help="whole backward walk as ONE XLA program (blocks "
+                        "shuffle; incompatible with --checkpoint-dir)")
+    p.add_argument("--final-solve", action="store_true",
+                   help="closed-form shrunk readout after each MSE fit")
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 
@@ -69,6 +83,8 @@ def _emit(args, report, extra=None):
         }
         if report.v0_cv is not None:
             out.update(v0_plain=report.v0_plain, v0_cv=report.v0_cv, cv_std=report.cv_std)
+        if report.v0_acv is not None:
+            out.update(v0_acv=report.v0_acv, acv_std=report.acv_std)
         if extra:
             out.update(extra)
         print(json.dumps(out))

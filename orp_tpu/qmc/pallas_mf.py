@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 
 from orp_tpu.qmc.pallas_sobol import (
     _LANES,
+    _STATIC_STORE_MAX_KNOTS,
     _block_indices,
     _ndtri_f32,
     _sobol_u,
@@ -59,6 +60,7 @@ def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
     """
     rows = block_paths // _LANES
     idx = _block_indices(block_paths)
+    n_knots = n_steps // store_every + 1
 
     state = tuple(
         jnp.full((rows, _LANES), v, jnp.float32) for v in init_vals
@@ -73,7 +75,22 @@ def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
             )
             for f in used_factors
         }
-        state = step_fn(state, z, t)
+        return step_fn(state, z, t)
+
+    if n_knots <= _STATIC_STORE_MAX_KNOTS:
+        # statically-unrolled knot stores — same workaround as the GBM
+        # kernel for the many-knot dynamic-store device fault (SCALING.md §5)
+        for k in range(1, n_knots):
+            state = jax.lax.fori_loop(
+                (k - 1) * store_every + 1, k * store_every + 1, step, state,
+                unroll=False,
+            )
+            for j, oref in enumerate(out_refs):
+                oref[k, :, :] = state[out_slots[j]]
+        return
+
+    def step_and_store(t, state):
+        state = step(t, state)
 
         @pl.when(t % store_every == 0)
         def _():
@@ -82,7 +99,7 @@ def _mf_kernel(dirs_ref, *out_refs, n_steps, store_every, block_paths, seed,
 
         return state
 
-    jax.lax.fori_loop(1, n_steps + 1, step, state, unroll=False)
+    jax.lax.fori_loop(1, n_steps + 1, step_and_store, state, unroll=False)
 
 
 def _run_mf(n_paths, n_steps, *, store_every, block_paths, seed, n_factors,

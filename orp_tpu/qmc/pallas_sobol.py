@@ -129,17 +129,43 @@ def _sobol_z(idx, dirs_ref, dim, seed):
     return _ndtri_f32(_sobol_u(idx, dirs_ref, dim, seed))
 
 
+# above this many stored knots, fall back to the dynamic-index store: the
+# static unroll below duplicates the store site per knot, and a daily-store
+# 10y grid (3,651 knots) would blow up the kernel. The dynamic store is the
+# one implicated in the many-knot device fault (SCALING.md §5), but it is
+# only reached for shapes beyond this bound.
+_STATIC_STORE_MAX_KNOTS = 256
+
+
 def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
                 seed, c0, vol_sdt, log_s0):
     """One grid instance: evolve ``block_paths`` paths through all steps."""
     rows = block_paths // _LANES
     idx = _block_indices(block_paths)
+    n_knots = n_steps // store_every + 1
 
     out_ref[0, :, :] = jnp.full((rows, _LANES), log_s0, jnp.float32)
 
     def step(t, logs):
-        z = _sobol_z(idx, dirs_ref, t - 1, seed)
-        logs = logs + c0 + vol_sdt * z
+        return logs + c0 + vol_sdt * _sobol_z(idx, dirs_ref, t - 1, seed)
+
+    if n_knots <= _STATIC_STORE_MAX_KNOTS:
+        # statically-unrolled knot stores: the per-knot store index is a
+        # compile-time constant, sidestepping the dynamic-dslice store to a
+        # long non-tiled leading dim that faults the tunneled v5e at ~53
+        # knots (SCALING.md §5); the step loop between knots stays a
+        # fori_loop so program size grows only with n_knots
+        logs = out_ref[0, :, :]
+        for k in range(1, n_knots):
+            logs = jax.lax.fori_loop(
+                (k - 1) * store_every + 1, k * store_every + 1, step, logs,
+                unroll=False,
+            )
+            out_ref[k, :, :] = logs
+        return
+
+    def step_and_store(t, logs):
+        logs = step(t, logs)
 
         @pl.when(t % store_every == 0)
         def _():
@@ -147,7 +173,8 @@ def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
 
         return logs
 
-    jax.lax.fori_loop(1, n_steps + 1, step, out_ref[0, :, :], unroll=False)
+    jax.lax.fori_loop(1, n_steps + 1, step_and_store, out_ref[0, :, :],
+                      unroll=False)
 
 
 @functools.partial(

@@ -198,3 +198,51 @@ def test_pallas_sv_pension_inversion_matches_xla_scan():
     n_ref, n_got = np.asarray(ref["N"]), np.asarray(got["N"])
     assert (n_ref != n_got).mean() < 1e-3
     assert np.abs(n_ref - n_got).max() <= 1.0
+
+
+def test_pallas_dynamic_store_branch_matches_scan(monkeypatch):
+    # the >_STATIC_STORE_MAX_KNOTS fallback (dynamic-dslice stores) gets zero
+    # coverage from the small-knot tests above once the static unroll exists:
+    # force the threshold down so the SAME shape exercises the dynamic branch,
+    # and pin it against both the scan path and the static-branch output
+    import orp_tpu.qmc.pallas_sobol as ps
+
+    n_paths, n_steps, store = 512, 16, 2  # 9 knots
+    grid = TimeGrid(1.0, n_steps)
+    ref = simulate_gbm_log(
+        jnp.arange(n_paths, dtype=jnp.uint32), grid, 100.0, 0.08, 0.15,
+        seed=1235, store_every=store,
+    )
+    static_out = gbm_log_pallas(
+        n_paths, n_steps, s0=100.0, drift=0.08, sigma=0.15, dt=grid.dt,
+        seed=1235, store_every=store, block_paths=256, interpret=True,
+    )
+    monkeypatch.setattr(ps, "_STATIC_STORE_MAX_KNOTS", 4)
+    gbm_log_pallas.clear_cache()
+    dyn_out = gbm_log_pallas(
+        n_paths, n_steps, s0=100.0, drift=0.08, sigma=0.15, dt=grid.dt,
+        seed=1235, store_every=store, block_paths=256, interpret=True,
+    )
+    gbm_log_pallas.clear_cache()  # don't leak the patched trace to other tests
+    np.testing.assert_allclose(np.asarray(dyn_out), np.asarray(static_out),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(dyn_out), np.asarray(ref), rtol=2e-5)
+
+
+def test_pallas_mf_dynamic_store_branch_matches_static(monkeypatch):
+    import orp_tpu.qmc.pallas_mf as pm
+    from orp_tpu.qmc.pallas_mf import heston_log_pallas
+
+    n_paths, n_steps, store = 256, 16, 4
+    grid = TimeGrid(1.0, n_steps)
+    kw = dict(s0=100.0, mu=0.05, v0=0.04, kappa=1.5, theta=0.04, xi=0.3,
+              rho=-0.5, dt=grid.dt, seed=1235, store_every=store,
+              block_paths=256, interpret=True)
+    static_out = heston_log_pallas(n_paths, n_steps, **kw)
+    monkeypatch.setattr(pm, "_STATIC_STORE_MAX_KNOTS", 2)
+    heston_log_pallas.clear_cache()
+    dyn_out = heston_log_pallas(n_paths, n_steps, **kw)
+    heston_log_pallas.clear_cache()
+    for key in ("S", "v"):
+        np.testing.assert_allclose(np.asarray(dyn_out[key]),
+                                   np.asarray(static_out[key]), rtol=0, atol=0)

@@ -25,7 +25,6 @@ import jax.numpy as jnp
 from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
 from orp_tpu.api.pipelines import _backward_cfg
 from orp_tpu.models.mlp import HedgeMLP
-from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 from orp_tpu.sde import TimeGrid, bond_curve, payoffs
 from orp_tpu.train.backward import _date_outputs
 from orp_tpu.train.fit import FitConfig, fit
@@ -47,27 +46,18 @@ def main(n_log2=20):
 
     t0 = time.perf_counter()
     grid = TimeGrid(sim.T, sim.n_steps)
-    try:
-        s = gbm_log_pallas(
-            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
-            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
-            block_paths=min(2048, sim.n_paths),
-        )
-        s.block_until_ready()
-        stamps["sim_engine"] = "pallas"
-    except Exception as e:  # device fault at large grids over the tunnel
-        from orp_tpu.sde import simulate_gbm_log
+    # scan engine, matching the pipeline default: the Pallas kernel at THIS
+    # storage shape (53 knots) reproducibly faults the tunneled v5e and a
+    # device fault poisons the whole process, killing the rest of the profile
+    # (SCALING.md §5) — a try/except cannot save it
+    from orp_tpu.sde import simulate_gbm_log
 
-        print(f"pallas sim failed ({type(e).__name__}); scan fallback",
-              file=sys.stderr)
-        stamps["sim_pallas_failed_after"] = round(time.perf_counter() - t0, 3)
-        t0 = time.perf_counter()  # don't bill the Pallas fault to the scan
-        s = simulate_gbm_log(
-            jnp.arange(sim.n_paths, dtype=jnp.uint32), grid, euro.s0, euro.r,
-            euro.sigma, sim.seed_fund, store_every=sim.rebalance_every,
-        )
-        s.block_until_ready()
-        stamps["sim_engine"] = "scan"
+    s = simulate_gbm_log(
+        jnp.arange(sim.n_paths, dtype=jnp.uint32), grid, euro.s0, euro.r,
+        euro.sigma, sim.seed_fund, store_every=sim.rebalance_every,
+    )
+    s.block_until_ready()
+    stamps["sim_engine"] = "scan"
     stamps["sim"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
