@@ -132,6 +132,26 @@ def main():
     except Exception as e:  # noqa: BLE001
         record.update(hedge_error=f"{type(e).__name__}: {e}")
 
+    # measured error bar for the price (tools/rqmc_ci.py): mean +/- SE over
+    # independent Owen scrambles — makes the record defensible even when the
+    # single-seed hedge draw above lands outside +/-1bp
+    try:
+        import contextlib
+        import io
+
+        from tools.rqmc_ci import main as rqmc
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rqmc(["--paths-log2", "17" if cpu_fallback else "18",
+                  "--scrambles", "4"])
+        ci = json.loads(buf.getvalue().strip().splitlines()[-1])
+        record.update(rqmc_mean_bp=ci["mean_bp_err"], rqmc_se_bp=ci["se_bp"],
+                      rqmc_scrambles=ci["scrambles"],
+                      rqmc_paths=ci["paths_per_scramble"])
+    except Exception as e:  # noqa: BLE001
+        record.update(rqmc_error=f"{type(e).__name__}: {e}"[:200])
+
     record["platform"] = jax.devices()[0].platform
     print(json.dumps(record))
 

@@ -14,6 +14,7 @@ from orp_tpu.api.config import (
 from orp_tpu.api.pipelines import (
     basket_hedge,
     european_hedge,
+    european_oos,
     heston_hedge,
     pension_hedge,
     replicating_portfolio,
@@ -33,6 +34,7 @@ __all__ = [
     "TrainConfig",
     "basket_hedge",
     "european_hedge",
+    "european_oos",
     "heston_hedge",
     "pension_hedge",
     "replicating_portfolio",

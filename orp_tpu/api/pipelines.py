@@ -135,6 +135,8 @@ class PipelineResult:
     backward: BackwardResult
     times: np.ndarray               # rebalance-knot times (n_dates+1,)
     adjustment_factor: float
+    sim_seed: int | None = None     # seed_fund the run simulated with —
+    # lets european_oos refuse a fresh-paths evaluation on the training seed
 
     @property
     def v0(self) -> float:
@@ -220,7 +222,90 @@ def european_hedge(
     )
     _attach_cv_price(report, res, s, payoff, euro.r, times,
                      strike_over_s0=euro.strike / euro.s0)
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                           sim_seed=sim.seed_fund)
+
+
+def european_oos(
+    trained: PipelineResult,
+    euro: EuropeanConfig = EuropeanConfig(),
+    sim: SimConfig = SimConfig(n_paths=4096, T=1.0, dt=1 / 364, rebalance_every=7),
+    train: TrainConfig = TrainConfig(dual_mode="mse_only"),
+    *,
+    mesh=None,
+    quantile_method: str = "sort",
+    allow_in_sample: bool = False,
+) -> PipelineResult:
+    """Out-of-sample evaluation of a trained European hedge on FRESH paths.
+
+    Pass the ``PipelineResult`` of ``european_hedge`` plus a ``sim`` with a
+    DIFFERENT ``seed_fund`` (a fresh Owen scramble); ``euro``/``train`` must
+    match the training run (they determine the model head and the
+    value-combine semantics). Returns the same report structure — VaR,
+    residual P&L, fan, CV and OLS-martingale prices — measured on paths the
+    network never saw. Re-simulating the TRAINING seed is refused unless
+    ``allow_in_sample=True`` (the replay-identity check) — otherwise the
+    result would be the in-sample ledgers relabeled as OOS. No reference
+    analogue: the reference's ledgers are all in-sample (RP.py:224 reuses
+    the training ``X0``). See ``orp_tpu/train/replay.py``.
+    """
+    from orp_tpu.train.replay import replay_walk
+
+    _check_quantile_method(quantile_method)
+    if (not allow_in_sample and trained.sim_seed is not None
+            and sim.seed_fund == trained.sim_seed):
+        raise ValueError(
+            f"european_oos: sim.seed_fund={sim.seed_fund} is the TRAINING "
+            "seed — these are the in-sample paths, not out-of-sample. Pass a "
+            "different seed_fund, or allow_in_sample=True for a replay-"
+            "identity check"
+        )
+    dtype = jnp.dtype(sim.dtype)
+    grid = TimeGrid(sim.T, sim.n_steps)
+    if sim.engine == "pallas":
+        # honour the training engine: pallas and scan agree only to ~3e-5,
+        # so an engine mismatch would silently break the replay identity
+        _check_pallas(sim, mesh, "european_oos")
+        s = gbm_log_pallas(
+            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
+            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
+            block_paths=min(2048, sim.n_paths),
+        ).astype(dtype)
+    else:
+        idx = path_indices(sim.n_paths, mesh)
+        s = simulate_gbm_log(
+            idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
+            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
+        )
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, euro.r, dtype)
+    payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
+    s0 = euro.s0
+    model = HedgeMLP(n_features=1, constrain_self_financing=euro.constrain_self_financing)
+
+    res = replay_walk(
+        model,
+        trained.backward,
+        (s / s0)[:, :, None],
+        s / s0,
+        b / s0,
+        payoff / s0,
+        _backward_cfg(train),
+    )
+    times = np.asarray(coarse.times())
+    report = build_report(
+        res,
+        terminal_payoff=payoff / s0,
+        r=euro.r,
+        times=times,
+        adjustment_factor=s0,
+        holdings_adjustment=1.0,
+        quantile_method=quantile_method,
+    )
+    _attach_cv_price(report, res, s, payoff, euro.r, times,
+                     strike_over_s0=euro.strike / euro.s0)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                           sim_seed=sim.seed_fund)
 
 
 def heston_hedge(
@@ -277,7 +362,8 @@ def heston_hedge(
     )
     _attach_cv_price(report, res, s, payoff, h.r, times,
                      strike_over_s0=h.strike / h.s0)
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                           sim_seed=sim.seed_fund)
 
 
 def basket_hedge(
@@ -387,7 +473,8 @@ def basket_hedge(
         basket.s0, basket.weights, basket.strike, basket.r,
         basket.sigmas, basket.corr(), sim.T,
     )[0]
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
+                           sim_seed=sim.seed_fund)
 
 
 # ---------------------------------------------------------------------------

@@ -2,6 +2,7 @@
 
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
 from orp_tpu.train.fit import FitConfig, fit, reference_lr_schedule
+from orp_tpu.train.replay import replay_walk
 from orp_tpu.train import losses
 
 __all__ = [
@@ -11,5 +12,6 @@ __all__ = [
     "FitConfig",
     "fit",
     "reference_lr_schedule",
+    "replay_walk",
     "losses",
 ]

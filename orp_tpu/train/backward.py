@@ -245,6 +245,11 @@ class BackwardResult:
     epochs_ran: np.ndarray     # (n_dates,)
     params1: Any = None
     params2: Any = None
+    # per-date snapshots (each leaf gains a leading date-ascending axis):
+    # the trained state AS USED at each date — what out-of-sample replay
+    # (train/replay.py) evaluates on fresh paths. ~n_params x n_dates floats
+    params1_by_date: Any = None
+    params2_by_date: Any = None
 
     @property
     def v0(self) -> jax.Array:
@@ -296,11 +301,17 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
     params1, params2, v_first, comb_first, var_first, aux_first = one_date(
         params1, params2, terminal, n_dates - 1, kas[0], kbs[0], first_cfg
     )
+    _first_p1, _first_p2 = params1, params2
     scalar = lambda aux: (
         aux["final_loss"], aux["mae"], aux["mape"], aux["n_epochs_ran"]
     )
 
     phi_first, psi_first = _split_holdings(comb_first)
+    expand0 = lambda tree: jax.tree.map(lambda x: x[None], tree)
+    # snapshot params2 only when it is a distinct model: in mse_only/shared
+    # modes params2 is params1 (see _date_body), and stacking a byte-copy
+    # would double the per-date snapshot memory and the scan ys for nothing
+    two_models = cfg.dual_mode == "separate"
 
     if n_dates == 1:
         values = jnp.concatenate([v_first[:, None], terminal[:, None]], axis=1)
@@ -308,7 +319,8 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         return (
             values, stack1(phi_first), stack1(psi_first), stack1(var_first),
             tuple(jnp.asarray(s)[None] for s in scalar(aux_first)),
-            params1, params2,
+            params1, params2, expand0(params1),
+            expand0(params2) if two_models else None,
         )
 
     def body(carry, xs):
@@ -318,14 +330,23 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
             p1, p2, target, t, ka, kb, warm_cfg
         )
         phi, psi = _split_holdings(comb)
-        ys = (v_t, phi, psi, var_resid, *scalar(aux1))
+        snaps = (p1, p2) if two_models else (p1,)
+        ys = (v_t, phi, psi, var_resid, *scalar(aux1), snaps)
         return (p1, p2, v_t), ys
 
     ts = jnp.arange(n_dates - 2, -1, -1)
     (params1, params2, _), ys = jax.lax.scan(
         body, (params1, params2, v_first), (ts, kas[1:], kbs[1:])
     )
-    v_cols, phi_cols, psi_cols, var_cols, tls, tmaes, tmapes, eps = ys
+    v_cols, phi_cols, psi_cols, var_cols, tls, tmaes, tmapes, eps, snaps = ys
+    # per-date snapshots, walk order (latest->earliest) -> date-ascending,
+    # first (latest) date appended last
+    asc_tree = lambda stacked, first: jax.tree.map(
+        lambda col, f: jnp.concatenate([jnp.flip(col, 0), f[None]], axis=0),
+        stacked, first,
+    )
+    params1_by_date = asc_tree(snaps[0], _first_p1)
+    params2_by_date = asc_tree(snaps[1], _first_p2) if two_models else None
 
     def asc(cols, first_col):
         # scan-stacked (n_warm, n_paths[, A]) walk-order -> date-ascending
@@ -350,6 +371,8 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         metrics,
         params1,
         params2,
+        params1_by_date,
+        params2_by_date,
     )
 
 
@@ -398,7 +421,8 @@ def backward_induction(
         # identical numerics
         # seed is consumed above into the key arrays; normalise it out of the
         # static cfg so multi-seed runs reuse one compiled walk
-        values, phi, psi, var, metrics, params1, params2 = _fused_walk(
+        (values, phi, psi, var, metrics, params1, params2,
+         params1_by_date, params2_by_date) = _fused_walk(
             model, dataclasses.replace(cfg, seed=0), params1, params2,
             jnp.asarray(features), prices_all, terminal_values,
             jnp.stack(kas), jnp.stack(kbs),
@@ -409,6 +433,7 @@ def backward_induction(
             train_loss=tl, train_mae=tmae, train_mape=tmape,
             epochs_ran=eps_ran.astype(np.int64),
             params1=params1, params2=params2,
+            params1_by_date=params1_by_date, params2_by_date=params2_by_date,
         )
 
     values = jnp.zeros((n_paths, n_knots), dtype)
@@ -416,6 +441,7 @@ def backward_induction(
 
     phi_cols, psi_cols, var_cols = [], [], []
     tl, tmae, tmape, eps_ran = [], [], [], []
+    p1_snaps, p2_snaps = [], []  # per-date trained params, walk order
 
     # resume from the last completed date if a checkpoint exists (SURVEY.md §5:
     # the reference can only rerun by hand; here a preempted TPU job continues)
@@ -456,6 +482,9 @@ def backward_induction(
                 tmae.append(float(st["train_mae"]))
                 tmape.append(float(st["train_mape"]))
                 eps_ran.append(int(st["epochs_ran"]))
+                p1_snaps.append(st["params1"])
+                if cfg.dual_mode == "separate":
+                    p2_snaps.append(st["params2"])
             params1, params2 = st["params1"], st["params2"]
             if cfg.dual_mode == "shared":
                 params2 = params1
@@ -486,6 +515,9 @@ def backward_induction(
         phi_cols.append(phi_t)
         psi_cols.append(psi_t)
         var_cols.append(var_resid)
+        p1_snaps.append(params1)
+        if cfg.dual_mode == "separate":
+            p2_snaps.append(params2)
 
         tl.append(float(aux1["final_loss"]))
         tmae.append(float(aux1["mae"]))
@@ -517,6 +549,9 @@ def backward_induction(
 
     # ledgers were appended walking t downward; store date-ascending
     stack_asc = lambda cols: jnp.stack(cols[::-1], axis=1)
+    stack_tree_asc = lambda snaps: jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *snaps[::-1]
+    )
     return BackwardResult(
         values=values,
         phi=stack_asc(phi_cols),
@@ -528,4 +563,8 @@ def backward_induction(
         epochs_ran=np.array(eps_ran[::-1]),
         params1=params1,
         params2=params2,
+        params1_by_date=stack_tree_asc(p1_snaps),
+        params2_by_date=(
+            stack_tree_asc(p2_snaps) if cfg.dual_mode == "separate" else None
+        ),
     )

@@ -1,0 +1,114 @@
+"""Out-of-sample hedge replay: evaluate a TRAINED walk on fresh paths.
+
+The reference evaluates its hedge only on the paths it trained on
+(``Replicating_Portfolio.py:224`` reuses the training ``X0``), so its
+residual-P&L and VaR ledgers are in-sample. Here the per-date trained
+parameters captured by the walk (``BackwardResult.params*_by_date``) can be
+replayed on ANY path set — fresh Owen scrambles, stressed scenarios, more
+paths — producing the same ledger structure with no training:
+
+- per-date values ``v_t`` do not chain through training targets (each is a
+  direct prediction at date-t features/prices, RP.py:212/221 semantics), so
+  the replay is a single vmap over dates;
+- the replication residual at date t compares against the REPLAYED next-date
+  value (terminal payoff at the last date), exactly like the training walk's
+  ledger.
+
+This is the honest counterpart of the training ledgers: out-of-sample VaR,
+residual P&L, and an out-of-sample CV/OLS-martingale price (the trained phi
+stays a valid — adapted — control on fresh paths).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.train.backward import (
+    BackwardConfig,
+    BackwardResult,
+    _date_outputs_core,
+    _split_holdings,
+    _stack_prices,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "dual_mode", "holdings_combine"))
+def _replay(model, params1_by_date, params2_by_date, features, prices_all,
+            terminal, cost_of_capital, *, dual_mode, holdings_combine):
+    n_dates = prices_all.shape[1] - 1
+    terminal = terminal.astype(model.dtype)
+
+    def per_date(p1, p2, t):
+        g_pre = (
+            model.value(p1, features[:, t], prices_all[:, t])
+            if dual_mode == "shared" else jnp.zeros((), model.dtype)
+        )
+        # target enters only the var_resid column; the per-date target is the
+        # replayed next-date value, substituted after the vmap below
+        v_t, comb, _ = _date_outputs_core(
+            model, p1, p2, features[:, t], prices_all[:, t],
+            prices_all[:, t + 1], terminal, cost_of_capital, g_pre,
+            dual_mode=dual_mode, holdings_combine=holdings_combine,
+        )
+        return v_t, comb
+
+    v_cols, combs = jax.vmap(per_date, in_axes=(0, 0, 0), out_axes=(1, 1))(
+        params1_by_date, params2_by_date, jnp.arange(n_dates)
+    )
+    values = jnp.concatenate([v_cols, terminal[:, None]], axis=1)
+    # residual vs the replayed next-date value (v_{t+1}; terminal at the end)
+    gains = jnp.sum(combs * prices_all[:, 1:], axis=-1)  # comb_t . prices_{t+1}
+    var_resid = values[:, 1:] - gains
+    phi, psi = _split_holdings(combs)
+    return values, phi, psi, var_resid
+
+
+def replay_walk(
+    model: HedgeMLP,
+    result: BackwardResult,
+    features: jax.Array,    # (n_paths, n_dates+1, n_features) FRESH paths
+    y_prices: jax.Array,    # (n_paths, n_dates+1[, A])
+    b_prices: jax.Array,    # (n_dates+1,)
+    terminal_values: jax.Array,  # (n_paths,)
+    cfg: BackwardConfig,
+) -> BackwardResult:
+    """Replay ``result``'s per-date trained params on fresh paths.
+
+    Returns a ``BackwardResult`` with the replayed ledgers (training metrics
+    carry over unchanged — they describe the original fit, not these paths).
+
+    ``shared`` mode caveat: the stored per-date snapshot is the
+    post-quantile-fit weights (the walk's RP.py:212-217 ordering), so the
+    replayed ``v_t`` collapses to the quantile model's value (``g_pre`` from
+    the pre-quantile weights is not reconstructible); holdings and residuals
+    are unaffected. ``separate``/``mse_only`` replays on the training paths
+    reproduce the training ledgers exactly.
+    """
+    if result.params1_by_date is None:
+        raise ValueError(
+            "result has no per-date params (params1_by_date is None) — "
+            "was it produced by a pre-replay version of the walk?"
+        )
+    prices_all = _stack_prices(
+        jnp.asarray(y_prices, model.dtype), jnp.asarray(b_prices, model.dtype)
+    )
+    p2 = result.params2_by_date
+    values, phi, psi, var_resid = _replay(
+        model, result.params1_by_date,
+        result.params1_by_date if p2 is None else p2,
+        jnp.asarray(features), prices_all, terminal_values,
+        cfg.cost_of_capital,
+        dual_mode=cfg.dual_mode, holdings_combine=cfg.holdings_combine,
+    )
+    return BackwardResult(
+        values=values, phi=phi, psi=psi, var_residuals=var_resid,
+        train_loss=result.train_loss, train_mae=result.train_mae,
+        train_mape=result.train_mape, epochs_ran=result.epochs_ran,
+        params1=result.params1, params2=result.params2,
+        params1_by_date=result.params1_by_date,
+        params2_by_date=result.params2_by_date,
+    )

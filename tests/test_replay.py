@@ -1,0 +1,89 @@
+"""Out-of-sample replay (orp_tpu/train/replay.py + api european_oos)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge, european_oos
+from orp_tpu.models import HedgeMLP
+from orp_tpu.train.backward import BackwardConfig, BackwardResult
+from orp_tpu.train.replay import replay_walk
+
+EURO = EuropeanConfig(constrain_self_financing=False)
+SIM = SimConfig(n_paths=2048, T=1.0, dt=1 / 112, rebalance_every=28)
+
+
+def _train(dual_mode="mse_only", fused=True):
+    return european_hedge(
+        EURO, SIM,
+        TrainConfig(dual_mode=dual_mode, epochs_first=25, epochs_warm=6,
+                    batch_size=1024, lr=1e-3, fused=fused,
+                    shuffle="blocks" if fused else True),
+    )
+
+
+def test_replay_identity_on_training_paths():
+    # mse_only: replaying the per-date params on the SAME paths must
+    # reproduce the training walk's ledgers bit-for-bit (up to f32 assembly)
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=25, epochs_warm=6,
+                         batch_size=1024, lr=1e-3, fused=True, shuffle="blocks")
+    trained = european_hedge(EURO, SIM, tr_cfg)
+    same = european_oos(trained, EURO, SIM, tr_cfg, allow_in_sample=True)
+    for field in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(same.backward, field)),
+            np.asarray(getattr(trained.backward, field)),
+            rtol=1e-6, atol=1e-7, err_msg=field,
+        )
+
+
+def test_replay_identity_separate_mode_host_walk():
+    tr_cfg = TrainConfig(dual_mode="separate", epochs_first=25, epochs_warm=6,
+                         batch_size=1024, lr=1e-3)
+    trained = european_hedge(EURO, SIM, tr_cfg)
+    same = european_oos(trained, EURO, SIM, tr_cfg, allow_in_sample=True)
+    np.testing.assert_allclose(
+        np.asarray(same.backward.values), np.asarray(trained.backward.values),
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_oos_refuses_training_seed():
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=25, epochs_warm=6,
+                         batch_size=1024, lr=1e-3, fused=True, shuffle="blocks")
+    trained = european_hedge(EURO, SIM, tr_cfg)
+    with pytest.raises(ValueError, match="TRAINING seed"):
+        european_oos(trained, EURO, SIM, tr_cfg)
+
+
+def test_oos_fresh_scramble_matches_in_sample_quality():
+    # a 97-param net cannot overfit 2048 paths meaningfully: OOS hedge
+    # quality must be within 50% of in-sample, and the OOS CV price sane
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=25, epochs_warm=6,
+                         batch_size=1024, lr=1e-3, fused=True, shuffle="blocks")
+    trained = european_hedge(EURO, SIM, tr_cfg)
+    fresh = european_oos(
+        trained, EURO, dataclasses.replace(SIM, seed_fund=777), tr_cfg
+    )
+    assert np.isfinite(fresh.report.v0_cv)
+    assert fresh.report.cv_std < trained.report.cv_std * 1.5
+    assert abs(fresh.report.v0_cv - trained.report.v0_cv) / trained.report.v0_cv < 0.02
+    assert fresh.report.v0_acv is not None
+
+
+def test_replay_refuses_result_without_snapshots():
+    model = HedgeMLP(n_features=1)
+    res = BackwardResult(
+        values=jnp.zeros((4, 3)), phi=jnp.zeros((4, 2)), psi=jnp.zeros((4, 2)),
+        var_residuals=jnp.zeros((4, 2)), train_loss=np.zeros(2),
+        train_mae=np.zeros(2), train_mape=np.zeros(2),
+        epochs_ran=np.zeros(2, np.int64),
+    )
+    with pytest.raises(ValueError, match="per-date params"):
+        replay_walk(
+            model, res, jnp.zeros((4, 3, 1)), jnp.ones((4, 3)),
+            jnp.ones(3), jnp.zeros(4), BackwardConfig(),
+        )
