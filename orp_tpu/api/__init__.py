@@ -16,6 +16,7 @@ from orp_tpu.api.pipelines import (
     european_hedge,
     european_oos,
     heston_hedge,
+    heston_oos,
     pension_hedge,
     replicating_portfolio,
     replicating_portfolio_sv,
@@ -36,6 +37,7 @@ __all__ = [
     "european_hedge",
     "european_oos",
     "heston_hedge",
+    "heston_oos",
     "pension_hedge",
     "replicating_portfolio",
     "replicating_portfolio_sv",

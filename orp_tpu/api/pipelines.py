@@ -106,6 +106,68 @@ def _attach_cv_price(report, res: BackwardResult, s, payoff, r, times,
     )
 
 
+
+
+def _simulate_euro_paths(euro: EuropeanConfig, sim: SimConfig, mesh, grid, name: str):
+    """The euro pipelines' path sim (engine branch shared by hedge + oos)."""
+    dtype = jnp.dtype(sim.dtype)
+    if sim.engine == "pallas":
+        _check_pallas(sim, mesh, name)
+        return gbm_log_pallas(
+            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
+            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
+            block_paths=min(2048, sim.n_paths),
+        ).astype(dtype)
+    idx = path_indices(sim.n_paths, mesh)
+    return simulate_gbm_log(
+        idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
+        scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
+    )
+
+
+def _simulate_heston_paths(h: HestonConfig, sim: SimConfig, mesh, grid, name: str):
+    """The heston pipelines' path sim (engine branch shared by hedge + oos)."""
+    if sim.engine == "pallas":
+        _check_pallas(sim, mesh, name)
+        return heston_log_pallas(
+            sim.n_paths, sim.n_steps, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa,
+            theta=h.theta, xi=h.xi, rho=h.rho, dt=grid.dt, seed=sim.seed_fund,
+            store_every=sim.rebalance_every,
+            block_paths=min(1024, sim.n_paths),
+        )
+    idx = path_indices(sim.n_paths, mesh)
+    return simulate_heston_log(
+        idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
+        xi=h.xi, rho=h.rho, seed=sim.seed_fund,
+        scramble=sim.scramble, store_every=sim.rebalance_every,
+        dtype=jnp.dtype(sim.dtype),
+    )
+
+
+def _check_oos_args(name, trained, sim, train, allow_in_sample):
+    """Shared *_oos guards: training-seed reuse and combine-semantics drift."""
+    if (not allow_in_sample and trained.sim_seed is not None
+            and sim.seed_fund == trained.sim_seed):
+        raise ValueError(
+            f"{name}: sim.seed_fund={sim.seed_fund} is the TRAINING seed — "
+            "these are the in-sample paths, not out-of-sample. Pass a "
+            "different seed_fund, or allow_in_sample=True for a replay-"
+            "identity check"
+        )
+    if trained.dual_mode is not None and train.dual_mode != trained.dual_mode:
+        raise ValueError(
+            f"{name}: train.dual_mode={train.dual_mode!r} does not match the "
+            f"training run's {trained.dual_mode!r} — the replay would apply "
+            "the wrong value-combine to the stored params"
+        )
+    if (trained.holdings_combine is not None
+            and train.holdings_combine != trained.holdings_combine):
+        raise ValueError(
+            f"{name}: train.holdings_combine={train.holdings_combine!r} does "
+            f"not match the training run's {trained.holdings_combine!r}"
+        )
+
+
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
     return BackwardConfig(
         epochs_first=t.epochs_first,
@@ -136,7 +198,12 @@ class PipelineResult:
     times: np.ndarray               # rebalance-knot times (n_dates+1,)
     adjustment_factor: float
     sim_seed: int | None = None     # seed_fund the run simulated with —
-    # lets european_oos refuse a fresh-paths evaluation on the training seed
+    # lets the *_oos entry points refuse a fresh-paths evaluation on the
+    # training seed
+    dual_mode: str | None = None    # training combine semantics — *_oos
+    # validates its `train` argument against these to prevent replaying
+    # separately-trained params under the wrong value-combine
+    holdings_combine: str | None = None
 
     @property
     def v0(self) -> float:
@@ -175,19 +242,7 @@ def european_hedge(
     _check_quantile_method(quantile_method)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    if sim.engine == "pallas":
-        _check_pallas(sim, mesh, "european_hedge")
-        s = gbm_log_pallas(
-            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
-            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
-            block_paths=min(2048, sim.n_paths),
-        ).astype(dtype)
-    else:
-        idx = path_indices(sim.n_paths, mesh)
-        s = simulate_gbm_log(
-            idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
-            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
-        )
+    s = _simulate_euro_paths(euro, sim, mesh, grid, "european_hedge")
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
@@ -223,7 +278,9 @@ def european_hedge(
     _attach_cv_price(report, res, s, payoff, euro.r, times,
                      strike_over_s0=euro.strike / euro.s0)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                           sim_seed=sim.seed_fund)
+                           sim_seed=sim.seed_fund,
+                           dual_mode=train.dual_mode,
+                           holdings_combine=train.holdings_combine)
 
 
 def european_oos(
@@ -252,31 +309,12 @@ def european_oos(
     from orp_tpu.train.replay import replay_walk
 
     _check_quantile_method(quantile_method)
-    if (not allow_in_sample and trained.sim_seed is not None
-            and sim.seed_fund == trained.sim_seed):
-        raise ValueError(
-            f"european_oos: sim.seed_fund={sim.seed_fund} is the TRAINING "
-            "seed — these are the in-sample paths, not out-of-sample. Pass a "
-            "different seed_fund, or allow_in_sample=True for a replay-"
-            "identity check"
-        )
+    _check_oos_args("european_oos", trained, sim, train, allow_in_sample)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    if sim.engine == "pallas":
-        # honour the training engine: pallas and scan agree only to ~3e-5,
-        # so an engine mismatch would silently break the replay identity
-        _check_pallas(sim, mesh, "european_oos")
-        s = gbm_log_pallas(
-            sim.n_paths, sim.n_steps, s0=euro.s0, drift=euro.r, sigma=euro.sigma,
-            dt=grid.dt, seed=sim.seed_fund, store_every=sim.rebalance_every,
-            block_paths=min(2048, sim.n_paths),
-        ).astype(dtype)
-    else:
-        idx = path_indices(sim.n_paths, mesh)
-        s = simulate_gbm_log(
-            idx, grid, euro.s0, euro.r, euro.sigma, sim.seed_fund,
-            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
-        )
+    # the helper honours the training engine: pallas and scan agree only to
+    # ~3e-5, so an engine mismatch would silently break the replay identity
+    s = _simulate_euro_paths(euro, sim, mesh, grid, "european_oos")
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
@@ -305,7 +343,9 @@ def european_oos(
     _attach_cv_price(report, res, s, payoff, euro.r, times,
                      strike_over_s0=euro.strike / euro.s0)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                           sim_seed=sim.seed_fund)
+                           sim_seed=sim.seed_fund,
+                           dual_mode=train.dual_mode,
+                           holdings_combine=train.holdings_combine)
 
 
 def heston_hedge(
@@ -325,21 +365,7 @@ def heston_hedge(
     h = heston or HestonConfig()
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    if sim.engine == "pallas":
-        _check_pallas(sim, mesh, "heston_hedge")
-        traj = heston_log_pallas(
-            sim.n_paths, sim.n_steps, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa,
-            theta=h.theta, xi=h.xi, rho=h.rho, dt=grid.dt, seed=sim.seed_fund,
-            store_every=sim.rebalance_every,
-            block_paths=min(1024, sim.n_paths),
-        )
-    else:
-        idx = path_indices(sim.n_paths, mesh)
-        traj = simulate_heston_log(
-            idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
-            xi=h.xi, rho=h.rho, seed=sim.seed_fund,
-            scramble=sim.scramble, store_every=sim.rebalance_every, dtype=dtype,
-        )
+    traj = _simulate_heston_paths(h, sim, mesh, grid, "heston_hedge")
     s, v = traj["S"], traj["v"]
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, h.r, dtype)
@@ -363,7 +389,53 @@ def heston_hedge(
     _attach_cv_price(report, res, s, payoff, h.r, times,
                      strike_over_s0=h.strike / h.s0)
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                           sim_seed=sim.seed_fund)
+                           sim_seed=sim.seed_fund,
+                           dual_mode=train.dual_mode,
+                           holdings_combine=train.holdings_combine)
+
+
+def heston_oos(
+    trained: PipelineResult,
+    heston: HestonConfig | None = None,
+    sim: SimConfig = SimConfig(n_paths=1 << 16, T=1.0, dt=1 / 364, rebalance_every=7),
+    train: TrainConfig = TrainConfig(dual_mode="mse_only"),
+    *,
+    mesh=None,
+    quantile_method: str = "sort",
+    allow_in_sample: bool = False,
+) -> PipelineResult:
+    """Out-of-sample evaluation of a trained Heston hedge on fresh scrambles
+    (same contract as ``european_oos``; see ``orp_tpu/train/replay.py``)."""
+    from orp_tpu.train.replay import replay_walk
+
+    _check_quantile_method(quantile_method)
+    _check_oos_args("heston_oos", trained, sim, train, allow_in_sample)
+    h = heston or HestonConfig()
+    dtype = jnp.dtype(sim.dtype)
+    grid = TimeGrid(sim.T, sim.n_steps)
+    traj = _simulate_heston_paths(h, sim, mesh, grid, "heston_oos")
+    s, v = traj["S"], traj["v"]
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, h.r, dtype)
+    payoff = payoffs.european(s[:, -1], h.strike, h.option_type)
+    s0 = h.s0
+    model = HedgeMLP(n_features=2)
+    res = replay_walk(
+        model, trained.backward, jnp.stack([s / s0, v], axis=-1),
+        s / s0, b / s0, payoff / s0, _backward_cfg(train),
+    )
+    times = np.asarray(coarse.times())
+    report = build_report(
+        res, terminal_payoff=payoff / s0, r=h.r, times=times,
+        adjustment_factor=s0, holdings_adjustment=1.0,
+        quantile_method=quantile_method,
+    )
+    _attach_cv_price(report, res, s, payoff, h.r, times,
+                     strike_over_s0=h.strike / h.s0)
+    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                          sim_seed=sim.seed_fund,
+                           dual_mode=train.dual_mode,
+                           holdings_combine=train.holdings_combine)
 
 
 def basket_hedge(
@@ -474,7 +546,9 @@ def basket_hedge(
         basket.sigmas, basket.corr(), sim.T,
     )[0]
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
-                           sim_seed=sim.seed_fund)
+                           sim_seed=sim.seed_fund,
+                           dual_mode=train.dual_mode,
+                           holdings_combine=train.holdings_combine)
 
 
 # ---------------------------------------------------------------------------

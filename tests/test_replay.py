@@ -87,3 +87,24 @@ def test_replay_refuses_result_without_snapshots():
             model, res, jnp.zeros((4, 3, 1)), jnp.ones((4, 3)),
             jnp.ones(3), jnp.zeros(4), BackwardConfig(),
         )
+
+
+def test_heston_oos_identity_and_fresh():
+    from orp_tpu.api import heston_oos, heston_hedge
+
+    sim = dataclasses.replace(SIM, n_paths=2048)
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=5,
+                         batch_size=1024, lr=1e-3, fused=True, shuffle="blocks")
+    trained = heston_hedge(sim=sim, train=tr_cfg)
+    same = heston_oos(trained, sim=sim, train=tr_cfg, allow_in_sample=True)
+    for field in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(same.backward, field)),
+            np.asarray(getattr(trained.backward, field)),
+            rtol=1e-6, atol=1e-7, err_msg=field,
+        )
+    fresh = heston_oos(
+        trained, sim=dataclasses.replace(sim, seed_fund=999), train=tr_cfg
+    )
+    assert np.isfinite(fresh.report.v0_cv)
+    assert fresh.report.cv_std < trained.report.cv_std * 1.5

@@ -3,11 +3,14 @@ in ONE process (the axon tunnel grants the chip per interpreter, and flaky
 tunnels make many short processes risky — see .claude/skills/verify).
 
 Runs, in order, appending one JSON line each to the output file:
-  1. north_star (fused walk)   - the headline 1M-path 52-date hedge
-  2. profile                   - stage breakdown incl. fused cold/warm
-  3. scaling paths-sweep       - fused walk wall vs path count
-  4. binomial bench            - sampler crossover on the chip
-  5. baseline configs 1,2,4    - quick oracle-checked configs
+  1. north_star (fused walk)  - the headline 1M-path 52-date hedge, run
+                                TWICE: payload {"cold": {...}, "warm": {...}}
+                                (cold includes the one-time compile)
+  2. rqmc_ci                  - 8-scramble price CI at 1M paths/scramble
+  3. profile                  - stage breakdown incl. fused cold/warm
+  4. scaling paths-sweep      - fused walk wall vs path count
+  5. binomial bench           - sampler crossover on the chip
+  6. baseline configs 1,2,4   - quick oracle-checked configs
 
 Usage: python tools/tpu_measure_all.py [out=TPU_MEASURE.jsonl]
 Partial results survive a mid-run tunnel death: each stage flushes its line
@@ -54,7 +57,22 @@ def main(out_path):
     def north():
         from benchmarks.north_star import main as ns
 
-        return ns(quiet=True)
+        # run TWICE: first populates/validates the compile cache (cold),
+        # second is the steady-state number the <60s target is about
+        cold = ns(quiet=True)
+        warm = ns(quiet=True)
+        return {"cold": cold, "warm": warm}
+
+    def rqmc():
+        import io
+        from contextlib import redirect_stdout
+
+        from tools.rqmc_ci import main as ci
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            ci(["--paths-log2", "20", "--scrambles", "8"])
+        return json.loads(buf.getvalue().strip().splitlines()[-1])
 
     def profile():
         import io
@@ -98,7 +116,12 @@ def main(out_path):
         return {"rows": [bc.config_1_single_step(), bc.config_2_multi_step_100k(),
                          bc.config_4_heston()]}
 
+    # value-ordered: the headline wall/accuracy numbers land first so a
+    # mid-run tunnel death (SCALING.md §5) still leaves the round's key
+    # evidence in the file (all stages here use the scan engine; Pallas
+    # shapes are probed separately via tools/pallas_bisect.py)
     stage("north_star", north)
+    stage("rqmc_ci", rqmc)
     stage("profile", profile)
     stage("paths_sweep", paths_sweep)
     stage("binomial", binom)
