@@ -13,11 +13,13 @@ from orp_tpu.api.config import (
 )
 from orp_tpu.api.pipelines import (
     basket_hedge,
+    basket_oos,
     european_hedge,
     european_oos,
     heston_hedge,
     heston_oos,
     pension_hedge,
+    pension_oos,
     replicating_portfolio,
     replicating_portfolio_sv,
     sigma_sweep,
@@ -34,11 +36,13 @@ __all__ = [
     "StochVolConfig",
     "TrainConfig",
     "basket_hedge",
+    "basket_oos",
     "european_hedge",
     "european_oos",
     "heston_hedge",
     "heston_oos",
     "pension_hedge",
+    "pension_oos",
     "replicating_portfolio",
     "replicating_portfolio_sv",
     "sigma_sweep",

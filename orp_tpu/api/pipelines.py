@@ -144,14 +144,18 @@ def _simulate_heston_paths(h: HestonConfig, sim: SimConfig, mesh, grid, name: st
     )
 
 
-def _check_oos_args(name, trained, sim, train, allow_in_sample):
-    """Shared *_oos guards: training-seed reuse and combine-semantics drift."""
+def _check_oos_args(name, trained, seed, train, allow_in_sample,
+                    seed_field="seed_fund"):
+    """Shared *_oos guards: training-seed reuse and combine-semantics drift.
+
+    ``seed`` is the fresh run's path-sim seed (``sim.seed_fund`` for the
+    risk-neutral pipelines, ``sim.seed`` for the pension one)."""
     if (not allow_in_sample and trained.sim_seed is not None
-            and sim.seed_fund == trained.sim_seed):
+            and seed == trained.sim_seed):
         raise ValueError(
-            f"{name}: sim.seed_fund={sim.seed_fund} is the TRAINING seed — "
+            f"{name}: sim.{seed_field}={seed} is the TRAINING seed — "
             "these are the in-sample paths, not out-of-sample. Pass a "
-            "different seed_fund, or allow_in_sample=True for a replay-"
+            f"different {seed_field}, or allow_in_sample=True for a replay-"
             "identity check"
         )
     if trained.dual_mode is not None and train.dual_mode != trained.dual_mode:
@@ -309,7 +313,7 @@ def european_oos(
     from orp_tpu.train.replay import replay_walk
 
     _check_quantile_method(quantile_method)
-    _check_oos_args("european_oos", trained, sim, train, allow_in_sample)
+    _check_oos_args("european_oos", trained, sim.seed_fund, train, allow_in_sample)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     # the helper honours the training engine: pallas and scan agree only to
@@ -409,7 +413,7 @@ def heston_oos(
     from orp_tpu.train.replay import replay_walk
 
     _check_quantile_method(quantile_method)
-    _check_oos_args("heston_oos", trained, sim, train, allow_in_sample)
+    _check_oos_args("heston_oos", trained, sim.seed_fund, train, allow_in_sample)
     h = heston or HestonConfig()
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
@@ -436,6 +440,76 @@ def heston_oos(
                           sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
                            holdings_combine=train.holdings_combine)
+
+
+
+def _basket_setup(basket: BasketConfig, sim: SimConfig, mesh, instruments, name):
+    """Basket pipelines' shared sim + normalisation (hedge + oos)."""
+    if sim.engine == "pallas":
+        raise ValueError(f"{name}: engine='pallas' not available; use 'scan'")
+    if instruments not in ("basket", "assets"):
+        raise ValueError(
+            f"instruments={instruments!r}: expected 'basket' or 'assets'"
+        )
+    dtype = jnp.dtype(sim.dtype)
+    grid = TimeGrid(sim.T, sim.n_steps)
+    A = len(basket.s0)
+    idx = path_indices(sim.n_paths, mesh)
+    s = simulate_gbm_basket(
+        idx, grid, s0=jnp.asarray(basket.s0), drift=jnp.full(A, basket.r),
+        sigma=jnp.asarray(basket.sigmas), corr=jnp.asarray(basket.corr()),
+        seed=sim.seed_fund, scramble=sim.scramble,
+        store_every=sim.rebalance_every, dtype=dtype,
+    )
+    w = jnp.asarray(basket.weights, dtype)
+    bkt = s @ w
+    coarse = grid.reduced(sim.rebalance_every)
+    b = bond_curve(coarse, basket.r, dtype)
+    payoff = payoffs.basket_call(s[:, -1], w, basket.strike)
+    norm = basket.strike
+    # A=1: the "vector" hedge IS the basket hedge (one risky leg + bond), and
+    # the 2-output head's ledgers are scalar — route it through the basket
+    # branch instead of crashing on a phantom asset axis
+    vector = instruments == "assets" and A > 1
+    model = (HedgeMLP(n_features=A, n_hedge_assets=A) if vector
+             else HedgeMLP(n_features=A))
+    hedge_prices = (s / norm) if vector else (bkt / norm)
+    return dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model, hedge_prices
+
+
+def _basket_report(basket, sim, res, s, w, bkt, coarse, b, payoff, norm,
+                   vector, quantile_method):
+    """Basket pipelines' shared report assembly (hedge + oos)."""
+    dtype = jnp.dtype(sim.dtype)
+    times = np.asarray(coarse.times())
+    if vector:
+        # scalar ledger view for the report: the value-equivalent basket
+        # holding (same portfolio value, expressed in basket units)
+        phi_eq = jnp.sum(res.phi * (s[:, :-1] / norm), axis=-1) / (
+            bkt[:, :-1] / norm
+        )
+        res_view = dataclasses.replace(res, phi=phi_eq)
+    else:
+        res_view = res
+    report = build_report(
+        res_view, terminal_payoff=payoff / norm, r=basket.r, times=times,
+        adjustment_factor=norm, holdings_adjustment=1.0,
+        quantile_method=quantile_method,
+    )
+    # per-asset martingale CV under the vector hedge; basket martingale else.
+    # controls normalise each instrument by ITS OWN initial price, so the
+    # basis kink belongs at strike / initial-basket-level (norm is the
+    # strike itself, which would pin the kink at 1.0 regardless of moneyness)
+    b0 = float(jnp.dot(jnp.asarray(basket.s0, dtype), w))
+    _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r,
+                     times, strike_over_s0=basket.strike / b0)
+    from orp_tpu.utils.basket import basket_call_mm
+
+    report.oracle_mm = basket_call_mm(
+        basket.s0, basket.weights, basket.strike, basket.r,
+        basket.sigmas, basket.corr(), sim.T,
+    )[0]
+    return report, times
 
 
 def basket_hedge(
@@ -468,45 +542,16 @@ def basket_hedge(
     ``oracle_mm``. Scan engine only (the Pallas kernels cover the
     single-asset systems)."""
     _check_quantile_method(quantile_method)
-    if sim.engine == "pallas":
-        raise ValueError("basket_hedge: engine='pallas' not available; use 'scan'")
-    if instruments not in ("basket", "assets"):
-        raise ValueError(
-            f"instruments={instruments!r}: expected 'basket' or 'assets'"
-        )
-    dtype = jnp.dtype(sim.dtype)
-    grid = TimeGrid(sim.T, sim.n_steps)
-    A = len(basket.s0)
-    idx = path_indices(sim.n_paths, mesh)
-    s = simulate_gbm_basket(
-        idx, grid, s0=jnp.asarray(basket.s0), drift=jnp.full(A, basket.r),
-        sigma=jnp.asarray(basket.sigmas), corr=jnp.asarray(basket.corr()),
-        seed=sim.seed_fund, scramble=sim.scramble,
-        store_every=sim.rebalance_every, dtype=dtype,
-    )
-    w = jnp.asarray(basket.weights, dtype)
-    bkt = s @ w  # (n, knots) tradeable basket price
-    coarse = grid.reduced(sim.rebalance_every)
-    b = bond_curve(coarse, basket.r, dtype)
-    payoff = payoffs.basket_call(s[:, -1], w, basket.strike)
-
-    norm = basket.strike  # normalise all values/prices to strike units
-    # A=1: the "vector" hedge IS the basket hedge (one risky leg + bond), and
-    # the 2-output head's ledgers are scalar — route it through the basket
-    # branch instead of crashing on a phantom asset axis
-    vector = instruments == "assets" and A > 1
+    (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
+     hedge_prices) = _basket_setup(basket, sim, mesh, instruments, "basket_hedge")
     e_payoff_n = float(jnp.mean(payoff)) / norm
     if vector:
-        model = HedgeMLP(n_features=A, n_hedge_assets=A)
-        hedge_prices = s / norm           # (n, knots, A)
         # normalised prices are ~s0_i/norm at t=0: spread the expected payoff
         # evenly across the A risky legs
         bias = tuple(
             e_payoff_n / (A * s0_i / norm) for s0_i in basket.s0
         ) + (0.0,)
     else:
-        model = HedgeMLP(n_features=A)
-        hedge_prices = bkt / norm         # (n, knots)
         bias = (e_payoff_n, 0.0)
     res = backward_induction(
         model,
@@ -517,34 +562,10 @@ def basket_hedge(
         _backward_cfg(train),
         bias_init=bias,
     )
-    times = np.asarray(coarse.times())
-    if vector:
-        # scalar ledger view for the report: the value-equivalent basket
-        # holding (same portfolio value, expressed in basket units)
-        phi_eq = jnp.sum(res.phi * (s[:, :-1] / norm), axis=-1) / (
-            bkt[:, :-1] / norm
-        )
-        res_view = dataclasses.replace(res, phi=phi_eq)
-    else:
-        res_view = res
-    report = build_report(
-        res_view, terminal_payoff=payoff / norm, r=basket.r, times=times,
-        adjustment_factor=norm, holdings_adjustment=1.0,
-        quantile_method=quantile_method,
+    report, times = _basket_report(
+        basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
+        quantile_method,
     )
-    # per-asset martingale CV under the vector hedge; basket martingale else
-    # controls normalise each instrument by ITS OWN initial price, so the
-    # basis kink belongs at strike / initial-basket-level (norm is the
-    # strike itself, which would pin the kink at 1.0 regardless of moneyness)
-    b0 = float(jnp.dot(jnp.asarray(basket.s0, dtype), w))
-    _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r,
-                     times, strike_over_s0=basket.strike / b0)
-    from orp_tpu.utils.basket import basket_call_mm
-
-    report.oracle_mm = basket_call_mm(
-        basket.s0, basket.weights, basket.strike, basket.r,
-        basket.sigmas, basket.corr(), sim.T,
-    )[0]
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
@@ -554,6 +575,79 @@ def basket_hedge(
 # ---------------------------------------------------------------------------
 # Pension-liability pipeline (Replicating_Portfolio / _SV)
 # ---------------------------------------------------------------------------
+
+
+
+def _simulate_pension_paths(cfg: HedgeRunConfig, mesh, grid, name: str):
+    """The pension pipelines' path sim (engine + SV branch shared by
+    hedge + oos)."""
+    m, a, s = cfg.market, cfg.actuarial, cfg.sim
+    sv = cfg.sv
+    sde_kw = dict(
+        y0=m.y0, mu=m.mu, sigma=None if sv else m.sigma,
+        l0=a.l0, mort_c=a.mort_c, eta=a.eta, n0=float(a.n0),
+        seed=s.seed, store_every=s.rebalance_every,
+        sv=sv is not None,
+        v0=sv.v0 if sv else 0.0,
+        cir_a=sv.a if sv else 0.0,
+        cir_b=sv.b if sv else 0.0,
+        cir_c=sv.c if sv else 0.0,
+        cir_drift_times_dt=sv.drift_times_dt if sv else False,
+    )
+    if s.engine == "pallas":
+        _check_pallas(s, mesh, name)
+        if s.binomial_mode == "exact":
+            raise ValueError(
+                f"{name}: engine='pallas' supports binomial_mode "
+                "'normal' or 'inversion' (the exact stateless-binomial draw "
+                "needs threefry and stays on the scan path); got "
+                f"binomial_mode={s.binomial_mode!r}"
+            )
+        return pension_pallas(
+            s.n_paths, s.n_steps, dt=grid.dt,
+            block_paths=min(1024, s.n_paths),
+            binomial_mode=s.binomial_mode, **sde_kw,
+        )
+    idx = path_indices(s.n_paths, mesh)
+    return simulate_pension(
+        idx, grid, scramble=s.scramble, dtype=jnp.dtype(s.dtype),
+        binomial_mode=s.binomial_mode, **sde_kw,
+    )
+
+
+
+def basket_oos(
+    trained: PipelineResult,
+    basket: BasketConfig = BasketConfig(),
+    sim: SimConfig = SimConfig(n_paths=1 << 17, T=1.0, dt=1 / 52, rebalance_every=1),
+    train: TrainConfig = TrainConfig(dual_mode="mse_only"),
+    *,
+    mesh=None,
+    quantile_method: str = "sort",
+    instruments: str = "basket",
+    allow_in_sample: bool = False,
+) -> PipelineResult:
+    """Out-of-sample evaluation of a trained basket hedge on fresh scrambles
+    (same contract as ``european_oos``; ``instruments`` must match the
+    training run — the stored per-date params carry that head shape)."""
+    from orp_tpu.train.replay import replay_walk
+
+    _check_quantile_method(quantile_method)
+    _check_oos_args("basket_oos", trained, sim.seed_fund, train, allow_in_sample)
+    (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
+     hedge_prices) = _basket_setup(basket, sim, mesh, instruments, "basket_oos")
+    res = replay_walk(
+        model, trained.backward, s / jnp.asarray(basket.s0, dtype),
+        hedge_prices, b / norm, payoff / norm, _backward_cfg(train),
+    )
+    report, times = _basket_report(
+        basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
+        quantile_method,
+    )
+    return PipelineResult(report=report, backward=res, times=times,
+                          adjustment_factor=norm, sim_seed=sim.seed_fund,
+                          dual_mode=train.dual_mode,
+                          holdings_combine=train.holdings_combine)
 
 
 def pension_hedge(
@@ -572,38 +666,7 @@ def pension_hedge(
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
 
-    sv = cfg.sv
-    sde_kw = dict(
-        y0=m.y0, mu=m.mu, sigma=None if sv else m.sigma,
-        l0=a.l0, mort_c=a.mort_c, eta=a.eta, n0=float(a.n0),
-        seed=s.seed, store_every=s.rebalance_every,
-        sv=sv is not None,
-        v0=sv.v0 if sv else 0.0,
-        cir_a=sv.a if sv else 0.0,
-        cir_b=sv.b if sv else 0.0,
-        cir_c=sv.c if sv else 0.0,
-        cir_drift_times_dt=sv.drift_times_dt if sv else False,
-    )
-    if s.engine == "pallas":
-        _check_pallas(s, mesh, "pension_hedge")
-        if s.binomial_mode == "exact":
-            raise ValueError(
-                "pension_hedge: engine='pallas' supports binomial_mode "
-                "'normal' or 'inversion' (the exact stateless-binomial draw "
-                "needs threefry and stays on the scan path); got "
-                f"binomial_mode={s.binomial_mode!r}"
-            )
-        traj = pension_pallas(
-            s.n_paths, s.n_steps, dt=grid.dt,
-            block_paths=min(1024, s.n_paths),
-            binomial_mode=s.binomial_mode, **sde_kw,
-        )
-    else:
-        idx = path_indices(s.n_paths, mesh)
-        traj = simulate_pension(
-            idx, grid, scramble=s.scramble, dtype=dtype,
-            binomial_mode=s.binomial_mode, **sde_kw,
-        )
+    traj = _simulate_pension_paths(cfg, mesh, grid, "pension_hedge")
     y, lam, pop = traj["Y"], traj["lam"], traj["N"]
     coarse = grid.reduced(s.rebalance_every)
     b = bond_curve(coarse, m.r, dtype)
@@ -631,7 +694,59 @@ def pension_hedge(
         quantile_method=quantile_method,
     )
     return PipelineResult(
-        report=report, backward=res, times=times, adjustment_factor=adjustment
+        report=report, backward=res, times=times, adjustment_factor=adjustment,
+        sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
+        holdings_combine=cfg.train.holdings_combine,
+    )
+
+
+
+def pension_oos(
+    trained: PipelineResult,
+    cfg: HedgeRunConfig = HedgeRunConfig(),
+    *,
+    mesh=None,
+    quantile_method: str = "sort",
+    allow_in_sample: bool = False,
+) -> PipelineResult:
+    """Out-of-sample evaluation of a trained pension hedge on fresh paths.
+
+    Pass the trained ``pension_hedge`` result plus a ``cfg`` whose
+    ``sim.seed`` differs (fresh Sobol scrambles for all three factor
+    streams); everything else in ``cfg`` must match the training run. Same
+    contract as ``european_oos``; in ``shared`` mode the replayed values
+    carry the post-quantile snapshot caveat of ``train/replay.py``.
+    """
+    from orp_tpu.train.replay import replay_walk
+
+    _check_quantile_method(quantile_method)
+    m, a, s = cfg.market, cfg.actuarial, cfg.sim
+    _check_oos_args("pension_oos", trained, s.seed, cfg.train,
+                    allow_in_sample, seed_field="seed")
+    dtype = jnp.dtype(s.dtype)
+    grid = TimeGrid(s.T, s.n_steps)
+    traj = _simulate_pension_paths(cfg, mesh, grid, "pension_oos")
+    y, lam, pop = traj["Y"], traj["lam"], traj["N"]
+    coarse = grid.reduced(s.rebalance_every)
+    b = bond_curve(coarse, m.r, dtype)
+    pop_n = pop / a.n0
+    payoff_y = payoffs.pension_floor(y[:, -1], a.guarantee)
+    terminal = payoff_y * pop_n[:, -1]
+    model = HedgeMLP(n_features=3)
+    res = replay_walk(
+        model, trained.backward, jnp.stack([y, pop_n, lam], axis=-1),
+        y, b, terminal, _backward_cfg(cfg.train),
+    )
+    adjustment = a.n0 * a.premium
+    times = np.asarray(coarse.times())
+    report = build_report(
+        res, terminal_payoff=terminal, r=m.r, times=times,
+        adjustment_factor=adjustment, quantile_method=quantile_method,
+    )
+    return PipelineResult(
+        report=report, backward=res, times=times, adjustment_factor=adjustment,
+        sim_seed=s.seed, dual_mode=cfg.train.dual_mode,
+        holdings_combine=cfg.train.holdings_combine,
     )
 
 

@@ -108,3 +108,54 @@ def test_heston_oos_identity_and_fresh():
     )
     assert np.isfinite(fresh.report.v0_cv)
     assert fresh.report.cv_std < trained.report.cv_std * 1.5
+
+
+def test_pension_oos_identity_and_guards():
+    from orp_tpu.api import HedgeRunConfig, pension_hedge, pension_oos
+
+    cfg = HedgeRunConfig()
+    cfg = dataclasses.replace(
+        cfg,
+        sim=dataclasses.replace(cfg.sim, n_paths=1024, dt=1 / 12,
+                                rebalance_every=12),
+        train=dataclasses.replace(
+            cfg.train, dual_mode="mse_only", epochs_first=15, epochs_warm=4,
+            batch_size=512, lr=1e-3, fused=True, shuffle="blocks",
+        ),
+    )
+    trained = pension_hedge(cfg)
+    same = pension_oos(trained, cfg, allow_in_sample=True)
+    for field in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(same.backward, field)),
+            np.asarray(getattr(trained.backward, field)),
+            rtol=1e-6, atol=1e-7, err_msg=field,
+        )
+    with pytest.raises(ValueError, match="TRAINING seed"):
+        pension_oos(trained, cfg)
+    fresh_cfg = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, seed=555))
+    fresh = pension_oos(trained, fresh_cfg)
+    assert np.isfinite(fresh.report.v0)
+    assert fresh.report.residual_stats["std"] < trained.report.residual_stats["std"] * 2
+
+
+def test_basket_oos_identity_vector_hedge():
+    from orp_tpu.api import BasketConfig, basket_hedge, basket_oos
+
+    sim = SimConfig(n_paths=1024, T=1.0, dt=1 / 13, rebalance_every=1)
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=12, epochs_warm=4,
+                         batch_size=512, lr=1e-3, fused=True, shuffle="blocks")
+    trained = basket_hedge(BasketConfig(), sim, tr_cfg, instruments="assets")
+    same = basket_oos(trained, BasketConfig(), sim, tr_cfg,
+                      instruments="assets", allow_in_sample=True)
+    for field in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(same.backward, field)),
+            np.asarray(getattr(trained.backward, field)),
+            rtol=1e-6, atol=1e-7, err_msg=field,
+        )
+    fresh = basket_oos(trained, BasketConfig(),
+                       dataclasses.replace(sim, seed_fund=424242), tr_cfg,
+                       instruments="assets")
+    assert np.isfinite(fresh.report.v0_cv)
