@@ -63,6 +63,25 @@ def _add_train_flags(p):
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 
+def _add_oos_flag(p):
+    # only on the four hedge commands with an *_oos counterpart (NOT sweep
+    # or calibrate — the flag would be silently ignored there)
+    p.add_argument("--oos-seed", type=int, default=None,
+                   help="after training, re-evaluate the hedge on a fresh "
+                        "Owen scramble with this seed (out-of-sample VaR / "
+                        "residual P&L / prices)")
+
+
+def _check_oos_seed(args, training_seed: int, field: str) -> None:
+    """Fail the seed collision BEFORE the expensive sim+training run."""
+    if args.oos_seed is not None and args.oos_seed == training_seed:
+        raise SystemExit(
+            f"error: --oos-seed {args.oos_seed} equals the training "
+            f"{field} ({training_seed}) — those are the in-sample paths; "
+            "pick a different seed"
+        )
+
+
 def _add_quantile_flag(p):
     # only on commands whose output carries VaR/fan quantiles (NOT sweep,
     # which reports phi/psi rows only — a flag there would be silently ignored)
@@ -71,7 +90,9 @@ def _add_quantile_flag(p):
                         "two-pass histogram (O(bins) comms; for 1M+ paths)")
 
 
-def _emit(args, report, extra=None):
+def _emit(args, report, extra=None, prefix=""):
+    """Emit one result line; ``prefix`` namespaces the JSON keys (the
+    out-of-sample line uses ``oos_`` so both lines share ONE field set)."""
     if args.json:
         out = {
             "v0": report.v0,
@@ -80,6 +101,7 @@ def _emit(args, report, extra=None):
             "discounted_payoff": report.discounted_payoff,
             "var_overall": report.var_overall.tolist(),
             "var_qs": list(report.var_qs),
+            "residual_std": report.residual_stats["std"],
         }
         if report.v0_cv is not None:
             out.update(v0_plain=report.v0_plain, v0_cv=report.v0_cv, cv_std=report.cv_std)
@@ -87,28 +109,39 @@ def _emit(args, report, extra=None):
             out.update(v0_acv=report.v0_acv, acv_std=report.acv_std)
         if extra:
             out.update(extra)
-        print(json.dumps(out))
+        print(json.dumps({prefix + k: v for k, v in out.items()}))
     else:
+        if prefix:
+            print(f"--- {prefix.rstrip('_')} (fresh scramble) ---")
         print(report.summary())
 
 
-def cmd_euro(args):
-    from orp_tpu.api import EuropeanConfig, SimConfig, european_hedge
+def _emit_oos(args, oos_report):
+    _emit(args, oos_report, prefix="oos_")
 
-    res = european_hedge(
-        EuropeanConfig(
-            s0=args.s0, strike=args.strike, r=args.r, sigma=args.sigma,
-            option_type=args.option_type,
-            constrain_self_financing=not args.unconstrained,
-        ),
-        SimConfig(
-            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
-            rebalance_every=args.rebalance_every, engine=args.engine,
-        ),
-        _train_cfg(args, "mse_only"),
-        quantile_method=args.quantile_method,
+
+def cmd_euro(args):
+    from orp_tpu.api import EuropeanConfig, SimConfig, european_hedge, european_oos
+
+    euro = EuropeanConfig(
+        s0=args.s0, strike=args.strike, r=args.r, sigma=args.sigma,
+        option_type=args.option_type,
+        constrain_self_financing=not args.unconstrained,
     )
+    sim = SimConfig(
+        n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+        rebalance_every=args.rebalance_every, engine=args.engine,
+    )
+    train = _train_cfg(args, "mse_only")
+    _check_oos_seed(args, sim.seed_fund, "seed_fund")
+    res = european_hedge(euro, sim, train, quantile_method=args.quantile_method)
     _emit(args, res.report)
+    if args.oos_seed is not None:
+        oos = european_oos(
+            res, euro, dataclasses.replace(sim, seed_fund=args.oos_seed),
+            train, quantile_method=args.quantile_method,
+        )
+        _emit_oos(args, oos.report)
 
 
 def cmd_heston(args):
@@ -119,15 +152,13 @@ def cmd_heston(args):
         s0=args.s0, strike=args.strike, r=args.r, v0=args.v0, kappa=args.kappa,
         theta=args.theta, xi=args.xi, rho=args.rho, option_type=args.option_type,
     )
-    res = heston_hedge(
-        h,
-        SimConfig(
-            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
-            rebalance_every=args.rebalance_every, engine=args.engine,
-        ),
-        _train_cfg(args, "mse_only"),
-        quantile_method=args.quantile_method,
+    sim = SimConfig(
+        n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+        rebalance_every=args.rebalance_every, engine=args.engine,
     )
+    train = _train_cfg(args, "mse_only")
+    _check_oos_seed(args, sim.seed_fund, "seed_fund")
+    res = heston_hedge(h, sim, train, quantile_method=args.quantile_method)
     pricer = heston_call if h.option_type == "call" else heston_put
     oracle = pricer(h.s0, h.strike, h.r, args.T, v0=h.v0, kappa=h.kappa,
                     theta=h.theta, xi=h.xi, rho=h.rho)
@@ -135,6 +166,14 @@ def cmd_heston(args):
     _emit(args, res.report, extra={"oracle": oracle, "cv_err_bp": err_bp})
     if not args.json:
         print(f"CF oracle = {oracle:,.4f}  (v0_cv off by {err_bp:+.1f} bp)")
+    if args.oos_seed is not None:
+        from orp_tpu.api import heston_oos
+
+        oos = heston_oos(
+            res, h, dataclasses.replace(sim, seed_fund=args.oos_seed),
+            train, quantile_method=args.quantile_method,
+        )
+        _emit_oos(args, oos.report)
 
 
 def cmd_pension(args):
@@ -156,8 +195,17 @@ def cmd_pension(args):
         ),
         train=_train_cfg(args, "separate"),
     )
+    _check_oos_seed(args, cfg.sim.seed, "seed")
     res = pension_hedge(cfg, quantile_method=args.quantile_method)
     _emit(args, res.report)
+    if args.oos_seed is not None:
+        from orp_tpu.api import pension_oos
+
+        oos_cfg = dataclasses.replace(
+            cfg, sim=dataclasses.replace(cfg.sim, seed=args.oos_seed)
+        )
+        oos = pension_oos(res, oos_cfg, quantile_method=args.quantile_method)
+        _emit_oos(args, oos.report)
 
 
 def cmd_sweep(args):
@@ -185,18 +233,20 @@ def cmd_sweep(args):
 def cmd_basket(args):
     from orp_tpu.api import BasketConfig, SimConfig, basket_hedge
 
+    bcfg = BasketConfig(
+        sigmas=tuple(float(x) for x in args.sigmas.split(",")),
+        s0=tuple(float(x) for x in args.s0.split(",")),
+        weights=tuple(float(x) for x in args.weights.split(",")),
+        strike=args.strike, r=args.r, rho=args.rho,
+    )
+    sim = SimConfig(
+        n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+        rebalance_every=args.rebalance_every,
+    )
+    train = _train_cfg(args, "mse_only")
+    _check_oos_seed(args, sim.seed_fund, "seed_fund")
     res = basket_hedge(
-        BasketConfig(
-            sigmas=tuple(float(x) for x in args.sigmas.split(",")),
-            s0=tuple(float(x) for x in args.s0.split(",")),
-            weights=tuple(float(x) for x in args.weights.split(",")),
-            strike=args.strike, r=args.r, rho=args.rho,
-        ),
-        SimConfig(
-            n_paths=args.paths, T=args.T, dt=args.T / args.steps,
-            rebalance_every=args.rebalance_every,
-        ),
-        _train_cfg(args, "mse_only"),
+        bcfg, sim, train,
         quantile_method=args.quantile_method,
         instruments=args.instruments,
     )
@@ -209,6 +259,15 @@ def cmd_basket(args):
     if not args.json:
         print(f"mm-lognormal oracle = {rep.oracle_mm:,.4f}  "
               f"(v0_cv off by {extra['mm_diff_bp']:+.1f} bp, approx-method error included)")
+    if args.oos_seed is not None:
+        from orp_tpu.api import basket_oos
+
+        oos = basket_oos(
+            res, bcfg, dataclasses.replace(sim, seed_fund=args.oos_seed),
+            train, quantile_method=args.quantile_method,
+            instruments=args.instruments,
+        )
+        _emit_oos(args, oos.report)
 
 
 def cmd_calibrate(args):
@@ -253,6 +312,7 @@ def main(argv=None):
     pe.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(pe)
+    _add_oos_flag(pe)
     _add_quantile_flag(pe)
     pe.set_defaults(fn=cmd_euro)
 
@@ -273,6 +333,7 @@ def main(argv=None):
     ph.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(ph)
+    _add_oos_flag(ph)
     _add_quantile_flag(ph)
     ph.set_defaults(fn=cmd_heston)
 
@@ -291,6 +352,7 @@ def main(argv=None):
                     help="path simulator: XLA scan (exact binomial) or fused "
                          "Pallas kernel (normal-approx binomial)")
     _add_train_flags(pp)
+    _add_oos_flag(pp)
     _add_quantile_flag(pp)
     pp.set_defaults(fn=cmd_pension)
 
@@ -321,6 +383,7 @@ def main(argv=None):
                     help="hedge with the tradeable basket + bond, or a VECTOR "
                          "hedge (one phi per asset + bond; lower CV variance)")
     _add_train_flags(pb)
+    _add_oos_flag(pb)
     _add_quantile_flag(pb)
     pb.set_defaults(fn=cmd_basket)
 
