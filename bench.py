@@ -117,7 +117,14 @@ def main():
     try:
         from benchmarks.north_star import main as north_star
 
-        hedge = north_star(n_paths=n_paths, quiet=True)
+        # CPU fallback keeps the Adam walk: Gauss-Newton's full-batch
+        # Jacobian products are the FASTER choice on TPU (805 big MXU steps
+        # vs 105,600 latency-bound ones) but the slower one on a CPU
+        hedge = north_star(
+            n_paths=n_paths,
+            optimizer="adam" if cpu_fallback else "gauss_newton",
+            quiet=True,
+        )
         record.update(
             hedge_bp_err=hedge["bp_err"],        # OLS-martingale estimator
             hedge_wall_s=hedge["wall_s"],

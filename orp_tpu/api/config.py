@@ -112,6 +112,10 @@ class TrainConfig:
     lr: float | None = None
     final_solve: bool = False  # closed-form ridge readout after each MSE fit
     # (BackwardConfig.final_solve; HedgeMLP.solve_readout)
+    optimizer: str = "adam"  # "adam" | "gauss_newton" (LM-damped full-batch GN
+    # for the MSE leg — BackwardConfig.optimizer; train/gn.py)
+    gn_iters_first: int = 30
+    gn_iters_warm: int = 10
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist/resume per backward date
     shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)

@@ -186,6 +186,9 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         holdings_combine=t.holdings_combine,
         lr=t.lr,
         final_solve=t.final_solve,
+        optimizer=t.optimizer,
+        gn_iters_first=t.gn_iters_first,
+        gn_iters_warm=t.gn_iters_warm,
         seed=t.seed,
         checkpoint_dir=t.checkpoint_dir,
         shuffle=t.shuffle,

@@ -45,6 +45,9 @@ def _train_cfg(args, default_dual: str):
         fused=args.fused,
         shuffle="blocks" if args.fused else True,
         final_solve=args.final_solve,
+        optimizer=args.optimizer,
+        gn_iters_first=args.gn_iters_first,
+        gn_iters_warm=args.gn_iters_warm,
     )
 
 
@@ -60,6 +63,14 @@ def _add_train_flags(p):
                         "shuffle; incompatible with --checkpoint-dir)")
     p.add_argument("--final-solve", action="store_true",
                    help="closed-form shrunk readout after each MSE fit")
+    p.add_argument("--optimizer", choices=["adam", "gauss_newton"], default="adam",
+                   help="MSE-leg trainer: reference-semantics minibatch Adam, "
+                        "or LM-damped full-batch Gauss-Newton (~10 big "
+                        "path-shardable iterations/date; quantile leg stays "
+                        "Adam). --gn-iters-first/--gn-iters-warm set the "
+                        "iteration budget")
+    p.add_argument("--gn-iters-first", type=int, default=30)
+    p.add_argument("--gn-iters-warm", type=int, default=10)
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 

@@ -53,6 +53,11 @@ from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.train import losses as L
 from orp_tpu.train.fit import FitConfig, fit, fit_core
 from orp_tpu.train.fit import validate_shuffle as _validate_shuffle
+from orp_tpu.train.gn import GNConfig, fit_gn
+
+fit_gn_jit = functools.partial(
+    jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
+)(fit_gn)
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -142,12 +147,19 @@ _date_outputs = functools.partial(
 def _date_body(
     model, cfg, params1, params2, feats_t, prices_t, prices_t1, target,
     ka, kb, fit_cfg, mse, q_loss, metric_fns, *, fit_fn, value_fn, outputs_fn,
+    q_fit_fn=None, q_fit_cfg=None,
 ):
     """One backward date: MSE fit, optional quantile fit (``dual_mode``
     semantics incl. the shared-weights ``g_pre`` snapshot, RP.py:212-217 order),
     then the per-date outputs. The ONE definition of the date body — the host
     loop passes the jitted pieces (``fit``/``_value``/``_date_outputs``), the
-    fused walk the traceable cores; only the dispatch structure differs."""
+    fused walk the traceable cores; only the dispatch structure differs.
+
+    ``q_fit_fn``/``q_fit_cfg`` override the quantile leg's trainer — the
+    Gauss-Newton optimizer applies to the MSE leg only (least squares is not
+    the pinball optimum), so the quantile fit keeps its Adam fn/config."""
+    if q_fit_fn is None:
+        q_fit_fn, q_fit_cfg = fit_fn, fit_cfg
     vfn = _model_value_fn(model)  # interned: stable static-arg identity
     solve_fn = _model_solve_fn(model) if cfg.final_solve else None
     params1, aux1 = fit_fn(
@@ -165,9 +177,9 @@ def _date_body(
             # the shared weights (reference order, RP.py:212-217)
             g_pre = value_fn(model, params1, feats_t, prices_t)
             params2 = params1
-        params2, _ = fit_fn(
+        params2, _ = q_fit_fn(
             params2, feats_t, prices_t1, target, kb,
-            value_fn=vfn, loss_fn=q_loss, cfg=fit_cfg, metric_fns=(),
+            value_fn=vfn, loss_fn=q_loss, cfg=q_fit_cfg, metric_fns=(),
         )
         if cfg.dual_mode == "shared":
             params1 = params2
@@ -210,6 +222,12 @@ class BackwardConfig:
     # with its closed-form ridge optimum given the learned hidden features
     # (HedgeMLP.solve_readout) — training MSE monotonically improves; the
     # quantile model is untouched (least squares is not the pinball optimum)
+    optimizer: str = "adam"  # "adam" (reference semantics: minibatch epochs,
+    # LR schedule, early stopping) | "gauss_newton" (LM-damped full-batch GN
+    # for the MSE leg: ~10 big MXU-bound iterations/date instead of ~10^3
+    # latency-bound tiny steps; path-shardable reductions. train/gn.py)
+    gn_iters_first: int = 30
+    gn_iters_warm: int = 10
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist state per date; resume if present
     shuffle: bool | str = True  # per-epoch row shuffling policy (FitConfig.shuffle):
@@ -225,6 +243,10 @@ class BackwardConfig:
             raise ValueError(
                 "fused=True runs the whole walk device-side; per-date "
                 "checkpointing needs the host loop (fused=False)"
+            )
+        if self.optimizer not in ("adam", "gauss_newton"):
+            raise ValueError(
+                f"optimizer={self.optimizer!r}: expected 'adam' or 'gauss_newton'"
             )
 
 
@@ -277,29 +299,38 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
     n_dates = prices_all.shape[1] - 1
     terminal = terminal.astype(dtype)
 
-    first_cfg = FitConfig(
+    adam_first = FitConfig(
         n_epochs=cfg.epochs_first, batch_size=cfg.batch_size,
         patience=cfg.patience_first, lr=cfg.lr, shuffle=cfg.shuffle,
     )
-    warm_cfg = FitConfig(
+    adam_warm = FitConfig(
         n_epochs=cfg.epochs_warm, batch_size=cfg.batch_size,
         patience=cfg.patience_warm,
         lr=cfg.lr if cfg.lr is not None else cfg.warm_lr,
         shuffle=cfg.shuffle,
     )
+    gn = cfg.optimizer == "gauss_newton"
+    if gn:
+        first_cfg = GNConfig(n_iters=cfg.gn_iters_first)
+        warm_cfg = GNConfig(n_iters=cfg.gn_iters_warm)
+    else:
+        first_cfg, warm_cfg = adam_first, adam_warm
 
-    def one_date(params1, params2, target, t, ka, kb, fit_cfg):
+    def one_date(params1, params2, target, t, ka, kb, fit_cfg, q_cfg):
         return _date_body(
             model, cfg, params1, params2,
             features[:, t], prices_all[:, t], prices_all[:, t + 1], target,
             ka, kb, fit_cfg, mse, q_loss, metric_fns,
-            fit_fn=fit_core,
+            fit_fn=fit_gn if gn else fit_core,
             value_fn=lambda m, p, f, pr: m.value(p, f, pr),
             outputs_fn=_date_outputs_core,
+            q_fit_fn=fit_core if gn else None,
+            q_fit_cfg=q_cfg if gn else None,
         )
 
     params1, params2, v_first, comb_first, var_first, aux_first = one_date(
-        params1, params2, terminal, n_dates - 1, kas[0], kbs[0], first_cfg
+        params1, params2, terminal, n_dates - 1, kas[0], kbs[0], first_cfg,
+        adam_first,
     )
     _first_p1, _first_p2 = params1, params2
     scalar = lambda aux: (
@@ -327,7 +358,7 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         p1, p2, target = carry
         t, ka, kb = xs
         p1, p2, v_t, comb, var_resid, aux1 = one_date(
-            p1, p2, target, t, ka, kb, warm_cfg
+            p1, p2, target, t, ka, kb, warm_cfg, adam_warm
         )
         phi, psi = _split_holdings(comb)
         snaps = (p1, p2) if two_models else (p1,)
@@ -457,13 +488,13 @@ def backward_induction(
         # does not change the math, so it must not churn the fingerprint
         fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None, fused=False)
         # the format tag versions the on-disk state layout AND the config
-        # field set: v3 = BackwardConfig grew shuffle/fused; v4 = final_solve
-        # (r3). A dir from an older field set refuses cleanly here instead of
-        # failing in replay
+        # field set: v3 = BackwardConfig grew shuffle/fused; v4 = final_solve;
+        # v5 = optimizer/gn_iters (r3). A dir from an older field set refuses
+        # cleanly here instead of failing in replay
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
-            "ckpt_format=increment-v4",
+            "ckpt_format=increment-v5",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
@@ -495,12 +526,17 @@ def backward_induction(
         if step_i < start_step:
             continue  # key stream still advances: resumed == uninterrupted run
         first = step_i == 0
-        fit_cfg = FitConfig(
+        adam_cfg = FitConfig(
             n_epochs=cfg.epochs_first if first else cfg.epochs_warm,
             batch_size=cfg.batch_size,
             patience=cfg.patience_first if first else cfg.patience_warm,
             lr=cfg.lr if (first or cfg.lr is not None) else cfg.warm_lr,
             shuffle=cfg.shuffle,
+        )
+        gn = cfg.optimizer == "gauss_newton"
+        fit_cfg = (
+            GNConfig(n_iters=cfg.gn_iters_first if first else cfg.gn_iters_warm)
+            if gn else adam_cfg
         )
         # one date = MSE fit + dual-mode quantile fit + fused outputs program
         # (RP.py:103-125, :221) via the shared body, with jitted pieces
@@ -508,7 +544,9 @@ def backward_induction(
             model, cfg, params1, params2,
             features[:, t], prices_all[:, t], prices_all[:, t + 1],
             values[:, t + 1], ka, kb, fit_cfg, mse, q_loss, metric_fns,
-            fit_fn=fit, value_fn=_value, outputs_fn=_date_outputs,
+            fit_fn=fit_gn_jit if gn else fit, value_fn=_value,
+            outputs_fn=_date_outputs,
+            q_fit_fn=fit if gn else None, q_fit_cfg=adam_cfg if gn else None,
         )
         values = values.at[:, t].set(v_t)
         phi_t, psi_t = _split_holdings(comb)

@@ -1,0 +1,155 @@
+"""Gauss-Newton / Levenberg-Marquardt fit for the MSE hedge regression.
+
+The per-date fit is a ~100-parameter nonlinear least squares over up to 1M
+samples. Minibatch Adam solves it with O(10^3) SEQUENTIAL tiny steps per
+date — each microseconds of tensor work — so on TPU the walk's wall is pure
+step LATENCY (SCALING.md §3/§3a). Gauss-Newton inverts the shape of the
+work: ~10 full-batch iterations per date, each dominated by ONE large
+matmul pair
+
+    G = g^T g / n   (P x P Gram of per-sample value gradients, P ~ 97)
+    b = g^T r / n   (gradient of the half-MSE)
+
+— MXU-sized, and under a path-sharded mesh the reductions are psums, so
+the fit stage finally SCALES with chips instead of being latency-bound.
+Levenberg-Marquardt damping (multiplicative, accept/reject on the true
+loss) makes it robust to the LeakyReLU kinks; a fixed iteration count with
+a converged-freeze keeps the whole fit one XLA program, same as fit_core.
+
+MSE only: GN is the natural algorithm for least squares; the 0.99-pinball
+quantile fit stays on Adam (``fit_core``). No reference analogue — the
+reference trains everything with Keras Adam (RP.py:177).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class GNConfig:
+    n_iters: int = 12
+    init_lambda: float = 1e-3   # LM damping, relative to mean(diag(G))
+    lambda_up: float = 10.0
+    lambda_down: float = 1 / 3
+    min_rel_improve: float = 1e-7  # freeze once an accepted step improves
+    # the loss by less than this relative amount (converged)
+    ridge: float = 1e-9         # absolute floor added to the damped diagonal
+
+
+def fit_gn(
+    params,
+    features: jax.Array,
+    prices: jax.Array,
+    targets: jax.Array,
+    key: jax.Array,  # unused (deterministic full-batch); kept for fit_core parity
+    *,
+    value_fn: Callable,
+    loss_fn: Callable,  # must be the MSE (asserted by the caller)
+    cfg: GNConfig,
+    metric_fns: tuple = (),
+    solve_fn: Callable | None = None,
+):
+    """Drop-in replacement for ``fit_core`` (MSE loss only).
+
+    Returns ``(best_params, aux)`` with the same aux contract: per-iteration
+    ``loss_history`` (inf past the freeze), ``best_loss``, ``n_epochs_ran``
+    (= accepted GN iterations), ``final_loss`` and ``metric_fns`` values.
+    """
+    from orp_tpu.train import losses as L
+
+    if loss_fn is not L.mse:
+        # GN minimises mean squared residuals by construction; any other
+        # loss_fn would be silently ignored by the iterations while
+        # aux["final_loss"] reported it — refuse instead
+        raise ValueError(
+            "fit_gn optimises the MSE only; got a different loss_fn "
+            "(the quantile leg must stay on the Adam fit)"
+        )
+    theta0, unravel = ravel_pytree(params)
+    dim = theta0.shape[0]
+    n = targets.shape[0]
+    y = targets.astype(theta0.dtype)
+
+    def resid(theta):
+        return value_fn(unravel(theta), features, prices) - y
+
+    def loss_of(theta):
+        r = resid(theta)
+        return jnp.mean(r * r)
+
+    def grads_per_sample(theta):
+        # J as one vmap'd gradient: (n, P). Memory n*P floats — 388MB at 1M
+        # paths, sharded over the path mesh like every other (n, ...) array
+        def one(fx, px):
+            return jax.grad(
+                lambda t: value_fn(unravel(t), fx[None], px[None])[0]
+            )(theta)
+
+        return jax.vmap(one)(features, prices)
+
+    def body(carry, _):
+        theta, lam, best_loss, frozen = carry
+
+        def do(operand):
+            theta, lam, best_loss, frozen = operand
+            J = grads_per_sample(theta)
+            r = resid(theta)
+            G = J.T @ J / n
+            b = J.T @ r / n
+            diag_scale = jnp.mean(jnp.diag(G)) + cfg.ridge
+            A = G + (lam * diag_scale + cfg.ridge) * jnp.eye(dim, dtype=G.dtype)
+            delta = jnp.linalg.solve(A, b)
+            cand = theta - delta
+            cand_loss = loss_of(cand)
+
+            improved = cand_loss < best_loss
+            rel_gain = (best_loss - cand_loss) / jnp.maximum(best_loss, 1e-30)
+            # freeze once improvement stalls (converged)
+            now_frozen = frozen | (improved & (rel_gain < cfg.min_rel_improve))
+
+            take = improved
+            theta = jnp.where(take, cand, theta)
+            best_loss = jnp.where(take, cand_loss, best_loss)
+            lam = jnp.clip(
+                jnp.where(improved, lam * cfg.lambda_down, lam * cfg.lambda_up),
+                1e-10, 1e10,
+            )
+            return (theta, lam, best_loss, now_frozen), (cand_loss, take)
+
+        def skip(operand):
+            # frozen: no Jacobian, no solve — XLA executes only this branch
+            # after convergence (the fit_core early-stop pattern)
+            return operand, (jnp.asarray(jnp.inf, theta.dtype),
+                             jnp.asarray(False))
+
+        carry, ys = jax.lax.cond(frozen, skip, do, (theta, lam, best_loss, frozen))
+        return carry, ys
+
+    init = (
+        theta0,
+        jnp.asarray(cfg.init_lambda, theta0.dtype),
+        loss_of(theta0),
+        jnp.asarray(False),
+    )
+    (theta, _, best_loss, _), (hist, takes) = jax.lax.scan(
+        body, init, None, length=cfg.n_iters
+    )
+    best_params = unravel(theta)
+    aux = {
+        "loss_history": hist,
+        "n_epochs_ran": jnp.sum(takes),
+    }
+    if solve_fn is not None:
+        best_params = solve_fn(best_params, features, prices, targets)
+    pred = value_fn(best_params, features, prices)
+    aux["final_loss"] = loss_fn(pred, y)
+    aux["best_loss"] = aux["final_loss"] if solve_fn is not None else best_loss
+    for fn in metric_fns:
+        aux[fn.__name__] = fn(pred, y)
+    return best_params, aux

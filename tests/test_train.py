@@ -4,6 +4,7 @@ backward induction vs Black–Scholes (SURVEY.md §4 items 2-4)."""
 import dataclasses
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -362,3 +363,71 @@ def test_final_solve_walk_guarantees_at_first_fit():
     assert solved.train_loss[-1] <= plain.train_loss[-1] * (1 + 1e-3)
     sq = lambda res: float((np.asarray(res.var_residuals)[:, -1] ** 2).mean())
     assert sq(solved) <= sq(plain) * (1 + 1e-3)
+
+
+def test_gn_fit_matches_adam_quality_in_few_iters():
+    # the 97-param MSE regression: ~12 LM-damped GN iterations should reach
+    # (or beat) what hundreds of Adam minibatch steps reach
+    from orp_tpu.train.gn import GNConfig, fit_gn
+
+    m = HedgeMLP(n_features=1)
+    p0 = m.init(jax.random.key(1))
+    n = 8192
+    s = jnp.exp(jax.random.normal(jax.random.key(2), (n,)) * 0.3)
+    prices = jnp.stack([s, jnp.full(n, 1.05)], axis=-1)
+    target = jnp.maximum(s - 1.0, 0.0)
+    p_adam, aux_adam = fit(
+        p0, s[:, None], prices, target, jax.random.key(3),
+        value_fn=m.value, loss_fn=losses.mse,
+        cfg=FitConfig(n_epochs=100, batch_size=1024, patience=100, lr=1e-3),
+    )
+    p_gn, aux_gn = fit_gn(
+        p0, s[:, None], prices, target, jax.random.key(3),
+        value_fn=m.value, loss_fn=losses.mse, cfg=GNConfig(n_iters=12),
+    )
+    assert float(aux_gn["final_loss"]) <= float(aux_adam["final_loss"]) * 1.05
+    hist = np.asarray(aux_gn["loss_history"])
+    assert int(aux_gn["n_epochs_ran"]) <= 12
+    assert np.isfinite(hist).any()
+
+
+def test_gn_walk_fused_matches_host():
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=2048, n_steps=4)
+    model = HedgeMLP(n_features=1)
+    cfg = BackwardConfig(
+        dual_mode="mse_only", optimizer="gauss_newton",
+        gn_iters_first=10, gn_iters_warm=4, fused=False,
+    )
+    args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
+    bias = (float(payoff.mean()) / S0, 0.0)
+    host = backward_induction(*args, cfg, bias_init=bias)
+    fused = backward_induction(
+        *args, dataclasses.replace(cfg, fused=True), bias_init=bias
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.values), np.asarray(host.values), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_gn_walk_dual_mode_keeps_quantile_on_adam():
+    # separate mode with GN: the quantile leg still trains (Adam) and lifts
+    # the value above the pure-MSE walk like the reference's combine does
+    S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=2048, n_steps=2)
+    model = HedgeMLP(n_features=1)
+    base = BackwardConfig(
+        dual_mode="separate", optimizer="gauss_newton",
+        gn_iters_first=10, gn_iters_warm=4,
+        epochs_first=60, epochs_warm=20, batch_size=1024, lr=1e-3,
+    )
+    args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
+    bias = (float(payoff.mean()) / S0, 0.0)
+    res = backward_induction(*args, base, bias_init=bias)
+    mse_only = backward_induction(
+        *args, dataclasses.replace(base, dual_mode="mse_only"), bias_init=bias
+    )
+    assert float(res.v0.mean()) > float(mse_only.v0.mean())
+
+
+def test_backward_config_rejects_unknown_optimizer():
+    with pytest.raises(ValueError, match="optimizer"):
+        BackwardConfig(optimizer="sgd")

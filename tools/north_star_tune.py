@@ -61,9 +61,12 @@ def main(argv=None):
                 "epochs_warm": e_warm, "final_solve": bool(solve), "lr": lr,
                 "solve_variant": "shrink" if solve else None}
         try:
+            # this tool sweeps the ADAM frontier; the GN default would
+            # make the epochs/batch knobs silent no-ops
             res = ns(n_paths=1 << args.paths_log2, epochs_first=e_first,
                      epochs_warm=e_warm, batch_div=batch_div,
-                     final_solve=bool(solve), lr=lr, quiet=True)
+                     final_solve=bool(solve), lr=lr, optimizer="adam",
+                     quiet=True)
             rec = {**base, **res}
         except Exception as e:  # noqa: BLE001
             rec = {**base, "error": f"{type(e).__name__}: {e}"[:200]}
