@@ -491,10 +491,13 @@ def backward_induction(
         # field set: v3 = BackwardConfig grew shuffle/fused; v4 = final_solve;
         # v5 = optimizer/gn_iters (r3). A dir from an older field set refuses
         # cleanly here instead of failing in replay
+        # GNConfig's class defaults (LM damping etc.) are training policy
+        # that lives OUTSIDE BackwardConfig — folding the instance repr in
+        # makes any future default change auto-invalidate old directories
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
-            "ckpt_format=increment-v5",
+            f"gn={GNConfig(n_iters=0)} ckpt_format=increment-v6",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:

@@ -366,8 +366,9 @@ def test_final_solve_walk_guarantees_at_first_fit():
 
 
 def test_gn_fit_matches_adam_quality_in_few_iters():
-    # the 97-param MSE regression: ~12 LM-damped GN iterations should reach
-    # (or beat) what hundreds of Adam minibatch steps reach
+    # the 97-param MSE regression: ~16 LM-damped GN iterations from a COLD
+    # init reach (or beat) hundreds of Adam minibatch steps; at 20 the fit is
+    # near-exact (warm-started walk dates need far fewer — SCALING.md §3c)
     from orp_tpu.train.gn import GNConfig, fit_gn
 
     m = HedgeMLP(n_features=1)
@@ -383,11 +384,11 @@ def test_gn_fit_matches_adam_quality_in_few_iters():
     )
     p_gn, aux_gn = fit_gn(
         p0, s[:, None], prices, target, jax.random.key(3),
-        value_fn=m.value, loss_fn=losses.mse, cfg=GNConfig(n_iters=12),
+        value_fn=m.value, loss_fn=losses.mse, cfg=GNConfig(n_iters=16),
     )
     assert float(aux_gn["final_loss"]) <= float(aux_adam["final_loss"]) * 1.05
     hist = np.asarray(aux_gn["loss_history"])
-    assert int(aux_gn["n_epochs_ran"]) <= 12
+    assert int(aux_gn["n_epochs_ran"]) <= 16
     assert np.isfinite(hist).any()
 
 
