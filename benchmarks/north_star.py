@@ -30,7 +30,7 @@ from orp_tpu.utils import bs_call
 
 def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64,
          final_solve=False, lr=1e-3, optimizer="gauss_newton",
-         gn_iters=(40, 15), quiet=False):
+         gn_iters=(60, 30), quiet=False):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(
@@ -42,11 +42,11 @@ def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64,
         TrainConfig(
             dual_mode="mse_only",
             # optimizer="gauss_newton" (the default): LM-damped full-batch GN
-            # — 40 + 51x15 = 805 SEQUENTIAL steps for the whole walk vs the
+            # — 60 + 51x30 = 1,590 SEQUENTIAL steps for the whole walk vs the
             # Adam config's 105,600 latency-bound minibatch steps, at
-            # identical headline (OLS-martingale) accuracy: acv_std 1.06 vs
-            # 1.07 measured at 131k (SCALING.md §3c). Adam remains available
-            # via optimizer="adam" with the epochs/batch/lr knobs below.
+            # identical headline (OLS-martingale) accuracy and near-Adam
+            # hedge quality (cv_std ladder in SCALING.md §3c). Adam remains
+            # available via optimizer="adam" with the epochs/batch/lr knobs.
             optimizer=optimizer,
             gn_iters_first=gn_iters[0],
             gn_iters_warm=gn_iters[1],
