@@ -118,8 +118,8 @@ def main():
         from benchmarks.north_star import main as north_star
 
         # CPU fallback keeps the Adam walk: Gauss-Newton's full-batch
-        # Jacobian products are the FASTER choice on TPU (805 big MXU steps
-        # vs 105,600 latency-bound ones) but the slower one on a CPU
+        # Jacobian products are the FASTER choice on TPU (~1,600 big MXU
+        # steps vs 105,600 latency-bound ones) but the slower one on a CPU
         hedge = north_star(
             n_paths=n_paths,
             optimizer="adam" if cpu_fallback else "gauss_newton",

@@ -172,23 +172,14 @@ def test_legacy_sv_shim_uses_namespaced_c():
 def test_pension_hedge_gauss_newton_runs():
     # GN on the 3-feature/122-param pension model (the MSE leg; the quantile
     # leg of dual_mode="separate" stays on Adam)
-    import dataclasses
-
-    from orp_tpu.api import HedgeRunConfig, pension_hedge
-
-    cfg = HedgeRunConfig()
-    cfg = dataclasses.replace(
-        cfg,
-        sim=dataclasses.replace(cfg.sim, n_paths=512, dt=1 / 12,
-                                rebalance_every=12),
-        train=dataclasses.replace(
-            cfg.train, dual_mode="separate", optimizer="gauss_newton",
+    cfg = HedgeRunConfig(
+        sim=SimConfig(n_paths=512, dt=1 / 12, rebalance_every=12),
+        train=TrainConfig(
+            dual_mode="separate", optimizer="gauss_newton",
             gn_iters_first=8, gn_iters_warm=3, epochs_first=20, epochs_warm=8,
             batch_size=256, lr=1e-3,
         ),
     )
     res = pension_hedge(cfg)
-    import numpy as np
-
     assert np.isfinite(res.report.v0)
     assert np.isfinite(res.report.phi0)

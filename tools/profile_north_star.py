@@ -189,7 +189,7 @@ def main(n_log2=20):
 
     # the GN walk — what benchmarks/north_star.py runs by default now
     gn_cfg = dataclasses.replace(
-        fused_cfg, optimizer="gauss_newton", gn_iters_first=40, gn_iters_warm=15
+        fused_cfg, optimizer="gauss_newton", gn_iters_first=60, gn_iters_warm=30
     )
     t0 = time.perf_counter()
     res = backward_induction(*args, gn_cfg, bias_init=(e_payoff_n, 0.0))
