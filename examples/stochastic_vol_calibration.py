@@ -1,12 +1,20 @@
 """CIR vol calibration + sanity simulation — parity example for
 ``Extra: Stochastic Volatility.ipynb``.
 
-The reference downloads 10y of ^GSPC via yfinance (a network dependency this
-framework keeps out of the compute path); pass any price CSV instead, or run
-with no argument to calibrate on a synthetic GBM price series. Reference
-output to compare (Extra#8(out)): CIRParams(a=0.00336, b=0.15431, c=0.01583).
+The reference downloads 10y of ^GSPC via yfinance (``Extra: Stochastic
+Volatility.ipynb#5``) — a network dependency this framework keeps out of the
+compute path. Three input modes, most-reproducible first:
 
-Run: env -u PALLAS_AXON_POOL_IPS python examples/stochastic_vol_calibration.py [prices.csv]
+- ``prices.csv``       — any price CSV (one close per line);
+- ``--ticker ^GSPC``   — the reference's live pull, used ONLY if yfinance is
+  importable (an optional extra, never a framework dependency) and the
+  network is reachable; degrades with a clear message otherwise;
+- no argument          — a synthetic GBM series (fully offline/reproducible).
+
+Reference output to compare (Extra#8(out)): CIRParams(a=0.00336, b=0.15431,
+c=0.01583).
+
+Run: env -u PALLAS_AXON_POOL_IPS python examples/stochastic_vol_calibration.py [prices.csv | --ticker ^GSPC]
 """
 
 import pathlib
@@ -22,8 +30,42 @@ from orp_tpu.calib import annualized_drift, estimate_cir_params, log_returns, ro
 from orp_tpu.sde import TimeGrid, simulate_pension
 
 
+def _fetch_ticker(symbol: str, years: float) -> np.ndarray:
+    """The reference's yfinance pull (Extra#5: ``yf.download('^GSPC',
+    period='10y')['Close']``), behind an import guard — yfinance is an
+    optional extra, not a framework dependency."""
+    try:
+        import yfinance as yf
+    except ImportError:
+        raise SystemExit(
+            "--ticker needs the optional yfinance package (pip install "
+            "yfinance); alternatively pass a price CSV — the calibration "
+            "itself is offline"
+        )
+    data = yf.download(symbol, period=f"{int(years)}y", progress=False)
+    if data is None or getattr(data, "empty", True) or "Close" not in data:
+        raise SystemExit(
+            f"--ticker {symbol}: empty download — network/symbol problem? "
+            "Pass a price CSV instead"
+        )
+    closes = np.asarray(data["Close"], dtype=float).ravel()
+    closes = closes[np.isfinite(closes)]  # partial downloads carry NaN rows
+    if closes.size < 100:
+        raise SystemExit(
+            f"--ticker {symbol}: got {closes.size} usable closes — network/"
+            "symbol problem? Pass a price CSV instead"
+        )
+    return closes
+
+
 def main():
-    if len(sys.argv) > 1:
+    if len(sys.argv) > 1 and sys.argv[1] == "--ticker":
+        if len(sys.argv) < 3:
+            raise SystemExit("usage: ... --ticker SYMBOL  (e.g. --ticker ^GSPC)")
+        years = 10.0
+        prices = _fetch_ticker(sys.argv[2], years)
+        print(f"({sys.argv[2]}: {prices.size} closes via yfinance)")
+    elif len(sys.argv) > 1:
         prices = np.loadtxt(sys.argv[1], delimiter=",")
         years = 10.0
     else:

@@ -116,6 +116,8 @@ class TrainConfig:
     # for the MSE leg — BackwardConfig.optimizer; train/gn.py)
     gn_iters_first: int = 30
     gn_iters_warm: int = 10
+    gn_quantile: bool = True  # gauss_newton only: IRLS-GN pinball solver for
+    # the quantile leg too (BackwardConfig.gn_quantile); False = Adam leg
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist/resume per backward date
     shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)

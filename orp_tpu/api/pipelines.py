@@ -170,6 +170,14 @@ def _check_oos_args(name, trained, seed, train, allow_in_sample,
             f"{name}: train.holdings_combine={train.holdings_combine!r} does "
             f"not match the training run's {trained.holdings_combine!r}"
         )
+    if (trained.cost_of_capital is not None
+            and train.cost_of_capital != trained.cost_of_capital):
+        raise ValueError(
+            f"{name}: train.cost_of_capital={train.cost_of_capital!r} does "
+            f"not match the training run's {trained.cost_of_capital!r} — the "
+            "replay would combine the stored params' values under a "
+            "different i in g+i(h-g)"
+        )
 
 
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
@@ -189,6 +197,7 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         optimizer=t.optimizer,
         gn_iters_first=t.gn_iters_first,
         gn_iters_warm=t.gn_iters_warm,
+        gn_quantile=t.gn_quantile,
         seed=t.seed,
         checkpoint_dir=t.checkpoint_dir,
         shuffle=t.shuffle,
@@ -211,6 +220,8 @@ class PipelineResult:
     # validates its `train` argument against these to prevent replaying
     # separately-trained params under the wrong value-combine
     holdings_combine: str | None = None
+    cost_of_capital: float | None = None  # enters the replayed value/holdings
+    # combine (_date_outputs_core) exactly like dual_mode — *_oos checks it too
 
     @property
     def v0(self) -> float:
@@ -287,7 +298,8 @@ def european_hedge(
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine)
+                           holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 def european_oos(
@@ -352,7 +364,8 @@ def european_oos(
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine)
+                           holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 def heston_hedge(
@@ -398,7 +411,8 @@ def heston_hedge(
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine)
+                           holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 def heston_oos(
@@ -442,7 +456,8 @@ def heston_oos(
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                           sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine)
+                           holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 
@@ -572,7 +587,8 @@ def basket_hedge(
     return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine)
+                           holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 # ---------------------------------------------------------------------------
@@ -650,7 +666,8 @@ def basket_oos(
     return PipelineResult(report=report, backward=res, times=times,
                           adjustment_factor=norm, sim_seed=sim.seed_fund,
                           dual_mode=train.dual_mode,
-                          holdings_combine=train.holdings_combine)
+                          holdings_combine=train.holdings_combine,
+                           cost_of_capital=train.cost_of_capital)
 
 
 def pension_hedge(
@@ -700,6 +717,7 @@ def pension_hedge(
         report=report, backward=res, times=times, adjustment_factor=adjustment,
         sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
         holdings_combine=cfg.train.holdings_combine,
+        cost_of_capital=cfg.train.cost_of_capital,
     )
 
 
@@ -750,6 +768,7 @@ def pension_oos(
         report=report, backward=res, times=times, adjustment_factor=adjustment,
         sim_seed=s.seed, dual_mode=cfg.train.dual_mode,
         holdings_combine=cfg.train.holdings_combine,
+        cost_of_capital=cfg.train.cost_of_capital,
     )
 
 

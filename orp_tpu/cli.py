@@ -48,6 +48,7 @@ def _train_cfg(args, default_dual: str):
         optimizer=args.optimizer,
         gn_iters_first=args.gn_iters_first,
         gn_iters_warm=args.gn_iters_warm,
+        gn_quantile=not args.adam_quantile,
     )
 
 
@@ -64,13 +65,17 @@ def _add_train_flags(p):
     p.add_argument("--final-solve", action="store_true",
                    help="closed-form shrunk readout after each MSE fit")
     p.add_argument("--optimizer", choices=["adam", "gauss_newton"], default="adam",
-                   help="MSE-leg trainer: reference-semantics minibatch Adam, "
-                        "or LM-damped full-batch Gauss-Newton (~10 big "
-                        "path-shardable iterations/date; quantile leg stays "
-                        "Adam). --gn-iters-first/--gn-iters-warm set the "
-                        "iteration budget")
+                   help="trainer: reference-semantics minibatch Adam, or "
+                        "LM-damped full-batch Gauss-Newton (~10 big "
+                        "path-shardable iterations/date — MSE leg plain GN, "
+                        "quantile leg IRLS pinball unless --adam-quantile). "
+                        "--gn-iters-first/--gn-iters-warm set the budget")
     p.add_argument("--gn-iters-first", type=int, default=30)
     p.add_argument("--gn-iters-warm", type=int, default=10)
+    p.add_argument("--adam-quantile", action="store_true",
+                   help="with --optimizer gauss_newton: keep the quantile "
+                        "leg on Adam (reference semantics) instead of the "
+                        "IRLS-GN pinball solver")
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 

@@ -2,6 +2,7 @@
 
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
 from orp_tpu.train.fit import FitConfig, fit, reference_lr_schedule
+from orp_tpu.train.gn import GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball
 from orp_tpu.train.replay import replay_walk
 from orp_tpu.train import losses
 
@@ -11,6 +12,10 @@ __all__ = [
     "backward_induction",
     "FitConfig",
     "fit",
+    "GNConfig",
+    "GNPinballConfig",
+    "fit_gn",
+    "fit_gn_pinball",
     "reference_lr_schedule",
     "replay_walk",
     "losses",

@@ -53,11 +53,14 @@ from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.train import losses as L
 from orp_tpu.train.fit import FitConfig, fit, fit_core
 from orp_tpu.train.fit import validate_shuffle as _validate_shuffle
-from orp_tpu.train.gn import GNConfig, fit_gn
+from orp_tpu.train.gn import GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball
 
 fit_gn_jit = functools.partial(
     jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
 )(fit_gn)
+fit_gn_pinball_jit = functools.partial(
+    jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
+)(fit_gn_pinball)
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -155,9 +158,10 @@ def _date_body(
     loop passes the jitted pieces (``fit``/``_value``/``_date_outputs``), the
     fused walk the traceable cores; only the dispatch structure differs.
 
-    ``q_fit_fn``/``q_fit_cfg`` override the quantile leg's trainer — the
-    Gauss-Newton optimizer applies to the MSE leg only (least squares is not
-    the pinball optimum), so the quantile fit keeps its Adam fn/config."""
+    ``q_fit_fn``/``q_fit_cfg`` override the quantile leg's trainer: under
+    ``optimizer="gauss_newton"`` the quantile fit runs the IRLS-GN pinball
+    solver (``fit_gn_pinball``; plain least-squares GN is not the pinball
+    optimum) — or Adam when ``cfg.gn_quantile`` is False."""
     if q_fit_fn is None:
         q_fit_fn, q_fit_cfg = fit_fn, fit_cfg
     vfn = _model_value_fn(model)  # interned: stable static-arg identity
@@ -166,7 +170,8 @@ def _date_body(
         params1, feats_t, prices_t1, target, ka,
         value_fn=vfn, loss_fn=mse, cfg=fit_cfg, metric_fns=metric_fns,
         solve_fn=solve_fn,  # exact-readout step applies to the MSE model only
-        # (least squares is the MSE optimum; the quantile fit below stays Adam)
+        # (least squares is the MSE optimum, not the pinball one — the
+        # quantile fit below never receives a solve_fn)
     )
     g_pre = jnp.zeros((), model.dtype)  # only read in shared mode
     if cfg.dual_mode == "mse_only":
@@ -228,6 +233,11 @@ class BackwardConfig:
     # latency-bound tiny steps; path-shardable reductions. train/gn.py)
     gn_iters_first: int = 30
     gn_iters_warm: int = 10
+    gn_quantile: bool = True  # under optimizer="gauss_newton", train the
+    # quantile leg (dual_mode separate/shared) with the IRLS Gauss-Newton
+    # pinball solver (train/gn.py:fit_gn_pinball) at the same gn_iters —
+    # removing the last ~10^5-sequential-step Adam wall from dual walks.
+    # False keeps the quantile leg on reference-semantics Adam
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist state per date; resume if present
     shuffle: bool | str = True  # per-epoch row shuffling policy (FitConfig.shuffle):
@@ -310,11 +320,18 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         shuffle=cfg.shuffle,
     )
     gn = cfg.optimizer == "gauss_newton"
+    gn_q = gn and cfg.gn_quantile
     if gn:
         first_cfg = GNConfig(n_iters=cfg.gn_iters_first)
         warm_cfg = GNConfig(n_iters=cfg.gn_iters_warm)
+        if gn_q:
+            q_first = GNPinballConfig(n_iters=cfg.gn_iters_first, q=cfg.quantile)
+            q_warm = GNPinballConfig(n_iters=cfg.gn_iters_warm, q=cfg.quantile)
+        else:
+            q_first, q_warm = adam_first, adam_warm
     else:
         first_cfg, warm_cfg = adam_first, adam_warm
+        q_first, q_warm = adam_first, adam_warm
 
     def one_date(params1, params2, target, t, ka, kb, fit_cfg, q_cfg):
         return _date_body(
@@ -324,13 +341,13 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
             fit_fn=fit_gn if gn else fit_core,
             value_fn=lambda m, p, f, pr: m.value(p, f, pr),
             outputs_fn=_date_outputs_core,
-            q_fit_fn=fit_core if gn else None,
+            q_fit_fn=(fit_gn_pinball if gn_q else fit_core) if gn else None,
             q_fit_cfg=q_cfg if gn else None,
         )
 
     params1, params2, v_first, comb_first, var_first, aux_first = one_date(
         params1, params2, terminal, n_dates - 1, kas[0], kbs[0], first_cfg,
-        adam_first,
+        q_first,
     )
     _first_p1, _first_p2 = params1, params2
     scalar = lambda aux: (
@@ -358,7 +375,7 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
         p1, p2, target = carry
         t, ka, kb = xs
         p1, p2, v_t, comb, var_resid, aux1 = one_date(
-            p1, p2, target, t, ka, kb, warm_cfg, adam_warm
+            p1, p2, target, t, ka, kb, warm_cfg, q_warm
         )
         phi, psi = _split_holdings(comb)
         snaps = (p1, p2) if two_models else (p1,)
@@ -489,15 +506,19 @@ def backward_induction(
         fp_cfg = dataclasses.replace(cfg, checkpoint_dir=None, fused=False)
         # the format tag versions the on-disk state layout AND the config
         # field set: v3 = BackwardConfig grew shuffle/fused; v4 = final_solve;
-        # v5 = optimizer/gn_iters (r3). A dir from an older field set refuses
-        # cleanly here instead of failing in replay
-        # GNConfig's class defaults (LM damping etc.) are training policy
-        # that lives OUTSIDE BackwardConfig — folding the instance repr in
-        # makes any future default change auto-invalidate old directories
+        # v5 = optimizer/gn_iters (r3); v6 = GNConfig repr folded into the
+        # fingerprint string below + the gentler default damping (r3), which
+        # changes what GN-trained directories contain; v7 = BackwardConfig
+        # grew gn_quantile + GNPinballConfig folded in (r4). A dir from an
+        # older field set refuses cleanly here instead of failing in replay
+        # GN config class defaults (LM damping, IRLS floor etc.) are training
+        # policy that lives OUTSIDE BackwardConfig — folding the instance
+        # reprs in makes any future default change auto-invalidate old dirs
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
-            f"gn={GNConfig(n_iters=0)} ckpt_format=increment-v6",
+            f"gn={GNConfig(n_iters=0)} gnq={GNPinballConfig(n_iters=0)} "
+            "ckpt_format=increment-v7",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
@@ -537,9 +558,12 @@ def backward_induction(
             shuffle=cfg.shuffle,
         )
         gn = cfg.optimizer == "gauss_newton"
-        fit_cfg = (
-            GNConfig(n_iters=cfg.gn_iters_first if first else cfg.gn_iters_warm)
-            if gn else adam_cfg
+        gn_q = gn and cfg.gn_quantile
+        n_iters = cfg.gn_iters_first if first else cfg.gn_iters_warm
+        fit_cfg = GNConfig(n_iters=n_iters) if gn else adam_cfg
+        q_cfg = (
+            GNPinballConfig(n_iters=n_iters, q=cfg.quantile)
+            if gn_q else adam_cfg
         )
         # one date = MSE fit + dual-mode quantile fit + fused outputs program
         # (RP.py:103-125, :221) via the shared body, with jitted pieces
@@ -549,7 +573,8 @@ def backward_induction(
             values[:, t + 1], ka, kb, fit_cfg, mse, q_loss, metric_fns,
             fit_fn=fit_gn_jit if gn else fit, value_fn=_value,
             outputs_fn=_date_outputs,
-            q_fit_fn=fit if gn else None, q_fit_cfg=adam_cfg if gn else None,
+            q_fit_fn=(fit_gn_pinball_jit if gn_q else fit) if gn else None,
+            q_fit_cfg=q_cfg if gn else None,
         )
         values = values.at[:, t].set(v_t)
         phi_t, psi_t = _split_holdings(comb)

@@ -1,14 +1,14 @@
-"""Gauss-Newton / Levenberg-Marquardt fit for the MSE hedge regression.
+"""Gauss-Newton / Levenberg-Marquardt fits for the hedge regressions.
 
-The per-date fit is a ~100-parameter nonlinear least squares over up to 1M
+The per-date fit is a ~100-parameter nonlinear problem over up to 1M
 samples. Minibatch Adam solves it with O(10^3) SEQUENTIAL tiny steps per
 date — each microseconds of tensor work — so on TPU the walk's wall is pure
 step LATENCY (SCALING.md §3/§3a). Gauss-Newton inverts the shape of the
 work: ~10 full-batch iterations per date, each dominated by ONE large
 matmul pair
 
-    G = g^T g / n   (P x P Gram of per-sample value gradients, P ~ 97)
-    b = g^T r / n   (gradient of the half-MSE)
+    G = g^T W g / n   (P x P weighted Gram of per-sample value gradients)
+    b = g^T W r / n   (weighted normal-equations RHS, P ~ 97)
 
 — MXU-sized, and under a path-sharded mesh the reductions are psums, so
 the fit stage finally SCALES with chips instead of being latency-bound.
@@ -16,9 +16,25 @@ Levenberg-Marquardt damping (multiplicative, accept/reject on the true
 loss) makes it robust to the LeakyReLU kinks; a fixed iteration count with
 a converged-freeze keeps the whole fit one XLA program, same as fit_core.
 
-MSE only: GN is the natural algorithm for least squares; the 0.99-pinball
-quantile fit stays on Adam (``fit_core``). No reference analogue — the
-reference trains everything with Keras Adam (RP.py:177).
+Two losses, one core:
+
+- ``fit_gn`` — the MSE leg (W = I): plain damped Gauss-Newton, the natural
+  algorithm for least squares.
+- ``fit_gn_pinball`` — the 0.99-quantile leg (reference model2,
+  RP.py:138-142): IRLS. The pinball loss is an asymmetric L1,
+  ``rho_q(e) = a(e)|e|`` with ``a = q`` above / ``1-q`` below, so each
+  iteration solves the weighted least squares that majorises it at the
+  current residuals, ``w_i = a(e_i)/max(|e_i|, floor)`` — the classical
+  iteratively-reweighted quantile-regression step, here fused with the
+  LM-damped GN linearisation of the network. Fixed points of the weighted
+  normal equations are exactly the pinball stationary points
+  (``w·e = a·sign(e)``, the pinball subgradient); accept/reject on the TRUE
+  (smoothed) pinball loss guards every step. This replaces the ~10^5
+  sequential Adam steps the quantile leg otherwise costs per walk — the
+  exact latency wall §3c removed for the MSE leg.
+
+No reference analogue — the reference trains everything with Keras Adam
+(RP.py:177).
 """
 
 from __future__ import annotations
@@ -44,35 +60,43 @@ class GNConfig:
     ridge: float = 1e-9         # absolute floor added to the damped diagonal
 
 
-def fit_gn(
+@dataclasses.dataclass(frozen=True)
+class GNPinballConfig(GNConfig):
+    """IRLS weights for the quantile leg: ``w = a(e)/max(|e|, weight_floor)``.
+
+    ``weight_floor`` caps the weight of near-zero residuals (the IRLS
+    equivalent of the smoothed-pinball kink half-width — same 1e-3 default
+    as ``losses.smoothed_pinball``); it bounds the condition number of the
+    weighted Gram without moving the fixed point materially.
+    """
+
+    q: float = 0.99
+    weight_floor: float = 1e-3
+    # the asymmetric-L1 majoriser is rougher than the MSE's exact quadratic
+    # model, so start LM more cautiously than GNConfig's 1e-4
+    init_lambda: float = 1e-2
+
+
+def _gn_core(
     params,
     features: jax.Array,
     prices: jax.Array,
     targets: jax.Array,
-    key: jax.Array,  # unused (deterministic full-batch); kept for fit_core parity
     *,
     value_fn: Callable,
-    loss_fn: Callable,  # must be the MSE (asserted by the caller)
+    loss_fn: Callable,
     cfg: GNConfig,
+    weight_fn: Callable | None,
     metric_fns: tuple = (),
     solve_fn: Callable | None = None,
 ):
-    """Drop-in replacement for ``fit_core`` (MSE loss only).
+    """Shared LM-damped (weighted) Gauss-Newton scan.
 
-    Returns ``(best_params, aux)`` with the same aux contract: per-iteration
-    ``loss_history`` (inf past the freeze), ``best_loss``, ``n_epochs_ran``
-    (= accepted GN iterations), ``final_loss`` and ``metric_fns`` values.
+    ``weight_fn(r) -> (n,)`` supplies per-sample IRLS weights recomputed at
+    every iteration from the current residuals ``r = pred - y``; ``None``
+    means unweighted (plain GN for the MSE). Accept/reject and the freeze
+    test always use the TRUE ``loss_fn``.
     """
-    from orp_tpu.train import losses as L
-
-    if loss_fn is not L.mse:
-        # GN minimises mean squared residuals by construction; any other
-        # loss_fn would be silently ignored by the iterations while
-        # aux["final_loss"] reported it — refuse instead
-        raise ValueError(
-            "fit_gn optimises the MSE only; got a different loss_fn "
-            "(the quantile leg must stay on the Adam fit)"
-        )
     theta0, unravel = ravel_pytree(params)
     dim = theta0.shape[0]
     n = targets.shape[0]
@@ -82,8 +106,7 @@ def fit_gn(
         return value_fn(unravel(theta), features, prices) - y
 
     def loss_of(theta):
-        r = resid(theta)
-        return jnp.mean(r * r)
+        return loss_fn(value_fn(unravel(theta), features, prices), y)
 
     def grads_per_sample(theta):
         # J as one vmap'd gradient: (n, P). Memory n*P floats — 388MB at 1M
@@ -102,8 +125,12 @@ def fit_gn(
             theta, lam, best_loss, frozen = operand
             J = grads_per_sample(theta)
             r = resid(theta)
-            G = J.T @ J / n
-            b = J.T @ r / n
+            if weight_fn is None:
+                Jw = J
+            else:
+                Jw = J * weight_fn(r)[:, None]
+            G = Jw.T @ J / n
+            b = Jw.T @ r / n
             diag_scale = jnp.mean(jnp.diag(G)) + cfg.ridge
             A = G + (lam * diag_scale + cfg.ridge) * jnp.eye(dim, dtype=G.dtype)
             delta = jnp.linalg.solve(A, b)
@@ -122,7 +149,11 @@ def fit_gn(
                 jnp.where(improved, lam * cfg.lambda_down, lam * cfg.lambda_up),
                 1e-10, 1e10,
             )
-            return (theta, lam, best_loss, now_frozen), (cand_loss, take)
+            # history records the post-accept ACHIEVED loss (monotone
+            # non-increasing), matching fit_core's per-epoch training-loss
+            # semantics — not the candidate loss, whose rejected-LM-step
+            # spikes would read as divergence; rejects are in `takes`
+            return (theta, lam, best_loss, now_frozen), (best_loss, take)
 
         def skip(operand):
             # frozen: no Jacobian, no solve — XLA executes only this branch
@@ -155,3 +186,82 @@ def fit_gn(
     for fn in metric_fns:
         aux[fn.__name__] = fn(pred, y)
     return best_params, aux
+
+
+def fit_gn(
+    params,
+    features: jax.Array,
+    prices: jax.Array,
+    targets: jax.Array,
+    key: jax.Array,  # unused (deterministic full-batch); kept for fit_core parity
+    *,
+    value_fn: Callable,
+    loss_fn: Callable,  # must be the MSE (asserted by the caller)
+    cfg: GNConfig,
+    metric_fns: tuple = (),
+    solve_fn: Callable | None = None,
+):
+    """Drop-in replacement for ``fit_core`` (MSE loss only).
+
+    Returns ``(best_params, aux)`` with the same aux contract: per-iteration
+    ``loss_history`` (the post-accept achieved loss per iteration — monotone
+    non-increasing, fit_core's per-epoch semantics; inf past the freeze),
+    ``best_loss``, ``n_epochs_ran`` (= accepted GN iterations), ``final_loss``
+    and ``metric_fns`` values.
+    """
+    from orp_tpu.train import losses as L
+
+    if loss_fn is not L.mse:
+        # GN minimises mean squared residuals by construction; any other
+        # loss_fn would be silently ignored by the iterations while
+        # aux["final_loss"] reported it — refuse instead
+        raise ValueError(
+            "fit_gn optimises the MSE only; got a different loss_fn "
+            "(the quantile leg uses fit_gn_pinball)"
+        )
+    return _gn_core(
+        params, features, prices, targets,
+        value_fn=value_fn, loss_fn=loss_fn, cfg=cfg, weight_fn=None,
+        metric_fns=metric_fns, solve_fn=solve_fn,
+    )
+
+
+def fit_gn_pinball(
+    params,
+    features: jax.Array,
+    prices: jax.Array,
+    targets: jax.Array,
+    key: jax.Array,  # unused (deterministic full-batch); kept for fit_core parity
+    *,
+    value_fn: Callable,
+    loss_fn: Callable,  # the pinball/smoothed-pinball at cfg.q (accept/reject)
+    cfg: GNPinballConfig,
+    metric_fns: tuple = (),
+    solve_fn: Callable | None = None,  # refused: least squares is not the
+    # pinball optimum, a closed-form readout solve would undo the fit
+):
+    """IRLS Gauss-Newton for the quantile (pinball) leg — fit_core drop-in.
+
+    ``loss_fn`` must be the pinball (or smoothed pinball) at ``cfg.q``: it is
+    what accept/reject optimises, while the weighted normal equations supply
+    the step direction. Same aux contract as ``fit_gn``.
+    """
+    if solve_fn is not None:
+        raise ValueError(
+            "fit_gn_pinball: solve_fn (closed-form least-squares readout) "
+            "does not apply to the pinball objective"
+        )
+    q = cfg.q
+    floor = cfg.weight_floor
+
+    def weight_fn(r):
+        # r = pred - y; e = y - pred = -r. Above-prediction residuals (e>0,
+        # r<0) carry weight q, below carry 1-q — RP.py:138-142 orientation
+        a = jnp.where(r < 0, q, 1.0 - q)
+        return a / jnp.maximum(jnp.abs(r), floor)
+
+    return _gn_core(
+        params, features, prices, targets,
+        value_fn=value_fn, loss_fn=loss_fn, cfg=cfg, weight_fn=weight_fn,
+        metric_fns=metric_fns, solve_fn=None,
+    )

@@ -93,6 +93,17 @@ def replay_walk(
             "result has no per-date params (params1_by_date is None) — "
             "was it produced by a pre-replay version of the walk?"
         )
+    if cfg.dual_mode == "shared":
+        import warnings
+
+        warnings.warn(
+            "replay_walk with dual_mode='shared': the stored per-date "
+            "snapshot is the post-quantile-fit weights, so the replayed v_t "
+            "collapses to the quantile model's value — different semantics "
+            "than the training walk's g_pre combine. Holdings and residuals "
+            "are unaffected; treat the value ledger accordingly.",
+            stacklevel=2,
+        )
     prices_all = _stack_prices(
         jnp.asarray(y_prices, model.dtype), jnp.asarray(b_prices, model.dtype)
     )

@@ -118,6 +118,7 @@ PENSION_FAST = HedgeRunConfig(
 )
 
 
+@pytest.mark.slow
 def test_pension_hedge_end_to_end():
     res = pension_hedge(PENSION_FAST)
     # liability floor: guaranteed premium pool is ~N0*P=1M; V0 must be of that order
@@ -170,8 +171,8 @@ def test_legacy_sv_shim_uses_namespaced_c():
 
 
 def test_pension_hedge_gauss_newton_runs():
-    # GN on the 3-feature/122-param pension model (the MSE leg; the quantile
-    # leg of dual_mode="separate" stays on Adam)
+    # GN on the 3-feature/122-param pension model — both legs: LM-GN on the
+    # MSE leg, IRLS-GN pinball on the quantile leg (gn_quantile default)
     cfg = HedgeRunConfig(
         sim=SimConfig(n_paths=512, dt=1 / 12, rebalance_every=12),
         train=TrainConfig(

@@ -9,6 +9,7 @@ from math import exp
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from orp_tpu.sde import TimeGrid, simulate_heston_log
 from orp_tpu.utils.black_scholes import bs_call
@@ -17,6 +18,7 @@ from orp_tpu.utils.heston import heston_call, heston_put
 CFG4 = dict(v0=0.0225, kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6)
 
 
+@pytest.mark.slow
 def test_quadrature_converged():
     p = heston_call(100.0, 100.0, 0.08, 1.0, **CFG4)
     p_hi = heston_call(100.0, 100.0, 0.08, 1.0, u_max=400.0, n_quad=8192, **CFG4)

@@ -7,6 +7,7 @@ Runs on the 8-device virtual CPU mesh forced by conftest.py — the analogue of
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from orp_tpu.parallel import (
     histogram_quantile,
@@ -82,6 +83,7 @@ def test_histogram_quantile_sharded_input():
     )
 
 
+@pytest.mark.slow
 def test_european_pipeline_on_mesh_matches_single_device():
     # full pipeline with a path-sharded mesh: same Sobol indices -> same paths
     # -> numerically equivalent hedge (reduction order may differ slightly)

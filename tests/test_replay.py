@@ -59,6 +59,33 @@ def test_oos_refuses_training_seed():
         european_oos(trained, EURO, SIM, tr_cfg)
 
 
+def test_oos_refuses_cost_of_capital_drift():
+    # ADVICE r3: cost_of_capital enters the replayed value/holdings combine
+    # (g+i(h-g)) exactly like dual_mode — a mismatched replay must refuse
+    tr_cfg = TrainConfig(dual_mode="mse_only", epochs_first=25, epochs_warm=6,
+                         batch_size=1024, lr=1e-3, fused=True, shuffle="blocks")
+    trained = european_hedge(EURO, SIM, tr_cfg)
+    drifted = dataclasses.replace(tr_cfg, cost_of_capital=0.5)
+    with pytest.raises(ValueError, match="cost_of_capital"):
+        european_oos(trained, EURO, dataclasses.replace(SIM, seed_fund=777),
+                     drifted)
+
+
+@pytest.mark.slow
+def test_shared_mode_replay_warns_value_semantics():
+    # ADVICE r3: shared-mode replay collapses v_t to the quantile model's
+    # value (g_pre is not reconstructible from the post-quantile snapshot) —
+    # the caveat must be a runtime warning, not just a docstring
+    trained = _train(dual_mode="shared", fused=False)
+    with pytest.warns(UserWarning, match="dual_mode='shared'"):
+        european_oos(
+            trained, EURO, SIM,
+            TrainConfig(dual_mode="shared", epochs_first=25, epochs_warm=6,
+                        batch_size=1024, lr=1e-3),
+            allow_in_sample=True,
+        )
+
+
 def test_oos_fresh_scramble_matches_in_sample_quality():
     # a 97-param net cannot overfit 2048 paths meaningfully: OOS hedge
     # quality must be within 50% of in-sample, and the OOS CV price sane

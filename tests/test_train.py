@@ -213,6 +213,7 @@ def test_backward_shared_mode_g_predates_quantile_fit():
     assert float(jnp.abs(res.values[:, 0] - post).max()) > 1e-4
 
 
+@pytest.mark.slow
 def test_fused_walk_matches_host_loop():
     # the fused (single-XLA-program) walk must reproduce the host loop exactly:
     # same key stream, same math — only the dispatch structure differs
@@ -392,11 +393,15 @@ def test_gn_fit_matches_adam_quality_in_few_iters():
     assert np.isfinite(hist).any()
 
 
-def test_gn_walk_fused_matches_host():
+@pytest.mark.parametrize("dual_mode", ["mse_only", "separate"])
+def test_gn_walk_fused_matches_host(dual_mode):
+    # both GN engines — and in separate mode both LEGS (LM-GN mse + IRLS-GN
+    # pinball) — are deterministic full-batch, so fused and host walks must
+    # agree to f32 assembly noise
     S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=2048, n_steps=4)
     model = HedgeMLP(n_features=1)
     cfg = BackwardConfig(
-        dual_mode="mse_only", optimizer="gauss_newton",
+        dual_mode=dual_mode, optimizer="gauss_newton",
         gn_iters_first=10, gn_iters_warm=4, fused=False,
     )
     args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
@@ -410,14 +415,17 @@ def test_gn_walk_fused_matches_host():
     )
 
 
-def test_gn_walk_dual_mode_keeps_quantile_on_adam():
-    # separate mode with GN: the quantile leg still trains (Adam) and lifts
+@pytest.mark.parametrize("gn_quantile", [True, False])
+def test_gn_walk_dual_mode_trains_quantile_leg(gn_quantile):
+    # separate mode with GN: the quantile leg trains — by default on the
+    # IRLS-GN pinball solver (gn_quantile=True, train/gn.py:fit_gn_pinball),
+    # optionally on reference-semantics Adam (False) — and either way lifts
     # the value above the pure-MSE walk like the reference's combine does
     S0, K, r, sigma, T, S, B, payoff = _euro_setup(n_paths=2048, n_steps=2)
     model = HedgeMLP(n_features=1)
     base = BackwardConfig(
         dual_mode="separate", optimizer="gauss_newton",
-        gn_iters_first=10, gn_iters_warm=4,
+        gn_iters_first=10, gn_iters_warm=4, gn_quantile=gn_quantile,
         epochs_first=60, epochs_warm=20, batch_size=1024, lr=1e-3,
     )
     args = (model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0)
@@ -427,6 +435,61 @@ def test_gn_walk_dual_mode_keeps_quantile_on_adam():
         *args, dataclasses.replace(base, dual_mode="mse_only"), bias_init=bias
     )
     assert float(res.v0.mean()) > float(mse_only.v0.mean())
+
+
+@pytest.mark.slow
+def test_gn_pinball_matches_adam_quantile_fit():
+    # the IRLS-GN pinball solver reaches (at least) Adam's pinball loss and
+    # calibrated coverage in ~30 full-batch iterations — the quantile-leg
+    # analogue of §3c's sequential-step collapse. Same heteroscedastic
+    # synthetic problem as test_quantile_fit_coverage
+    from orp_tpu.train.gn import GNPinballConfig, fit_gn_pinball
+
+    q = 0.9
+    n = 2048
+    s = jnp.exp(jax.random.normal(jax.random.key(5), (n,)) * 0.3)
+    noise = jax.random.normal(jax.random.key(6), (n,)) * 0.2 * s
+    target = 0.5 * s + noise
+    prices = jnp.stack([s, jnp.ones(n)], axis=-1)
+    m = HedgeMLP(n_features=1)
+    p0 = m.init(jax.random.key(7))
+    ql = lambda pr, t: losses.pinball(pr, t, q)
+
+    p_adam, _ = fit(
+        p0, s[:, None], prices, target, jax.random.key(8),
+        value_fn=m.value, loss_fn=ql,
+        cfg=FitConfig(n_epochs=600, batch_size=512, patience=100, lr=1e-3),
+    )
+    loss_adam = float(ql(m.value(p_adam, s[:, None], prices), target))
+
+    p_gn, aux = fit_gn_pinball(
+        p0, s[:, None], prices, target, jax.random.key(8),
+        value_fn=m.value, loss_fn=ql, cfg=GNPinballConfig(n_iters=30, q=q),
+    )
+    pred = m.value(p_gn, s[:, None], prices)
+    coverage = float(jnp.mean(target <= pred))
+    assert abs(coverage - q) < 0.04, coverage
+    # 30 full-batch IRLS iterations vs 600 minibatch-epoch Adam: allow 2%
+    assert float(ql(pred, target)) < loss_adam * 1.02
+    # loss_history carries post-accept achieved losses: monotone non-increasing
+    hist = np.asarray(aux["loss_history"])
+    finite = hist[np.isfinite(hist)]
+    assert (np.diff(finite) <= 1e-12).all()
+
+
+def test_gn_pinball_refuses_solve_fn():
+    from orp_tpu.train.gn import GNPinballConfig, fit_gn_pinball
+
+    m = HedgeMLP(n_features=1)
+    p0 = m.init(jax.random.key(0))
+    x = jnp.ones((8, 1))
+    prices = jnp.ones((8, 2))
+    with pytest.raises(ValueError, match="solve_fn"):
+        fit_gn_pinball(
+            p0, x, prices, jnp.ones(8), jax.random.key(1),
+            value_fn=m.value, loss_fn=losses.pinball,
+            cfg=GNPinballConfig(n_iters=2), solve_fn=m.solve_readout,
+        )
 
 
 def test_backward_config_rejects_unknown_optimizer():

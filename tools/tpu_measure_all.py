@@ -63,6 +63,38 @@ def main(out_path):
         warm = ns(quiet=True)
         return {"cold": cold, "warm": warm}
 
+    def gn_dual():
+        # r4: the dual-model walk with BOTH legs on Gauss-Newton (LM-GN mse,
+        # IRLS-GN pinball — SCALING.md §3d) at benchmark scale; the wall
+        # witnesses the quantile leg's sequential-step collapse on the chip
+        import time as _t
+
+        from orp_tpu.api import (EuropeanConfig, SimConfig, TrainConfig,
+                                 european_hedge)
+
+        euro = EuropeanConfig(constrain_self_financing=False)
+        sim = SimConfig(n_paths=1 << 20, T=1.0, dt=1 / 364, rebalance_every=7)
+        train = TrainConfig(
+            dual_mode="separate", optimizer="gauss_newton",
+            gn_iters_first=100, gn_iters_warm=50,
+            batch_size=(1 << 20) // 64, fused=True, shuffle="blocks",
+        )
+
+        def run():
+            t0 = _t.perf_counter()
+            res = european_hedge(euro, sim, train)
+            return _t.perf_counter() - t0, res
+
+        cold_s, res = run()
+        warm_s, res = run()
+        return {
+            "cold_s": round(cold_s, 1), "warm_s": round(warm_s, 1),
+            "v0_cv": round(res.report.v0_cv, 5),
+            "cv_std": round(res.report.cv_std, 4),
+            "var99_overall": round(float(
+                res.report.var_overall[res.report.var_qs.index(0.99)]), 4),
+        }
+
     def rqmc():
         import io
         from contextlib import redirect_stdout
@@ -121,6 +153,7 @@ def main(out_path):
     # evidence in the file (all stages here use the scan engine; Pallas
     # shapes are probed separately via tools/pallas_bisect.py)
     stage("north_star", north)
+    stage("gn_dual_walk", gn_dual)
     stage("rqmc_ci", rqmc)
     stage("profile", profile)
     stage("paths_sweep", paths_sweep)
