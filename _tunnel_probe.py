@@ -1,0 +1,38 @@
+"""Shared accelerator-liveness probe for the repo-root driver entries.
+
+A dead axon tunnel hangs ``jax.devices()`` INDEFINITELY at interpreter start
+(client init never returns), so any driver entry that touches JAX in its own
+process first asks a SUBPROCESS with a timeout. The probe process exits
+cleanly, releasing the chip grant. One implementation, two consumers with
+different questions:
+
+- ``bench.py``: "is a non-CPU accelerator alive?" (else CPU-fallback re-exec);
+- ``__graft_entry__.py``: "how many devices are visible?" (else self-provision
+  a virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def probe_device_info(timeout_s: int = 150) -> dict | None:
+    """Platform + device count from a fresh JAX process, or ``None`` if the
+    probe times out / fails (treat as: no live backend)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "print('probe=%s,%d' % (ds[0].platform, len(ds)))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("probe="):
+            platform, n = line[len("probe="):].rsplit(",", 1)
+            return {"platform": platform, "n": int(n)}
+    return None

@@ -22,26 +22,17 @@ BASELINE_PATH_STEPS_PER_SEC = 15e6  # BASELINE.md "implied sim throughput"
 
 
 def _device_alive(timeout_s: int = 150) -> bool:
-    """Probe the accelerator in a SUBPROCESS with a timeout: a dead axon
-    tunnel hangs `jax.devices()` indefinitely at interpreter start, which
-    would turn the whole bench run into a silent hang instead of a record.
-    The probe process exits cleanly, releasing the chip grant."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('plat=' + jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        # a healthy CPU-only JAX is NOT a live accelerator (full-size 1M-path
-        # runs on CPU are the hang-equivalent the fallback exists to avoid);
-        # any non-cpu platform (tpu/axon here, gpu elsewhere) counts as alive
-        return (
-            r.returncode == 0
-            and "plat=" in r.stdout
-            and "plat=cpu" not in r.stdout
-        )
-    except subprocess.TimeoutExpired:
-        return False
+    """Probe the accelerator via the shared timeout-subprocess probe
+    (``_tunnel_probe``): a dead axon tunnel hangs `jax.devices()`
+    indefinitely at interpreter start, which would turn the whole bench run
+    into a silent hang instead of a record. A healthy CPU-only JAX is NOT a
+    live accelerator (full-size 1M-path runs on CPU are the hang-equivalent
+    the fallback exists to avoid); any non-cpu platform (tpu/axon here, gpu
+    elsewhere) counts as alive."""
+    from _tunnel_probe import probe_device_info
+
+    info = probe_device_info(timeout_s)
+    return info is not None and info["platform"] != "cpu"
 
 
 def main():

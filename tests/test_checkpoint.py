@@ -60,6 +60,33 @@ def test_resume_reproduces_uninterrupted_run(tmp_path):
         )
 
 
+def test_gn_dual_resume_reproduces_uninterrupted_run(tmp_path):
+    # r4: the GN walk with BOTH legs Gauss-Newton (LM-GN mse + IRLS-GN
+    # pinball, dual_mode="separate") under the v7 checkpoint fingerprint —
+    # resumed must equal uninterrupted exactly, quantile snapshots included
+    model, feats, y, b, term = _setup()
+    base = dict(
+        dual_mode="separate", optimizer="gauss_newton",
+        gn_iters_first=8, gn_iters_warm=4,
+        epochs_first=40, epochs_warm=20, batch_size=512,
+    )
+    full = backward_induction(model, feats, y, b, term, BackwardConfig(**base))
+    ckdir = str(tmp_path / "gn_walk")
+    first = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    resumed = backward_induction(
+        model, feats, y, b, term, BackwardConfig(checkpoint_dir=ckdir, **base)
+    )
+    for a, c in [(full, first), (first, resumed)]:
+        np.testing.assert_allclose(
+            np.asarray(a.values), np.asarray(c.values), rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(a.phi), np.asarray(c.phi), rtol=1e-6, atol=1e-7
+        )
+
+
 def test_checkpoint_saves_constant_size_increments(tmp_path):
     """Each step persists only its own date's columns — the fix for the
     O(walk^2) cumulative I/O of re-saving accumulated ledgers every date."""

@@ -29,6 +29,22 @@ def test_pension_single_step(capsys):
     assert out["v0"] > 0
 
 
+def test_euro_gn_dual_and_adam_quantile_flag(capsys):
+    # r4: --optimizer gauss_newton runs BOTH legs on GN (IRLS pinball leg);
+    # --adam-quantile keeps the quantile leg on Adam. Both must run and emit
+    # the JSON contract
+    for extra in ([], ["--adam-quantile"]):
+        cli.main([
+            "euro", "--paths", "512", "--steps", "4", "--rebalance-every", "2",
+            "--optimizer", "gauss_newton", "--gn-iters-first", "6",
+            "--gn-iters-warm", "3", "--dual-mode", "separate",
+            "--epochs-first", "20", "--epochs-warm", "10",
+            "--batch-size", "512", "--json", *extra,
+        ])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert np.isfinite(out["v0"])
+
+
 def test_heston_json(capsys):
     cli.main([
         "heston", "--paths", "512", "--steps", "8", "--rebalance-every", "2",

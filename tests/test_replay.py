@@ -71,19 +71,17 @@ def test_oos_refuses_cost_of_capital_drift():
                      drifted)
 
 
-@pytest.mark.slow
 def test_shared_mode_replay_warns_value_semantics():
     # ADVICE r3: shared-mode replay collapses v_t to the quantile model's
     # value (g_pre is not reconstructible from the post-quantile snapshot) —
-    # the caveat must be a runtime warning, not just a docstring
-    trained = _train(dual_mode="shared", fused=False)
+    # the caveat must be a runtime warning, not just a docstring. Tiny walk:
+    # only the warning path is under test, not hedge quality
+    sim = SimConfig(n_paths=256, T=1.0, dt=1 / 4, rebalance_every=1)
+    tr = TrainConfig(dual_mode="shared", epochs_first=4, epochs_warm=2,
+                     batch_size=256, lr=1e-3)
+    trained = european_hedge(EURO, sim, tr)
     with pytest.warns(UserWarning, match="dual_mode='shared'"):
-        european_oos(
-            trained, EURO, SIM,
-            TrainConfig(dual_mode="shared", epochs_first=25, epochs_warm=6,
-                        batch_size=1024, lr=1e-3),
-            allow_in_sample=True,
-        )
+        european_oos(trained, EURO, sim, tr, allow_in_sample=True)
 
 
 def test_oos_fresh_scramble_matches_in_sample_quality():
