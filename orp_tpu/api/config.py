@@ -118,6 +118,8 @@ class TrainConfig:
     gn_iters_warm: int = 10
     gn_quantile: bool = True  # gauss_newton only: IRLS-GN pinball solver for
     # the quantile leg too (BackwardConfig.gn_quantile); False = Adam leg
+    gn_block_rows: int | None = None  # gauss_newton only: blocked Gram
+    # accumulation (BackwardConfig.gn_block_rows) — O(block*P) fit memory
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist/resume per backward date
     shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)

@@ -198,6 +198,7 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         gn_iters_first=t.gn_iters_first,
         gn_iters_warm=t.gn_iters_warm,
         gn_quantile=t.gn_quantile,
+        gn_block_rows=t.gn_block_rows,
         seed=t.seed,
         checkpoint_dir=t.checkpoint_dir,
         shuffle=t.shuffle,

@@ -49,6 +49,7 @@ def _train_cfg(args, default_dual: str):
         gn_iters_first=args.gn_iters_first,
         gn_iters_warm=args.gn_iters_warm,
         gn_quantile=not args.adam_quantile,
+        gn_block_rows=args.gn_block_rows,
     )
 
 
@@ -76,6 +77,10 @@ def _add_train_flags(p):
                    help="with --optimizer gauss_newton: keep the quantile "
                         "leg on Adam (reference semantics) instead of the "
                         "IRLS-GN pinball solver")
+    p.add_argument("--gn-block-rows", type=int, default=None,
+                   help="with --optimizer gauss_newton: accumulate the Gram "
+                        "products over row blocks of this size (O(block*P) "
+                        "fit memory; 1.5x faster walk on CPU)")
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
 
 

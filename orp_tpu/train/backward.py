@@ -238,6 +238,9 @@ class BackwardConfig:
     # pinball solver (train/gn.py:fit_gn_pinball) at the same gn_iters —
     # removing the last ~10^5-sequential-step Adam wall from dual walks.
     # False keeps the quantile leg on reference-semantics Adam
+    gn_block_rows: int | None = None  # GNConfig.block_rows: accumulate the
+    # Gram products over row blocks (O(block*P) fit memory) instead of
+    # materialising the (n, P) Jacobian — the >1M-path headroom knob
     seed: int = 1234
     checkpoint_dir: str | None = None  # persist state per date; resume if present
     shuffle: bool | str = True  # per-epoch row shuffling policy (FitConfig.shuffle):
@@ -322,11 +325,14 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
     gn = cfg.optimizer == "gauss_newton"
     gn_q = gn and cfg.gn_quantile
     if gn:
-        first_cfg = GNConfig(n_iters=cfg.gn_iters_first)
-        warm_cfg = GNConfig(n_iters=cfg.gn_iters_warm)
+        blk = cfg.gn_block_rows
+        first_cfg = GNConfig(n_iters=cfg.gn_iters_first, block_rows=blk)
+        warm_cfg = GNConfig(n_iters=cfg.gn_iters_warm, block_rows=blk)
         if gn_q:
-            q_first = GNPinballConfig(n_iters=cfg.gn_iters_first, q=cfg.quantile)
-            q_warm = GNPinballConfig(n_iters=cfg.gn_iters_warm, q=cfg.quantile)
+            q_first = GNPinballConfig(n_iters=cfg.gn_iters_first,
+                                      q=cfg.quantile, block_rows=blk)
+            q_warm = GNPinballConfig(n_iters=cfg.gn_iters_warm,
+                                     q=cfg.quantile, block_rows=blk)
         else:
             q_first, q_warm = adam_first, adam_warm
     else:
@@ -509,8 +515,11 @@ def backward_induction(
         # v5 = optimizer/gn_iters (r3); v6 = GNConfig repr folded into the
         # fingerprint string below + the gentler default damping (r3), which
         # changes what GN-trained directories contain; v7 = BackwardConfig
-        # grew gn_quantile + GNPinballConfig folded in (r4). A dir from an
-        # older field set refuses cleanly here instead of failing in replay
+        # grew gn_quantile + GNPinballConfig folded in (r4); v8 =
+        # gn_block_rows/block_rows fields (r4 — block_rows changes the
+        # reduction order, so resumed-vs-uninterrupted exactness requires it
+        # in the fingerprint). A dir from an older field set refuses cleanly
+        # here instead of failing in replay
         # GN config class defaults (LM damping, IRLS floor etc.) are training
         # policy that lives OUTSIDE BackwardConfig — folding the instance
         # reprs in makes any future default change auto-invalidate old dirs
@@ -518,7 +527,7 @@ def backward_induction(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
             f"gn={GNConfig(n_iters=0)} gnq={GNPinballConfig(n_iters=0)} "
-            "ckpt_format=increment-v7",
+            "ckpt_format=increment-v8",
         )
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
@@ -560,9 +569,13 @@ def backward_induction(
         gn = cfg.optimizer == "gauss_newton"
         gn_q = gn and cfg.gn_quantile
         n_iters = cfg.gn_iters_first if first else cfg.gn_iters_warm
-        fit_cfg = GNConfig(n_iters=n_iters) if gn else adam_cfg
+        fit_cfg = (
+            GNConfig(n_iters=n_iters, block_rows=cfg.gn_block_rows)
+            if gn else adam_cfg
+        )
         q_cfg = (
-            GNPinballConfig(n_iters=n_iters, q=cfg.quantile)
+            GNPinballConfig(n_iters=n_iters, q=cfg.quantile,
+                            block_rows=cfg.gn_block_rows)
             if gn_q else adam_cfg
         )
         # one date = MSE fit + dual-mode quantile fit + fused outputs program

@@ -58,6 +58,15 @@ class GNConfig:
     min_rel_improve: float = 1e-7  # freeze once an accepted step improves
     # the loss by less than this relative amount (converged)
     ridge: float = 1e-9         # absolute floor added to the damped diagonal
+    block_rows: int | None = None  # accumulate the Gram/rhs over row blocks
+    # of this size (lax.scan) instead of materialising the full (n, P)
+    # Jacobian: peak fit memory drops from O(n*P) to O(block*P) — the
+    # >1M-path / vector-hedge headroom knob. None (default) = one-shot
+    # products, bit-identical to r3. Blocked accumulation changes the
+    # reduction order (f32 sums differ in low bits, so LM trajectories can
+    # drift like any reduction-order change — SCALING.md §2 r4 note).
+    # A block that does not divide n raises (a silent one-shot fallback
+    # would defeat the memory bound); n <= block needs no blocking
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,29 +117,58 @@ def _gn_core(
     def loss_of(theta):
         return loss_fn(value_fn(unravel(theta), features, prices), y)
 
-    def grads_per_sample(theta):
-        # J as one vmap'd gradient: (n, P). Memory n*P floats — 388MB at 1M
-        # paths, sharded over the path mesh like every other (n, ...) array
+    def grads_per_sample(theta, f, p):
+        # J as one vmap'd gradient: (rows, P). Memory rows*P floats — 388MB
+        # at 1M paths one-shot, sharded over the path mesh like every other
+        # (n, ...) array; cfg.block_rows caps rows instead (scan below)
         def one(fx, px):
             return jax.grad(
                 lambda t: value_fn(unravel(t), fx[None], px[None])[0]
             )(theta)
 
-        return jax.vmap(one)(features, prices)
+        return jax.vmap(one)(f, p)
+
+    block = cfg.block_rows
+    blocked = block is not None and n > block
+    if blocked and n % block != 0:
+        # the knob exists solely to bound fit memory; silently reverting to
+        # the full (n, P) Jacobian would OOM exactly the run that set it
+        raise ValueError(
+            f"block_rows={block} does not divide n={n} rows — pick a "
+            "divisor (n <= block_rows needs no blocking and is accepted)"
+        )
+
+    def gram_products(theta):
+        """(G, b) = (JᵀWJ/n, JᵀWr/n) — one-shot, or accumulated over
+        ``cfg.block_rows``-row blocks so J never materialises at (n, P)."""
+        if not blocked:
+            J = grads_per_sample(theta, features, prices)
+            r = resid(theta)
+            Jw = J if weight_fn is None else J * weight_fn(r)[:, None]
+            return Jw.T @ J / n, Jw.T @ r / n
+
+        k = n // block
+        reshape = lambda a: a.reshape(k, block, *a.shape[1:])
+        fb, pb, yb = reshape(features), reshape(prices), reshape(y)
+
+        def acc(carry, xs):
+            G, b = carry
+            f, p, yy = xs
+            Jb = grads_per_sample(theta, f, p)
+            rb = value_fn(unravel(theta), f, p) - yy
+            Jw = Jb if weight_fn is None else Jb * weight_fn(rb)[:, None]
+            return (G + Jw.T @ Jb, b + Jw.T @ rb), None
+
+        zero = (jnp.zeros((dim, dim), theta.dtype), jnp.zeros(dim, theta.dtype))
+        (G, b), _ = jax.lax.scan(acc, zero, (fb, pb, yb))
+        return G / n, b / n
 
     def body(carry, _):
         theta, lam, best_loss, frozen = carry
 
         def do(operand):
             theta, lam, best_loss, frozen = operand
-            J = grads_per_sample(theta)
-            r = resid(theta)
-            if weight_fn is None:
-                Jw = J
-            else:
-                Jw = J * weight_fn(r)[:, None]
-            G = Jw.T @ J / n
-            b = Jw.T @ r / n
+            G, b = gram_products(theta)
             diag_scale = jnp.mean(jnp.diag(G)) + cfg.ridge
             A = G + (lam * diag_scale + cfg.ridge) * jnp.eye(dim, dtype=G.dtype)
             delta = jnp.linalg.solve(A, b)

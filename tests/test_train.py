@@ -477,6 +477,56 @@ def test_gn_pinball_matches_adam_quantile_fit():
     assert (np.diff(finite) <= 1e-12).all()
 
 
+def test_gn_blocked_gram_matches_one_shot():
+    # block_rows accumulates JᵀWJ/JᵀWr over row blocks (O(block*P) memory)
+    # instead of materialising the (n, P) Jacobian. Oracle: ONE iteration —
+    # theta1 = theta0 - solve(A, b) is a pure function of the Gram products,
+    # so blocked and one-shot must agree to f32 sum-reduction noise. (Multi-
+    # iteration trajectories drift through the LM accept/reject branches
+    # like any reduction-order change — SCALING.md §2 r4 note — so they are
+    # NOT the oracle.)
+    from orp_tpu.train.gn import (
+        GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball,
+    )
+
+    n = 2048
+    s = jnp.exp(jax.random.normal(jax.random.key(5), (n,)) * 0.3)
+    noise = jax.random.normal(jax.random.key(6), (n,)) * 0.2 * s
+    target = 0.5 * s + noise
+    prices = jnp.stack([s, jnp.ones(n)], axis=-1)
+    # f64 model (conftest enables x64): the 97x97 normal-equations solve has
+    # cond ~1e6 from the (Y, B) price collinearity, which amplifies the f32
+    # blocked-vs-one-shot sum noise (~1e-7) to ~1e-3 in the step — f64 sums
+    # push the reduction noise far below the oracle band, leaving only
+    # structural bugs (wrong rows/weights) visible
+    m = HedgeMLP(n_features=1, dtype=jnp.float64)
+    p0 = m.init(jax.random.key(7))
+    ql = lambda pr, t: losses.pinball(pr, t, 0.9)
+
+    def one_iter(fit_fn, loss_fn, cfg_cls, **kw):
+        def run(block):
+            p, _ = fit_fn(
+                p0, s[:, None], prices, target, jax.random.key(8),
+                value_fn=m.value, loss_fn=loss_fn,
+                cfg=cfg_cls(n_iters=1, block_rows=block, **kw),
+            )
+            return np.asarray(m.value(p, s[:, None], prices))
+        return run
+
+    run_mse = one_iter(fit_gn, losses.mse, GNConfig)
+    np.testing.assert_allclose(run_mse(256), run_mse(None), rtol=1e-4, atol=1e-5)
+
+    run_q = one_iter(fit_gn_pinball, ql, GNPinballConfig, q=0.9)
+    np.testing.assert_allclose(run_q(256), run_q(None), rtol=1e-4, atol=1e-5)
+
+    # a block that doesn't divide n REFUSES (a silent one-shot fallback
+    # would defeat the memory bound the knob exists for); n <= block is
+    # accepted and bitwise equal to one-shot
+    with pytest.raises(ValueError, match="does not divide"):
+        run_mse(1000)
+    np.testing.assert_allclose(run_mse(4096), run_mse(None), rtol=0, atol=0)
+
+
 def test_gn_pinball_refuses_solve_fn():
     from orp_tpu.train.gn import GNPinballConfig, fit_gn_pinball
 

@@ -32,6 +32,11 @@ def main(argv=None):
     ap.add_argument("--configs", default=None,
                     help="semicolon list of batch_div,epochs_first,epochs_warm"
                          "[,final_solve(0|1)[,lr]] (defaults: solve 0, lr 1e-3)")
+    ap.add_argument("--gn-configs", default=None,
+                    help="semicolon list of iters_first,iters_warm — runs the "
+                         "Gauss-Newton walk instead of the Adam frontier "
+                         "(e.g. '60,30;100,50' reproduces the r4 quality "
+                         "ladder of GN_QUALITY_r4.jsonl / SCALING.md §3c-bis)")
     args = ap.parse_args(argv)
 
     import jax
@@ -55,19 +60,11 @@ def main(argv=None):
     grid = [c + (0, 1e-3)[len(c) - 3:] for c in grid]
 
     out = open(args.out, "a")
-    for batch_div, e_first, e_warm, solve, lr in grid:
+
+    def emit(base, run):
         t0 = time.perf_counter()
-        base = {"batch_div": batch_div, "epochs_first": e_first,
-                "epochs_warm": e_warm, "final_solve": bool(solve), "lr": lr,
-                "solve_variant": "shrink" if solve else None}
         try:
-            # this tool sweeps the ADAM frontier; the GN default would
-            # make the epochs/batch knobs silent no-ops
-            res = ns(n_paths=1 << args.paths_log2, epochs_first=e_first,
-                     epochs_warm=e_warm, batch_div=batch_div,
-                     final_solve=bool(solve), lr=lr, optimizer="adam",
-                     quiet=True)
-            rec = {**base, **res}
+            rec = {**base, **run()}
         except Exception as e:  # noqa: BLE001
             rec = {**base, "error": f"{type(e).__name__}: {e}"[:200]}
         rec["total_s"] = round(time.perf_counter() - t0, 1)
@@ -75,6 +72,32 @@ def main(argv=None):
         out.write(json.dumps(rec) + "\n")
         out.flush()
         print(json.dumps(rec), flush=True)
+
+    if args.gn_configs:
+        # the GN iteration ladder (cv_std/VaR99 vs sequential steps —
+        # SCALING.md §3c/§3c-bis); the Adam epochs/batch knobs are no-ops
+        # under optimizer="gauss_newton", so this is a separate sweep
+        for c in args.gn_configs.split(";"):
+            i_first, i_warm = (int(x) for x in c.split(","))
+            emit(
+                {"optimizer": "gauss_newton", "gn_iters_first": i_first,
+                 "gn_iters_warm": i_warm,
+                 "seq_steps": i_first + 51 * i_warm},
+                lambda i=(i_first, i_warm): ns(
+                    n_paths=1 << args.paths_log2, optimizer="gauss_newton",
+                    gn_iters=i, quiet=True),
+            )
+    else:
+        for batch_div, e_first, e_warm, solve, lr in grid:
+            emit(
+                {"batch_div": batch_div, "epochs_first": e_first,
+                 "epochs_warm": e_warm, "final_solve": bool(solve), "lr": lr,
+                 "solve_variant": "shrink" if solve else None},
+                lambda b=batch_div, ef=e_first, ew=e_warm, s=solve, l=lr: ns(
+                    n_paths=1 << args.paths_log2, epochs_first=ef,
+                    epochs_warm=ew, batch_div=b, final_solve=bool(s), lr=l,
+                    optimizer="adam", quiet=True),
+            )
     out.close()
 
 

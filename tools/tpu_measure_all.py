@@ -95,6 +95,19 @@ def main(out_path):
                 res.report.var_overall[res.report.var_qs.index(0.99)]), 4),
         }
 
+    def gn_blocked():
+        # r4: blocked Gram accumulation (GNConfig.block_rows) vs the one-shot
+        # (n, P) Jacobian at the benchmark default — decides whether the knob
+        # becomes the TPU default (it is 1.5x on CPU; on TPU it trades HBM
+        # traffic for scan steps). Run TWICE like the sibling stages: the
+        # blocked walk is a NEW XLA program (cold includes its compile), and
+        # only the warm number is comparable to north_star's warm baseline
+        from benchmarks.north_star import main as ns
+
+        cold = ns(gn_block_rows=1 << 14, quiet=True)
+        warm = ns(gn_block_rows=1 << 14, quiet=True)
+        return {"blocked_16k": {"cold": cold, "warm": warm}}
+
     def rqmc():
         import io
         from contextlib import redirect_stdout
@@ -154,6 +167,7 @@ def main(out_path):
     # shapes are probed separately via tools/pallas_bisect.py)
     stage("north_star", north)
     stage("gn_dual_walk", gn_dual)
+    stage("gn_blocked", gn_blocked)
     stage("rqmc_ci", rqmc)
     stage("profile", profile)
     stage("paths_sweep", paths_sweep)
