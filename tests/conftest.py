@@ -20,6 +20,15 @@ import jax  # noqa: E402
 # the config update (unlike the env var) reliably pins the platform to CPU.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# Persistent XLA compile cache: the suite's wall is dominated by per-test
+# compiles of the same fused-walk/fit programs (~8-16s each, re-done every
+# run). Separate dir from the benchmark cache (.jax_cache): the test env
+# differs (x64 + virtual 8-device CPU) and mixing would churn both.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

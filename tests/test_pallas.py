@@ -175,6 +175,7 @@ def test_pallas_pension_rejects_exact_mode():
         )
 
 
+@pytest.mark.slow
 def test_pallas_sv_pension_inversion_matches_xla_scan():
     # the sv (4-factor) branch wires inversion through uniform_factors too —
     # a factor-3 uniform-delivery regression specific to that layout must fail
@@ -200,6 +201,7 @@ def test_pallas_sv_pension_inversion_matches_xla_scan():
     assert np.abs(n_ref - n_got).max() <= 1.0
 
 
+@pytest.mark.slow
 def test_pallas_dynamic_store_branch_matches_scan(monkeypatch):
     # the >_STATIC_STORE_MAX_KNOTS fallback (dynamic-dslice stores) gets zero
     # coverage from the small-knot tests above once the static unroll exists:
@@ -229,6 +231,7 @@ def test_pallas_dynamic_store_branch_matches_scan(monkeypatch):
     np.testing.assert_allclose(np.asarray(dyn_out), np.asarray(ref), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_pallas_mf_dynamic_store_branch_matches_static(monkeypatch):
     import orp_tpu.qmc.pallas_mf as pm
     from orp_tpu.qmc.pallas_mf import heston_log_pallas

@@ -40,6 +40,7 @@ def test_replay_identity_on_training_paths():
         )
 
 
+@pytest.mark.slow
 def test_replay_identity_separate_mode_host_walk():
     tr_cfg = TrainConfig(dual_mode="separate", epochs_first=25, epochs_warm=6,
                          batch_size=1024, lr=1e-3)

@@ -92,6 +92,7 @@ def test_pension_binomial_normal_mode_close_to_exact():
     assert abs(float(np.std(np.asarray(a["N"][:, -1]))) - float(np.std(np.asarray(b["N"][:, -1])))) < 30
 
 
+@pytest.mark.slow
 def test_sv_pension_reference_form_runs_and_is_sane():
     # RP.py:280-289 semantics (drift without dt), CIR params from Extra#8(out)
     grid = TimeGrid(T=10.0, n_steps=1000)

@@ -394,6 +394,7 @@ def test_gn_fit_matches_adam_quality_in_few_iters():
 
 
 @pytest.mark.parametrize("dual_mode", ["mse_only", "separate"])
+@pytest.mark.slow
 def test_gn_walk_fused_matches_host(dual_mode):
     # both GN engines — and in separate mode both LEGS (LM-GN mse + IRLS-GN
     # pinball) — are deterministic full-batch, so fused and host walks must
