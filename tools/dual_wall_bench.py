@@ -1,0 +1,70 @@
+"""Dual-walk wall/quality bench: Adam vs GN-IRLS both legs (SCALING.md §3d).
+
+The reference's model2 (0.99-quantile leg) makes every separate/shared walk
+a DUAL training problem; this tool measures the end-to-end wall and the
+hedge-quality ledgers (cv_std, VaR99) for the Adam dual walk vs the
+Gauss-Newton walk with the IRLS pinball leg, optionally with blocked Gram
+accumulation. Produced `DUAL_WALL_r4.jsonl` (the committed r4 record).
+
+Usage: python tools/dual_wall_bench.py [out.jsonl] [--paths-log2 17]
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out", nargs="?", default=str(HERE / "DUAL_WALL.jsonl"))
+    ap.add_argument("--paths-log2", type=int, default=17)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    n = 1 << args.paths_log2
+    euro = EuropeanConfig(constrain_self_financing=False)
+    sim = SimConfig(n_paths=n, T=1.0, dt=1 / 364, rebalance_every=7)
+    configs = [
+        ("adam_dual", dict(dual_mode="separate", epochs_first=120,
+                           epochs_warm=30, batch_size=n // 64, lr=1e-3)),
+        ("gn_dual_100_50", dict(dual_mode="separate",
+                                optimizer="gauss_newton", gn_iters_first=100,
+                                gn_iters_warm=50, batch_size=n // 64)),
+        ("gn_dual_100_50_blk", dict(dual_mode="separate",
+                                    optimizer="gauss_newton",
+                                    gn_iters_first=100, gn_iters_warm=50,
+                                    gn_block_rows=max(n // 16, 1024),
+                                    batch_size=n // 64)),
+    ]
+    out = open(args.out, "a")
+    for label, kw in configs:
+        train = TrainConfig(fused=True, shuffle="blocks", **kw)
+        t0 = time.time()
+        res = european_hedge(euro, sim, train)
+        rec = {
+            "config": label, "paths": n,
+            "wall_s": round(time.time() - t0, 1),
+            "v0": round(float(res.v0), 5),
+            "v0_cv": round(float(res.report.v0_cv), 5),
+            "cv_std": round(float(res.report.cv_std), 4),
+            "var99": round(float(
+                res.report.var_overall[res.report.var_qs.index(0.99)]), 4),
+            "platform": jax.devices()[0].platform,
+        }
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+        print(json.dumps(rec), flush=True)
+    out.close()
+
+
+if __name__ == "__main__":
+    main()
