@@ -33,10 +33,11 @@ def main(argv=None):
                     help="semicolon list of batch_div,epochs_first,epochs_warm"
                          "[,final_solve(0|1)[,lr]] (defaults: solve 0, lr 1e-3)")
     ap.add_argument("--gn-configs", default=None,
-                    help="semicolon list of iters_first,iters_warm — runs the "
-                         "Gauss-Newton walk instead of the Adam frontier "
-                         "(e.g. '60,30;100,50' reproduces the r4 quality "
-                         "ladder of GN_QUALITY_r4.jsonl / SCALING.md §3c-bis)")
+                    help="semicolon list of iters_first,iters_warm[,block] — "
+                         "runs the Gauss-Newton walk instead of the Adam "
+                         "frontier (e.g. '60,30;100,50' reproduces the r4 "
+                         "quality ladder of GN_QUALITY_r4.jsonl / SCALING.md "
+                         "§3c-bis; block = gn_block_rows, 0 = one-shot)")
     args = ap.parse_args(argv)
 
     import jax
@@ -78,14 +79,16 @@ def main(argv=None):
         # SCALING.md §3c/§3c-bis); the Adam epochs/batch knobs are no-ops
         # under optimizer="gauss_newton", so this is a separate sweep
         for c in args.gn_configs.split(";"):
-            i_first, i_warm = (int(x) for x in c.split(","))
+            parts = [int(x) for x in c.split(",")]
+            i_first, i_warm = parts[0], parts[1]
+            block = parts[2] if len(parts) > 2 and parts[2] else None
             emit(
                 {"optimizer": "gauss_newton", "gn_iters_first": i_first,
-                 "gn_iters_warm": i_warm,
+                 "gn_iters_warm": i_warm, "gn_block_rows": block,
                  "seq_steps": i_first + 51 * i_warm},
-                lambda i=(i_first, i_warm): ns(
+                lambda i=(i_first, i_warm), b=block: ns(
                     n_paths=1 << args.paths_log2, optimizer="gauss_newton",
-                    gn_iters=i, quiet=True),
+                    gn_iters=i, gn_block_rows=b, quiet=True),
             )
     else:
         for batch_div, e_first, e_warm, solve, lr in grid:
