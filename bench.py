@@ -109,7 +109,7 @@ def main():
         from benchmarks.north_star import main as north_star
 
         # CPU fallback keeps the Adam walk: Gauss-Newton's full-batch
-        # Jacobian products are the FASTER choice on TPU (~2,650 big MXU
+        # Jacobian products are the FASTER choice on TPU (~3,975 big MXU
         # steps vs 105,600 latency-bound ones) but the slower one on a CPU
         hedge = north_star(
             n_paths=n_paths,

@@ -165,17 +165,19 @@ def test_golden_pension_single_step_gn_irls():
 def test_benchmark_default_matches_measured_row():
     # VERDICT r3 weak #3: the shipped benchmark default must be the config a
     # measured row exists for. GN_QUALITY_r4.jsonl / PARITY.md measured
-    # optimizer="gauss_newton" at gn_iters=(100, 50) (cv_std 3.427 / VaR99
-    # 1.321 at 131k; 1M row appended when the run lands) — if anyone moves
-    # the default, this fails and forces a re-measure, so the default can
-    # never again ship unmeasured
+    # optimizer="gauss_newton", gn_iters=(150, 75), gn_block_rows=16384
+    # VERBATIM at 1M (acv −0.067bp, cv_std 2.442, VaR99 1.299 — row
+    # gn_150_75_block16k_1M_cpu_f32) — if anyone moves the default, this
+    # fails and forces a re-measure, so the default can never again ship
+    # unmeasured
     import inspect
 
     from benchmarks.north_star import main as ns
 
     sig = inspect.signature(ns)
     assert sig.parameters["optimizer"].default == "gauss_newton"
-    assert sig.parameters["gn_iters"].default == (100, 50)
+    assert sig.parameters["gn_iters"].default == (150, 75)
+    assert sig.parameters["gn_block_rows"].default == 16384
     assert sig.parameters["n_paths"].default == 1 << 20
     # and the walk config it builds: GNConfig defaults are the measured
     # gentle damping (SCALING.md §3c)

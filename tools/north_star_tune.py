@@ -37,7 +37,8 @@ def main(argv=None):
                          "runs the Gauss-Newton walk instead of the Adam "
                          "frontier (e.g. '60,30;100,50' reproduces the r4 "
                          "quality ladder of GN_QUALITY_r4.jsonl / SCALING.md "
-                         "§3c-bis; block = gn_block_rows, 0 = one-shot)")
+                         "§3c-bis; block = gn_block_rows: omitted = the "
+                         "benchmark's shipped default, 0 = one-shot)")
     args = ap.parse_args(argv)
 
     import jax
@@ -81,14 +82,20 @@ def main(argv=None):
         for c in args.gn_configs.split(";"):
             parts = [int(x) for x in c.split(",")]
             i_first, i_warm = parts[0], parts[1]
-            block = parts[2] if len(parts) > 2 and parts[2] else None
+            # omitted third field = inherit the benchmark's SHIPPED default
+            # (so 'i,j' sweeps stay config-identical to the default rows);
+            # 0 = explicit one-shot; any other value = gn_block_rows
+            blk_kw = {}
+            if len(parts) > 2:
+                blk_kw["gn_block_rows"] = parts[2] or None
             emit(
                 {"optimizer": "gauss_newton", "gn_iters_first": i_first,
-                 "gn_iters_warm": i_warm, "gn_block_rows": block,
+                 "gn_iters_warm": i_warm,
+                 "gn_block_rows": blk_kw.get("gn_block_rows", "default"),
                  "seq_steps": i_first + 51 * i_warm},
-                lambda i=(i_first, i_warm), b=block: ns(
+                lambda i=(i_first, i_warm), kw=blk_kw: ns(
                     n_paths=1 << args.paths_log2, optimizer="gauss_newton",
-                    gn_iters=i, gn_block_rows=b, quiet=True),
+                    gn_iters=i, quiet=True, **kw),
             )
     else:
         for batch_div, e_first, e_warm, solve, lr in grid:

@@ -76,7 +76,7 @@ def main(out_path):
         sim = SimConfig(n_paths=1 << 20, T=1.0, dt=1 / 364, rebalance_every=7)
         train = TrainConfig(
             dual_mode="separate", optimizer="gauss_newton",
-            gn_iters_first=100, gn_iters_warm=50,
+            gn_iters_first=150, gn_iters_warm=75, gn_block_rows=1 << 14,
             batch_size=(1 << 20) // 64, fused=True, shuffle="blocks",
         )
 
@@ -95,18 +95,18 @@ def main(out_path):
                 res.report.var_overall[res.report.var_qs.index(0.99)]), 4),
         }
 
-    def gn_blocked():
-        # r4: blocked Gram accumulation (GNConfig.block_rows) vs the one-shot
-        # (n, P) Jacobian at the benchmark default — decides whether the knob
-        # becomes the TPU default (it is 1.5x on CPU; on TPU it trades HBM
-        # traffic for scan steps). Run TWICE like the sibling stages: the
-        # blocked walk is a NEW XLA program (cold includes its compile), and
-        # only the warm number is comparable to north_star's warm baseline
+    def gn_oneshot():
+        # r4: the benchmark default ships BLOCKED Gram accumulation
+        # (gn_block_rows=16384 — 2.5-4.7x faster on CPU); this stage runs the
+        # ONE-SHOT (n, P) Jacobian variant so the chip decides the knob with
+        # both sides measured. Run TWICE like the sibling stages: the
+        # one-shot walk is a different XLA program (cold includes its
+        # compile); only warm-vs-warm against north_star is comparable
         from benchmarks.north_star import main as ns
 
-        cold = ns(gn_block_rows=1 << 14, quiet=True)
-        warm = ns(gn_block_rows=1 << 14, quiet=True)
-        return {"blocked_16k": {"cold": cold, "warm": warm}}
+        cold = ns(gn_block_rows=None, quiet=True)
+        warm = ns(gn_block_rows=None, quiet=True)
+        return {"oneshot": {"cold": cold, "warm": warm}}
 
     def rqmc():
         import io
@@ -167,7 +167,7 @@ def main(out_path):
     # shapes are probed separately via tools/pallas_bisect.py)
     stage("north_star", north)
     stage("gn_dual_walk", gn_dual)
-    stage("gn_blocked", gn_blocked)
+    stage("gn_oneshot", gn_oneshot)
     stage("rqmc_ci", rqmc)
     stage("profile", profile)
     stage("paths_sweep", paths_sweep)
