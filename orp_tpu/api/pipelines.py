@@ -481,7 +481,9 @@ def _basket_setup(basket: BasketConfig, sim: SimConfig, mesh, instruments, name)
         store_every=sim.rebalance_every, dtype=dtype,
     )
     w = jnp.asarray(basket.weights, dtype)
-    bkt = s @ w
+    # full f32: bf16-rounding the fixed weights would tilt the whole basket
+    # price deterministically (SCALING.md §6b defect class)
+    bkt = jnp.matmul(s, w, precision="highest")
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, basket.r, dtype)
     payoff = payoffs.basket_call(s[:, -1], w, basket.strike)
@@ -519,7 +521,7 @@ def _basket_report(basket, sim, res, s, w, bkt, coarse, b, payoff, norm,
     # controls normalise each instrument by ITS OWN initial price, so the
     # basis kink belongs at strike / initial-basket-level (norm is the
     # strike itself, which would pin the kink at 1.0 regardless of moneyness)
-    b0 = float(jnp.dot(jnp.asarray(basket.s0, dtype), w))
+    b0 = float(jnp.dot(jnp.asarray(basket.s0, dtype), w, precision="highest"))
     _attach_cv_price(report, res, s if vector else bkt, payoff, basket.r,
                      times, strike_over_s0=basket.strike / b0)
     from orp_tpu.utils.basket import basket_call_mm

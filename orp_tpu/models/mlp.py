@@ -32,6 +32,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from orp_tpu.utils.precision import highest_matmul_precision
+
 Params = Any  # nested dict pytree
 
 
@@ -122,6 +124,7 @@ class HedgeMLP:
             x = jnp.where(x >= 0, x, self.negative_slope * x)  # LeakyReLU
         return x
 
+    @highest_matmul_precision
     def solve_readout(
         self,
         params: Params,
@@ -154,6 +157,12 @@ class HedgeMLP:
         (the penalty vanishes at theta0), so the step can never hurt the
         training loss it replaces. No reference analogue; exposed via
         ``FitConfig``'s ``solve_fn`` hook / ``TrainConfig.final_solve``.
+
+        Traces under full-f32 matmul precision (``highest_matmul_precision``):
+        normal equations square the condition number, and the Gram here is
+        ill-conditioned by construction (see the shrinkage note) — TPU's
+        default bf16 rounding cannot be allowed near it. The products are
+        (n, ~H+1)-sized: full-f32 is free.
         """
         dt = self.dtype
         h = self.last_hidden(params, features)                   # (n, H)

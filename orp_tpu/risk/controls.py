@@ -34,8 +34,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from orp_tpu.utils.precision import highest_matmul_precision
+
 
 @jax.jit
+@highest_matmul_precision
 def _backfit_scan(y, m_cols, phi_cols, dm_cols, k, ridge):
     """Sequential per-(date, asset) OLS backfitting.
 
@@ -45,6 +48,13 @@ def _backfit_scan(y, m_cols, phi_cols, dm_cols, k, ridge):
         compatible sentinel when absent (a zero column is ridge-harmless).
     dm_cols: (T*A, n) discounted-price martingale increments.
     Returns the residual after subtracting every fitted control.
+
+    Traces under full-f32 matmul precision (``highest_matmul_precision``):
+    TPU's default bf16 rounding of the Gram/projection products is
+    deterministic (non-mean-zero) and leaks a systematic bp-scale shift into
+    ``mean(resid)`` — the exact quantity this estimator exists to pin to
+    sub-bp accuracy (measured −2.4 ± 0.2bp over 8 Owen scrambles on v5e,
+    SCALING.md §6b). The products are (n, J<=6)-sized: full-f32 is free.
     """
     use_phi = phi_cols.shape[0] == m_cols.shape[0]
 

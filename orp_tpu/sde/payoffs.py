@@ -29,8 +29,14 @@ def european(s_T: jax.Array, strike: float, option_type: str) -> jax.Array:
 
 
 def basket_call(s_T: jax.Array, weights: jax.Array, strike: float) -> jax.Array:
-    """Arithmetic basket call on terminal prices ``s_T (n, A)``."""
-    return jnp.maximum(s_T @ jnp.asarray(weights, s_T.dtype) - strike, 0.0)
+    """Arithmetic basket call on terminal prices ``s_T (n, A)``.
+
+    Full-f32 weighting: TPU's default bf16 matmul rounding of the fixed
+    weight vector would deterministically misprice every path (SCALING.md
+    §6b defect class); the product is (n, A)-sized, full f32 is free.
+    """
+    w = jnp.asarray(weights, s_T.dtype)
+    return jnp.maximum(jnp.matmul(s_T, w, precision="highest") - strike, 0.0)
 
 
 def pension_floor(y_T: jax.Array, guarantee: float) -> jax.Array:

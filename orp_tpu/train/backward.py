@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.utils.precision import highest_matmul_precision
 from orp_tpu.train import losses as L
 from orp_tpu.train.fit import FitConfig, fit, fit_core
 from orp_tpu.train.fit import validate_shuffle as _validate_shuffle
@@ -101,6 +102,7 @@ def _stack_prices(y, b):
     return jnp.stack([y, jnp.broadcast_to(b[None, :], y.shape)], axis=-1)
 
 
+@highest_matmul_precision
 def _date_outputs_core(
     model, params1, params2, feats_t, prices_t, prices_t1, target,
     cost_of_capital, g_pre, *, dual_mode, holdings_combine,
@@ -117,6 +119,12 @@ def _date_outputs_core(
     holdings ledger reads the post-quantile weights — exactly the reference's
     call order (predict at :212, fit quantile at :217, get_phi_psi_VaR at
     :224 seeing identical phi1/phi2 so the combine collapses to phi1).
+
+    Traces under full-f32 matmul precision (``highest_matmul_precision``):
+    these forwards ARE the walk's ledgers (values, holdings, next-date fit
+    targets) — TPU's default bf16 rounding would put ~4e-3 relative noise
+    on every per-path value, feeding the VaR ledgers and the CV phi column.
+    The matmuls are 8-wide: full f32 is free.
     """
     if dual_mode == "shared":
         h_t = model.value(params2, feats_t, prices_t)
@@ -179,8 +187,10 @@ def _date_body(
     else:
         if cfg.dual_mode == "shared":
             # snapshot the MSE-fit prediction before the quantile fit mutates
-            # the shared weights (reference order, RP.py:212-217)
-            g_pre = value_fn(model, params1, feats_t, prices_t)
+            # the shared weights (reference order, RP.py:212-217); same
+            # full-f32 precision as the _date_outputs forwards it combines with
+            with jax.default_matmul_precision("highest"):
+                g_pre = value_fn(model, params1, feats_t, prices_t)
             params2 = params1
         params2, _ = q_fit_fn(
             params2, feats_t, prices_t1, target, kb,

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from orp_tpu.utils.precision import highest_matmul_precision
+
 Params = Any
 LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 # model_value(params, features, prices) -> (n,) predictions
@@ -86,6 +88,7 @@ def _make_optimizer(cfg: FitConfig):
     return optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
 
 
+@highest_matmul_precision
 def fit_core(
     params: Params,
     features: jax.Array,
@@ -107,6 +110,12 @@ def fit_core(
     ``best_loss``, ``n_epochs_ran``, and final-data metrics (evaluated with
     best params — the reference's ``restore_best_weights=True`` then
     ``evaluate`` pattern, RP.py:174, :215).
+
+    Traces under full-f32 matmul precision (``highest_matmul_precision``):
+    TPU's default bf16 rounding degrades the tiny (8-wide) forward/backward
+    matmuls — and the 122-param net is far too small for bf16 MXU tiles to
+    buy any speed back (the fused 1M-path Adam walk warm wall is ~1.2s
+    either way, TPU_MEASURE_r4.jsonl).
     """
     n = targets.shape[0]
     bs = min(cfg.batch_size, n)

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
+from orp_tpu.utils.precision import highest_matmul_precision
+
 
 @dataclasses.dataclass(frozen=True)
 class GNConfig:
@@ -86,6 +88,7 @@ class GNPinballConfig(GNConfig):
     init_lambda: float = 1e-2
 
 
+@highest_matmul_precision
 def _gn_core(
     params,
     features: jax.Array,
@@ -105,6 +108,15 @@ def _gn_core(
     every iteration from the current residuals ``r = pred - y``; ``None``
     means unweighted (plain GN for the MSE). Accept/reject and the freeze
     test always use the TRUE ``loss_fn``.
+
+    Traces under full-f32 matmul precision (``highest_matmul_precision``):
+    normal equations SQUARE the condition number, so TPU's default bf16
+    rounding wrecks the solve — measured on v5e at the 1M north-star, the
+    bf16-Gram walk fit v0_network 9.73 vs Black-Scholes 10.39 with cv_std
+    5.6 where the f32 CPU walk hits 10.39 / 2.4 (TPU_MEASURE_r4.jsonl,
+    SCALING.md §6b). The Gram is ~2e10 FLOPs/iteration at 1M paths —
+    full-f32 passes cost ~2s on a ~8s warm wall, nothing next to a broken
+    fit.
     """
     theta0, unravel = ravel_pytree(params)
     dim = theta0.shape[0]

@@ -1,0 +1,36 @@
+"""Matmul-precision control for TPU numerical fidelity.
+
+TPU's default matmul precision rounds `dot_general` inputs to bf16 (~4e-3
+relative error). For this framework that default is never the right trade:
+the big matmuls are normal-equation Grams (condition number SQUARED — a bf16
+Gram wrecked the Gauss-Newton fit outright: v0_network 9.73 vs Black-Scholes
+10.39 on v5e, TPU_MEASURE_r4.jsonl / SCALING.md §6b), the CV-OLS products
+(whose deterministic rounding leaks a systematic bp-scale shift into the
+price — measured −2.4 ± 0.2bp over 8 Owen scrambles), and everything else is
+8-to-97-wide — far too small for bf16 MXU tiles to buy speed back.
+
+``highest_matmul_precision`` wraps a function so its body TRACES under
+``jax.default_matmul_precision("highest")`` — the config is a trace-time
+property baked into the jaxpr (and part of the jit cache key), so decorating
+the traced function is exactly equivalent to per-op ``precision=`` arguments.
+CPU ignores the setting (always full f32), so the CPU test oracles are
+bit-unchanged; TF32-capable GPUs get the same fix as TPU (``highest`` forces
+full f32 where the default would lower f32 matmuls to TF32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def highest_matmul_precision(fn):
+    """Decorator: trace ``fn`` under full-f32 matmul precision on TPU."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+
+    return wrapped

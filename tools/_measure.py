@@ -1,0 +1,64 @@
+"""Shared plumbing for the on-chip measurement tools (`tpu_measure_all.py`,
+`precision_check.py`): JSONL stage recording with flush-per-stage (partial
+results must survive a mid-run tunnel death) and exception-to-record capture.
+"""
+
+import io
+import json
+import time
+from contextlib import redirect_stdout
+
+
+class Recorder:
+    """Append one JSON line per stage to ``out_path``; flush immediately."""
+
+    def __init__(self, out_path):
+        self.out = open(out_path, "a")
+
+    def emit(self, name, payload):
+        payload = {"stage": name, **payload}
+        self.out.write(json.dumps(payload) + "\n")
+        self.out.flush()
+        print(json.dumps(payload), flush=True)
+
+    def stage(self, name, fn):
+        """Run ``fn`` and record its payload — or its exception (partial data
+        beats none when the tunnel dies mid-battery)."""
+        t0 = time.perf_counter()
+        try:
+            payload = fn() or {}
+            payload["stage_wall_s"] = round(time.perf_counter() - t0, 1)
+            self.emit(name, payload)
+        except Exception as e:
+            self.emit(name, {"error": f"{type(e).__name__}: {e}"[:300],
+                             "stage_wall_s": round(time.perf_counter() - t0, 1)})
+
+    def close(self):
+        self.out.close()
+
+
+def env_payload():
+    import jax
+
+    return {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+
+
+def last_json_line(fn, argv):
+    """Call a CLI-style ``main(argv)`` and parse its last stdout line as JSON
+    (the convention every tools/ CLI here follows)."""
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        fn(argv)
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def rqmc_stage(paths_log2="20", scrambles="8"):
+    from tools.rqmc_ci import main as ci
+
+    return last_json_line(
+        ci, ["--paths-log2", paths_log2, "--scrambles", scrambles]
+    )

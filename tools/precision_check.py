@@ -1,0 +1,48 @@
+"""Measure the matmul-precision fix on the chip: north-star walk, GN default
+vs Adam, after forcing full-f32 matmul precision inside the fit/solve/controls
+zones (``orp_tpu.utils.precision``; SCALING.md §6b).
+
+Context (TPU_MEASURE_r4.jsonl, pre-fix): TPU default precision rounds matmul
+inputs to bf16; the bf16 Gram wrecked the GN fit (v0_network 9.73 vs BS
+10.39, cv_std 5.61 vs 2.44 on f32 CPU) and the CV OLS carried a systematic
+-2.4bp +/- 0.2bp acv bias where CPU measures -0.07bp. This tool records the
+post-fix numbers next to those, stage names ``*_f32fix``.
+
+Usage: python tools/precision_check.py [out=TPU_MEASURE_r4.jsonl]
+"""
+
+import pathlib
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+from tools._measure import Recorder, env_payload, rqmc_stage  # noqa: E402
+
+
+def main(out_path):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    rec = Recorder(out_path)
+    rec.emit("precision_fix_env", env_payload())
+
+    from benchmarks.north_star import main as ns
+
+    # GN shipped default (150/75 + block 16k), cold + warm — directly
+    # comparable to the pre-fix "north_star" stage in the same file
+    rec.stage("north_star_f32fix", lambda: {
+        "cold": ns(quiet=True), "warm": ns(quiet=True)})
+    # Adam walk at the same 1M scale: the profile stage measured its fused
+    # walk at ~1.2s warm, so quality is the open question for the default
+    rec.stage("adam_f32fix", lambda: {
+        "cold": ns(optimizer="adam", quiet=True),
+        "warm": ns(optimizer="adam", quiet=True)})
+    # RQMC error bar with the fixed controls OLS: settles whether the
+    # -2.4bp +/- 0.2bp systematic shift was the bf16 CV regression
+    rec.stage("rqmc_ci_f32fix", rqmc_stage)
+    rec.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else str(HERE / "TPU_MEASURE_r4.jsonl"))

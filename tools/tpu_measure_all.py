@@ -20,39 +20,21 @@ before the next starts, and a stage exception is recorded as its own line.
 import json
 import pathlib
 import sys
-import time
 
 HERE = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(HERE))
+
+from tools._measure import Recorder, env_payload, last_json_line, rqmc_stage  # noqa: E402
 
 
 def main(out_path):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
-    out = open(out_path, "a")
+    rec = Recorder(out_path)
+    emit, stage = rec.emit, rec.stage
 
-    def emit(name, payload):
-        payload = {"stage": name, **payload}
-        out.write(json.dumps(payload) + "\n")
-        out.flush()
-        print(json.dumps(payload), flush=True)
-
-    emit("env", {
-        "platform": jax.devices()[0].platform,
-        "device": str(jax.devices()[0]),
-        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
-    })
-
-    def stage(name, fn):
-        t0 = time.perf_counter()
-        try:
-            payload = fn() or {}
-            payload["stage_wall_s"] = round(time.perf_counter() - t0, 1)
-            emit(name, payload)
-        except Exception as e:  # record and continue — partial data > none
-            emit(name, {"error": f"{type(e).__name__}: {e}"[:300],
-                        "stage_wall_s": round(time.perf_counter() - t0, 1)})
+    emit("env", env_payload())
 
     def north():
         from benchmarks.north_star import main as ns
@@ -109,26 +91,12 @@ def main(out_path):
         return {"oneshot": {"cold": cold, "warm": warm}}
 
     def rqmc():
-        import io
-        from contextlib import redirect_stdout
-
-        from tools.rqmc_ci import main as ci
-
-        buf = io.StringIO()
-        with redirect_stdout(buf):
-            ci(["--paths-log2", "20", "--scrambles", "8"])
-        return json.loads(buf.getvalue().strip().splitlines()[-1])
+        return rqmc_stage()
 
     def profile():
-        import io
-        from contextlib import redirect_stdout
-
         from tools.profile_north_star import main as prof
 
-        buf = io.StringIO()
-        with redirect_stdout(buf):
-            prof(20)
-        return json.loads(buf.getvalue().strip().splitlines()[-1])
+        return last_json_line(lambda argv: prof(20), [])
 
     def paths_sweep():
         from tools.scaling_bench import _walk
@@ -173,7 +141,7 @@ def main(out_path):
     stage("paths_sweep", paths_sweep)
     stage("binomial", binom)
     stage("baselines", baselines)
-    out.close()
+    rec.close()
 
 
 if __name__ == "__main__":
