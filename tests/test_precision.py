@@ -1,0 +1,124 @@
+"""Matmul-precision guards (SCALING.md §6b).
+
+TPU's default matmul precision rounds inputs to bf16, which wrecked the GN
+normal equations and biased the CV OLS by −2.4bp on v5e (TPU_MEASURE_r4.jsonl).
+The fix forces full-f32 precision at trace time in every precision-critical
+zone. TPU numerics can't execute in this CPU-forced suite — but the POLICY is
+a trace-time property baked into the jaxpr, so these tests pin it exactly
+where it matters: every `dot_general` the traced zone emits (including inside
+`lax.scan`/`lax.cond` bodies) must carry ``Precision.HIGHEST``.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.train import losses as L
+from orp_tpu.train.fit import FitConfig, fit_core
+from orp_tpu.train.gn import GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball
+
+HI = (lax.Precision.HIGHEST, lax.Precision.HIGHEST)
+
+
+def _dot_precisions(jaxpr, out):
+    """Collect the ``precision`` param of every dot_general, recursing into
+    sub-jaxprs (scan/cond/while bodies, custom-vjp calls)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("dot_general", "dot"):
+            out.append(eqn.params.get("precision"))
+        for v in eqn.params.values():
+            if isinstance(v, jax.extend.core.ClosedJaxpr):
+                _dot_precisions(v.jaxpr, out)
+            elif isinstance(v, jax.extend.core.Jaxpr):
+                _dot_precisions(v, out)
+            elif isinstance(v, (tuple, list)):
+                for x in v:
+                    if isinstance(x, jax.extend.core.ClosedJaxpr):
+                        _dot_precisions(x.jaxpr, out)
+    return out
+
+
+def _assert_all_highest(fn, *args, **kwargs):
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    precisions = _dot_precisions(jaxpr.jaxpr, [])
+    assert precisions, "zone traced no dot_general at all — test is vacuous"
+    bad = [p for p in precisions if p != HI]
+    assert not bad, f"{len(bad)}/{len(precisions)} dots below HIGHEST: {bad[:4]}"
+
+
+def _toy():
+    model = HedgeMLP(n_features=1, constrain_self_financing=False)
+    params = model.init(jax.random.key(0), bias_init=(0.5, 0.5))
+    n = 64
+    f = jnp.linspace(0.8, 1.2, n)[:, None]
+    p = jnp.stack([f[:, 0], jnp.full((n,), 1.01)], axis=-1)
+    y = jnp.maximum(f[:, 0] - 1.0, 0.0)
+    return model, params, f, p, y
+
+
+def test_fit_core_traces_highest_precision():
+    model, params, f, p, y = _toy()
+    _assert_all_highest(
+        fit_core, params, f, p, y, jax.random.key(1),
+        value_fn=model.value, loss_fn=L.mse,
+        cfg=FitConfig(n_epochs=2, batch_size=32, shuffle="blocks"),
+    )
+
+
+@pytest.mark.parametrize("blocked", [False, True])
+def test_fit_gn_traces_highest_precision(blocked):
+    model, params, f, p, y = _toy()
+    _assert_all_highest(
+        fit_gn, params, f, p, y, jax.random.key(1),
+        value_fn=model.value, loss_fn=L.mse,
+        cfg=GNConfig(n_iters=2, block_rows=32 if blocked else None),
+    )
+
+
+def test_fit_gn_pinball_traces_highest_precision():
+    model, params, f, p, y = _toy()
+    _assert_all_highest(
+        fit_gn_pinball, params, f, p, y, jax.random.key(1),
+        value_fn=model.value, loss_fn=L.make_loss("smoothed_pinball", 0.99),
+        cfg=GNPinballConfig(n_iters=2),
+    )
+
+
+def test_solve_readout_traces_highest_precision():
+    model, params, f, p, y = _toy()
+    _assert_all_highest(model.solve_readout, params, f, p, y)
+
+
+def test_backfit_scan_traces_highest_precision():
+    from orp_tpu.risk.controls import _backfit_scan
+
+    n, t = 64, 4
+    y = jnp.linspace(-1, 1, n)
+    m = jnp.ones((t, n))
+    d = jnp.linspace(-0.1, 0.1, n)[None, :] * jnp.ones((t, 1))
+    _assert_all_highest(
+        _backfit_scan, y, m, jnp.zeros((1, n)), d,
+        jnp.asarray(1.0), jnp.asarray(1e-5),
+    )
+
+
+def test_date_outputs_traces_highest_precision():
+    from orp_tpu.train.backward import _date_outputs_core
+
+    model, params, f, p, y = _toy()
+    _assert_all_highest(
+        lambda *a: _date_outputs_core(
+            model, *a, dual_mode="separate", holdings_combine="single"
+        ),
+        params, params, f, p, p, y, jnp.asarray(1.0), jnp.zeros(()),
+    )
+
+
+def test_basket_sites_trace_highest_precision():
+    from orp_tpu.sde.payoffs import basket_call
+
+    s = jnp.ones((32, 3))
+    w = jnp.asarray([0.5, 0.3, 0.2])
+    _assert_all_highest(basket_call, s, w, 1.0)

@@ -27,7 +27,7 @@ sys.path.insert(0, str(HERE))
 from tools._measure import Recorder, env_payload, last_json_line, rqmc_stage  # noqa: E402
 
 
-def main(out_path):
+def main(out_path, only=None):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
@@ -133,16 +133,43 @@ def main(out_path):
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
     # shapes are probed separately via tools/pallas_bisect.py)
-    stage("north_star", north)
-    stage("gn_dual_walk", gn_dual)
-    stage("gn_oneshot", gn_oneshot)
-    stage("rqmc_ci", rqmc)
-    stage("profile", profile)
-    stage("paths_sweep", paths_sweep)
-    stage("binomial", binom)
-    stage("baselines", baselines)
+    all_stages = [
+        ("north_star", north),
+        ("gn_dual_walk", gn_dual),
+        ("gn_oneshot", gn_oneshot),
+        ("rqmc_ci", rqmc),
+        ("profile", profile),
+        ("paths_sweep", paths_sweep),
+        ("binomial", binom),
+        ("baselines", baselines),
+    ]
+    assert [n for n, _ in all_stages] == list(STAGE_NAMES)
+    for name, fn in all_stages:
+        if only is None or name in only:
+            stage(name, fn)
     rec.close()
 
 
+STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
+               "profile", "paths_sweep", "binomial", "baselines")
+
+
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else str(HERE / "TPU_MEASURE.jsonl"))
+    # argv: [out_path] [--stages a,b,c] — the stage filter lets a revived
+    # tunnel resume exactly the stages a wedge killed (SCALING.md §6).
+    # Validate BEFORE main(): its first jax touch can hang on a wedged
+    # tunnel, and a typo'd stage list must fail fast instead
+    argv = sys.argv[1:]
+    only = None
+    if "--stages" in argv:
+        i = argv.index("--stages")
+        if i + 1 >= len(argv):
+            raise SystemExit("--stages needs a comma-separated value; "
+                             f"known: {list(STAGE_NAMES)}")
+        only = argv[i + 1].split(",")
+        argv = argv[:i] + argv[i + 2:]
+        unknown = set(only) - set(STAGE_NAMES)
+        if unknown:
+            raise SystemExit(f"unknown stages {sorted(unknown)}; "
+                             f"known: {list(STAGE_NAMES)}")
+    main(argv[0] if argv else str(HERE / "TPU_MEASURE.jsonl"), only=only)

@@ -1,9 +1,18 @@
 #!/bin/bash
-# Probe the axon tunnel every 10 min; when it revives, run the given tool
-# (default: tools/precision_check.py) once and exit. Survives wedges: the
-# probe itself is a timeout subprocess (_tunnel_probe).
+# Probe the axon tunnel every 10 min; when it revives, run the full revival
+# battery once and exit. Sequence is value-ordered and wedge-aware:
+#   1. precision_check.py      - post-f32fix north-star/Adam/RQMC (SCALING §6b)
+#   2. tpu_measure_all.py      - the tail stages wedge event #2 killed
+#   3. pallas_bisect.py        - LAST: Pallas shape probes can fault the chip
+#      and wedge the tunnel (SCALING §5), so nothing may run after them.
+# Each step is a separate interpreter (the tunnel grants the chip per
+# process) under a hard `timeout` — a mid-step wedge (SCALING §6: 0% CPU,
+# blocked in a device call) must kill that step and let the next one record
+# what it can, not hang the watcher. Exit status: 0 only if every step
+# succeeded. The probe itself is a timeout subprocess (_tunnel_probe), so
+# the polling loop survives a wedged tunnel.
 cd "$(dirname "$0")/.."
-TOOL="${1:-tools/precision_check.py}"
+OUT="${1:-TPU_MEASURE_r4.jsonl}"
 while true; do
   ALIVE=$(python - <<'PY'
 from _tunnel_probe import probe_device_info
@@ -13,8 +22,14 @@ PY
   )
   echo "$(date +%H:%M:%S) tunnel alive: $ALIVE"
   if [ "$ALIVE" = "yes" ]; then
-    python "$TOOL"
-    exit $?
+    RC=0
+    timeout 3600 python tools/precision_check.py "$OUT" || RC=$?
+    timeout 5400 python tools/tpu_measure_all.py "$OUT" \
+      --stages paths_sweep,binomial,baselines || RC=$?
+    timeout 3600 python tools/pallas_bisect.py \
+      | tee -a PALLAS_BISECT_r4.jsonl || RC=$?
+    echo "$(date +%H:%M:%S) revival battery done rc=$RC"
+    exit $RC
   fi
   sleep 600
 done
