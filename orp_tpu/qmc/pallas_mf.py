@@ -184,9 +184,11 @@ def heston_log_pallas(
     logs, v = _run_mf(
         n_paths, n_steps, store_every=store_every, block_paths=block_paths,
         seed=seed, n_factors=2, used_factors=(0, 1), step_fn=step,
-        init_vals=(math.log(s0), v0), out_slots=(0, 1), interpret=interpret,
+        # log-return accumulator (state0 = 0, S = s0*exp): same §6d policy as
+        # the scan engine — keeps the s0-proportionality pin engine-universal
+        init_vals=(0.0, v0), out_slots=(0, 1), interpret=interpret,
     )
-    return {"S": jnp.exp(logs), "v": v}
+    return {"S": jnp.float32(s0) * jnp.exp(logs), "v": v}
 
 
 @functools.partial(
@@ -281,10 +283,11 @@ def pension_pallas(
         logy, v, lam, pop = _run_mf(
             n_paths, n_steps, store_every=store_every, block_paths=block_paths,
             seed=seed, n_factors=4, used_factors=(0, 1, 2, 3), step_fn=step,
-            init_vals=(math.log(y0), v0, l0, n0), out_slots=(0, 1, 2, 3),
+            init_vals=(0.0, v0, l0, n0), out_slots=(0, 1, 2, 3),
             interpret=interpret, uniform_factors=(3,) if inv else (),
         )
-        return {"Y": jnp.exp(logy), "v": v, "lam": lam, "N": pop}
+        return {"Y": jnp.float32(y0) * jnp.exp(logy), "v": v, "lam": lam,
+                "N": pop}
 
     def step(state, z, t):
         y, lam, pop = state

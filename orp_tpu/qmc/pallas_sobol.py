@@ -25,7 +25,6 @@ same 23-bit f32 bucket mapping); the inverse normal is AS241 evaluated in f32,
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
@@ -227,7 +226,9 @@ def gbm_log_pallas(
         seed=seed,
         c0=float((drift - 0.5 * sigma * sigma) * dt),
         vol_sdt=float(sigma * dt**0.5),
-        log_s0=math.log(s0),
+        # log-RETURN accumulator, matching the scan engine (SCALING.md §6d):
+        # no log of the initial condition anywhere, s0 scales the output
+        log_s0=0.0,
     )
     out = pl.pallas_call(
         kernel,
@@ -240,4 +241,4 @@ def gbm_log_pallas(
         interpret=interpret,
     )(dirs)
     # (knots, path_rows, 128) -> (paths, knots)
-    return jnp.exp(out).reshape(n_knots, n_paths).T
+    return jnp.float32(s0) * jnp.exp(out).reshape(n_knots, n_paths).T

@@ -164,6 +164,12 @@ def simulate_gbm_log(
     Semantics of the European-option simulator (``European Options.ipynb#6``, risk-
     neutral ``drift=r``). Log-space accumulation keeps f32 drift error tiny over 3650+
     steps (SURVEY.md §7 numerics policy).
+
+    The accumulator is the log-RETURN (state0 = 0), not log-price: seeding it
+    with a device-side ``log(s0)`` costs −74 ulps on TPU (its f32 ``log`` at
+    x=100 is 3.5e-5 low — measured, SCALING.md §6d), which multiplies EVERY
+    path by the same wrong factor and moved the 1M-path call price a
+    systematic −2.5bp. ``s0 * exp(acc)`` takes no device log at all.
     """
     sdt = jnp.asarray(grid.dt, dtype) ** 0.5
     c0 = (drift - 0.5 * sigma * sigma) * grid.dt
@@ -171,12 +177,12 @@ def simulate_gbm_log(
     def step(logs, z, t, dt):
         return logs + c0 + sigma * sdt * z[:, factor]
 
-    state0 = jnp.full(indices.shape, jnp.log(jnp.asarray(s0, dtype)), dtype)
+    state0 = jnp.zeros(indices.shape, dtype)
     _, traj = scan_sde(
         step, state0, lambda x: x, indices, grid, n_factors, seed,
         scramble=scramble, store_every=store_every, dtype=dtype,
     )
-    return jnp.exp(traj)
+    return jnp.asarray(s0, dtype) * jnp.exp(traj)
 
 
 # ---------------------------------------------------------------------------
@@ -364,13 +370,17 @@ def simulate_pension(
         return (logy, v_new, lam, pop) if sv else (y, lam, pop)
 
     if sv:
+        # log-return accumulator (state0 = 0, Y = y0*exp(acc)): never take a
+        # device log of the initial condition — TPU's f32 log is tens of
+        # ulps off at typical price scales (SCALING.md §6d)
         state0 = (
-            jnp.full((n,), jnp.log(jnp.asarray(y0, dtype)), dtype),
+            jnp.zeros((n,), dtype),
             jnp.full((n,), jnp.asarray(v0, dtype), dtype),
             jnp.full((n,), jnp.asarray(l0, dtype), dtype),
             jnp.full((n,), jnp.asarray(n0, dtype), dtype),
         )
-        out_fn = lambda s: {"Y": jnp.exp(s[0]), "v": s[1], "lam": s[2], "N": s[3]}
+        out_fn = lambda s: {"Y": jnp.asarray(y0, dtype) * jnp.exp(s[0]),
+                            "v": s[1], "lam": s[2], "N": s[3]}
     else:
         state0 = (
             jnp.full((n,), jnp.asarray(y0, dtype), dtype),
@@ -428,12 +438,15 @@ def simulate_heston_log(
         return (logs, v)
 
     n = indices.shape[0]
+    # log-return accumulator: no device log(s0) — see simulate_gbm_log's
+    # numerics note (SCALING.md §6d)
     state0 = (
-        jnp.full((n,), jnp.log(jnp.asarray(s0, dtype)), dtype),
+        jnp.zeros((n,), dtype),
         jnp.full((n,), jnp.asarray(v0, dtype), dtype),
     )
     _, traj = scan_sde(
-        step, state0, lambda s: {"S": jnp.exp(s[0]), "v": s[1]},
+        step, state0,
+        lambda s: {"S": jnp.asarray(s0, dtype) * jnp.exp(s[0]), "v": s[1]},
         indices, grid, 2, seed, scramble=scramble, store_every=store_every, dtype=dtype,
     )
     return traj
@@ -481,9 +494,11 @@ def simulate_gbm_basket(
         return logs + c0[None, :] + sigma[None, :] * sdt * zc
 
     n = indices.shape[0]
-    state0 = jnp.broadcast_to(jnp.log(s0)[None, :], (n, A)).astype(dtype)
+    # log-return accumulator per asset: no device log(s0) — see
+    # simulate_gbm_log's numerics note (SCALING.md §6d)
+    state0 = jnp.zeros((n, A), dtype)
     _, traj = scan_sde(
         step, state0, lambda x: x, indices, grid, A, seed,
         scramble=scramble, store_every=store_every, dtype=dtype,
     )
-    return jnp.exp(traj)
+    return s0 * jnp.exp(traj)

@@ -1,0 +1,92 @@
+"""Localise the TPU-only acv shift (SCALING.md §6d): component-level A/B.
+
+For identical Sobol scrambles (same seeds, same indices — the uint32 point
+set is bit-identical on every platform), compute at 1M paths:
+
+  - ``v0_plain``      = mean(disc * payoff)      — pure QMC integration
+  - ``v0_acv``        = OLS-martingale estimator — v0_plain + backfit shift
+  - per-knot martingale-increment means E[dM_t]  — each is 0 in expectation;
+    a systematic nonzero mean is exactly what the OLS control subtracts,
+    and what a biased platform would corrupt
+
+on the CURRENT platform (run once under the TPU tunnel, once under
+``JAX_PLATFORMS=cpu``), then prints one JSON line per seed. Diffing the two
+platforms' lines answers: does the −2.4bp enter the *simulation/payoff mean*
+(platform transcendental/reduction difference) or the *backfit* (controls
+linear algebra), and is it precision (f32-vs-f64) or platform (TPU-vs-CPU
+at equal f32)?
+
+Usage: python tools/acv_bias_ab.py [--paths-log2 20] [--seeds 1235,2235]
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--paths-log2", type=int, default=20)
+    ap.add_argument("--seeds", type=str, default="1235,2235,3235")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+
+    from orp_tpu.risk.controls import martingale_ols_price
+    from orp_tpu.sde import TimeGrid, payoffs, simulate_gbm_log
+    from orp_tpu.utils import bs_call
+
+    S0 = K = 100.0
+    r, sigma, T = 0.08, 0.15, 1.0
+    bs, _ = bs_call(S0, K, r, sigma, T)
+    grid = TimeGrid(T, 364)
+    times = np.asarray(grid.reduced(7).times())
+    idx = jnp.arange(1 << args.paths_log2, dtype=jnp.uint32)
+    platform = jax.devices()[0].platform
+
+    for seed in (int(s) for s in args.seeds.split(",")):
+        s = simulate_gbm_log(idx, grid, S0, r, sigma, seed=seed, store_every=7)
+        payoff = payoffs.call(s[:, -1], K)
+        disc = jnp.exp(-r * jnp.asarray(times, s.dtype))
+        y = disc[-1] * payoff
+        # f64 mean of the f32 per-path values: isolates REDUCTION error in
+        # the platform's f32 mean from upstream per-path value differences
+        y64 = np.asarray(y, dtype=np.float64)
+        v0_plain_f64acc = float(y64.mean())
+        v0_plain = float(jnp.mean(y))
+        v0_acv, acv_std = martingale_ols_price(
+            s, payoff, r, times, strike_over_s0=K / S0)
+        m_disc = disc[:, None].T * s  # (n, T+1): disc_t * S_t
+        dm = np.asarray(m_disc[:, 1:] - m_disc[:, :-1], dtype=np.float64)
+        dm_means_bp = (dm.mean(axis=0) / S0 * 1e4).round(4)
+        # terminal-knot per-path stats: E[S_T] oracle = S0*exp(rT)
+        st64 = np.asarray(s[:, -1], dtype=np.float64)
+        print(json.dumps({
+            "platform": platform,
+            "x64": bool(jax.config.jax_enable_x64),
+            "seed": seed,
+            "paths": 1 << args.paths_log2,
+            "bs": round(bs, 6),
+            "v0_plain_bp": round((v0_plain - bs) / bs * 1e4, 3),
+            "v0_plain_f64acc_bp": round((v0_plain_f64acc - bs) / bs * 1e4, 3),
+            "v0_acv_bp": round((float(v0_acv) - bs) / bs * 1e4, 3),
+            "acv_minus_plain_bp": round(
+                (float(v0_acv) - v0_plain) / bs * 1e4, 3),
+            "mean_ST_err_bp": round(
+                (st64.mean() - S0 * np.exp(r * T)) / (S0 * np.exp(r * T))
+                * 1e4, 3),
+            "dm_means_bp_first4": dm_means_bp[:4].tolist(),
+            "dm_means_bp_sum": round(float(dm_means_bp.sum()), 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Probe the platform's f32 exp for systematic relative bias (SCALING.md §6d).
+
+The log-Euler sim exponentiates once per stored knot at arguments near
+log(S0) ~ 4.6; a systematic relative error -eps in exp shifts E[S_T]
+multiplicatively by -eps and the call price by ~Delta*S0/C * eps. This tool
+measures mean/max relative error of exp_f32 vs f64 exp of the SAME f32
+argument, over dense grids in the ranges the sim actually uses:
+
+  - "knot" range: x in [3.9, 5.4]   (log S_t around log 100 +/- 5 sigma)
+  - "small" range: x in [-0.05, 0.05] (per-step growth factors)
+  - ulp histogram of the signed error, to separate rounding from bias
+
+Usage: python tools/exp_probe.py ;  JAX_PLATFORMS=cpu python tools/exp_probe.py
+"""
+
+import json
+import pathlib
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    expf = jax.jit(lambda x: jnp.exp(x))
+
+    out = {"platform": platform}
+    for name, lo, hi in (("knot", 3.9, 5.4), ("small", -0.05, 0.05)):
+        # every representable f32 in [lo, hi) would be ~1e7 values for the
+        # knot range; a 2^22 even grid snapped to f32 is representative
+        x = np.linspace(lo, hi, 1 << 22).astype(np.float32)
+        y32 = np.asarray(expf(jnp.asarray(x)), dtype=np.float64)
+        y64 = np.exp(x.astype(np.float64))  # exact exp of the SAME argument
+        rel = y32 / y64 - 1.0
+        ulp = rel / 1.19209290e-07  # relative error in f32 ulps at 1.0..2.0
+        out[name] = {
+            "mean_rel": float(rel.mean()),
+            "mean_ulp": round(float(ulp.mean()), 3),
+            "max_abs_ulp": round(float(np.abs(ulp).max()), 3),
+            "frac_negative": round(float((rel < 0).mean()), 4),
+            "p5_ulp": round(float(np.percentile(ulp, 5)), 3),
+            "p95_ulp": round(float(np.percentile(ulp, 95)), 3),
+        }
+    # implied price impact at the north-star config (Delta*S0/C ~ 7.05)
+    eps = out["knot"]["mean_rel"]
+    out["implied_E_ST_bias_bp"] = round(eps * 1e4, 4)
+    out["implied_call_price_bias_bp"] = round(eps * 1e4 * 7.05, 3)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Stage-by-stage TPU-vs-CPU diff of the sim pipeline (SCALING.md §6d).
+
+Dumps, for one scramble seed and a small path set, f32 arrays at each stage:
+  u        - Sobol uniforms (uint32 path is bit-exact by construction)
+  z        - ndtri(u)
+  zsum     - f32 left-fold of a*z per path (the scan's log-space increment)
+  st       - simulate_gbm_log S_T
+Writes <out>/<platform>_<name>.npy; run once per platform, then `--compare`
+prints bitwise/ulp stats per stage. The first stage that diverges is the
+platform-difference injection point.
+
+Usage:
+  python tools/platform_diff.py dump out/           # under the tunnel (tpu)
+  JAX_PLATFORMS=cpu python tools/platform_diff.py dump out/
+  python tools/platform_diff.py compare out/
+"""
+
+import json
+import pathlib
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+N_PATHS = 1 << 16
+N_STEPS = 364
+SEED = 1235
+
+
+def dump(out_dir):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orp_tpu.qmc.sobol import sobol_normal, sobol_uniform
+    from orp_tpu.sde import TimeGrid, simulate_gbm_log
+
+    platform = jax.devices()[0].platform
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    idx = jnp.arange(N_PATHS, dtype=jnp.uint32)
+    dims = jnp.arange(N_STEPS)
+
+    u = sobol_uniform(idx, dims, SEED)
+    z = sobol_normal(idx, dims, SEED)
+    a = jnp.float32(0.15) * jnp.asarray(1.0 / N_STEPS, jnp.float32) ** 0.5
+
+    @jax.jit
+    def fold(z):
+        # the scan's per-path log-space accumulation, isolated: left-fold
+        # of a*z in f32 (c0 omitted - it is a shared exact constant)
+        def body(c, zt):
+            return c + a * zt, None
+
+        c, _ = jax.lax.scan(body, jnp.zeros((z.shape[0],), jnp.float32), z.T)
+        return c
+
+    zsum = fold(z)
+    grid = TimeGrid(1.0, N_STEPS)
+    st = simulate_gbm_log(idx, grid, 100.0, 0.08, 0.15, seed=SEED,
+                          store_every=N_STEPS)[:, -1]
+    for name, arr in (("u", u), ("z", z), ("zsum", zsum), ("st", st)):
+        np.save(out / f"{platform}_{name}.npy", np.asarray(arr))
+    print(json.dumps({"dumped": platform, "n_paths": N_PATHS}))
+
+
+def compare(out_dir):
+    import numpy as np
+
+    out = pathlib.Path(out_dir)
+    for name in ("u", "z", "zsum", "st"):
+        a = np.load(out / f"tpu_{name}.npy")
+        b = np.load(out / f"cpu_{name}.npy")
+        bits_equal = bool((a.view(np.uint32) == b.view(np.uint32)).all())
+        af, bf = a.astype(np.float64), b.astype(np.float64)
+        denom = np.maximum(np.abs(bf), 1e-30)
+        rel = (af - bf) / denom
+        print(json.dumps({
+            "stage": name,
+            "bitwise_equal": bits_equal,
+            "frac_differing": round(float((a != b).mean()), 6),
+            "mean_rel_tpu_minus_cpu": float(rel.mean()),
+            "max_abs_rel": float(np.abs(rel).max()),
+        }))
+
+
+if __name__ == "__main__":
+    mode, out_dir = sys.argv[1], sys.argv[2]
+    dump(out_dir) if mode == "dump" else compare(out_dir)

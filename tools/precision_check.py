@@ -8,7 +8,12 @@ inputs to bf16; the bf16 Gram wrecked the GN fit (v0_network 9.73 vs BS
 -2.4bp +/- 0.2bp acv bias where CPU measures -0.07bp. This tool records the
 post-fix numbers next to those, stage names ``*_f32fix``.
 
-Usage: python tools/precision_check.py [out=TPU_MEASURE_r4.jsonl]
+Usage: python tools/precision_check.py [out=TPU_MEASURE_r4.jsonl] [--tag f32fix]
+
+``--tag`` names the fix under measurement (stage suffix). Tags so far:
+  f32fix — the §6b matmul-precision fix
+  logfix — the §6d device-log fix (kernels accumulate log-returns; no
+           device log of the initial condition)
 """
 
 import pathlib
@@ -20,29 +25,35 @@ sys.path.insert(0, str(HERE))
 from tools._measure import Recorder, env_payload, rqmc_stage  # noqa: E402
 
 
-def main(out_path):
+def main(out_path, tag="f32fix"):
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
     rec = Recorder(out_path)
-    rec.emit("precision_fix_env", env_payload())
+    rec.emit(f"precision_{tag}_env", env_payload())
 
     from benchmarks.north_star import main as ns
 
     # GN shipped default (150/75 + block 16k), cold + warm — directly
     # comparable to the pre-fix "north_star" stage in the same file
-    rec.stage("north_star_f32fix", lambda: {
+    rec.stage(f"north_star_{tag}", lambda: {
         "cold": ns(quiet=True), "warm": ns(quiet=True)})
     # Adam walk at the same 1M scale: the profile stage measured its fused
     # walk at ~1.2s warm, so quality is the open question for the default
-    rec.stage("adam_f32fix", lambda: {
+    rec.stage(f"adam_{tag}", lambda: {
         "cold": ns(optimizer="adam", quiet=True),
         "warm": ns(optimizer="adam", quiet=True)})
-    # RQMC error bar with the fixed controls OLS: settles whether the
-    # -2.4bp +/- 0.2bp systematic shift was the bf16 CV regression
-    rec.stage("rqmc_ci_f32fix", rqmc_stage)
+    # RQMC error bar with the fixed estimator: the systematic-shift witness
+    rec.stage(f"rqmc_ci_{tag}", rqmc_stage)
     rec.close()
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else str(HERE / "TPU_MEASURE_r4.jsonl"))
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_path", nargs="?",
+                    default=str(HERE / "TPU_MEASURE_r4.jsonl"))
+    ap.add_argument("--tag", default="f32fix")
+    args = ap.parse_args()
+    main(args.out_path, args.tag)
