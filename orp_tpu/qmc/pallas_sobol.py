@@ -128,17 +128,20 @@ def _sobol_z(idx, dirs_ref, dim, seed):
     return _ndtri_f32(_sobol_u(idx, dirs_ref, dim, seed))
 
 
-# above this many stored knots, fall back to the dynamic-index store: the
-# static unroll below duplicates the store site per knot, and a daily-store
-# 10y grid (3,651 knots) would blow up the kernel. The dynamic store is the
-# one implicated in the many-knot device fault (SCALING.md §5), but it is
-# only reached for shapes beyond this bound.
+# per-call cap on stored knots: every store site is statically unrolled (the
+# per-knot store index is a compile-time constant — dynamic-dslice stores to a
+# long non-tiled leading dim were the original §5 fault suspect), so program
+# size grows with knots-per-call; beyond this the wrapper CHAINS calls instead
 _STATIC_STORE_MAX_KNOTS = 256
 
 
 def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
                 seed, c0, vol_sdt, log_s0):
-    """One grid instance: evolve ``block_paths`` paths through all steps."""
+    """One grid instance: evolve ``block_paths`` paths through all steps.
+
+    Statically-unrolled knot stores; the step loop between knots stays a
+    ``fori_loop`` so program size grows only with the knot count (the wrapper
+    guarantees ``n_knots <= _STATIC_STORE_MAX_KNOTS`` here)."""
     rows = block_paths // _LANES
     idx = _block_indices(block_paths)
     n_knots = n_steps // store_every + 1
@@ -148,39 +151,49 @@ def _gbm_kernel(dirs_ref, out_ref, *, n_steps, store_every, block_paths,
     def step(t, logs):
         return logs + c0 + vol_sdt * _sobol_z(idx, dirs_ref, t - 1, seed)
 
-    if n_knots <= _STATIC_STORE_MAX_KNOTS:
-        # statically-unrolled knot stores: the per-knot store index is a
-        # compile-time constant, sidestepping the dynamic-dslice store to a
-        # long non-tiled leading dim that faults the tunneled v5e at ~53
-        # knots (SCALING.md §5); the step loop between knots stays a
-        # fori_loop so program size grows only with n_knots
-        logs = out_ref[0, :, :]
-        for k in range(1, n_knots):
-            logs = jax.lax.fori_loop(
-                (k - 1) * store_every + 1, k * store_every + 1, step, logs,
-                unroll=False,
-            )
-            out_ref[k, :, :] = logs
-        return
+    logs = out_ref[0, :, :]
+    for k in range(1, n_knots):
+        logs = jax.lax.fori_loop(
+            (k - 1) * store_every + 1, k * store_every + 1, step, logs,
+            unroll=False,
+        )
+        out_ref[k, :, :] = logs
 
-    def step_and_store(t, logs):
-        logs = step(t, logs)
 
-        @pl.when(t % store_every == 0)
-        def _():
-            out_ref[pl.dslice(t // store_every, 1), :, :] = logs[None]
+def _gbm_kernel_chunk(dirs_ref, init_ref, out_ref, *, step_start, knots,
+                      store_every, block_paths, seed, c0, vol_sdt):
+    """One grid instance of one CHUNK: continue ``block_paths`` paths from the
+    per-path log-state in ``init_ref`` through ``knots * store_every`` steps,
+    storing each knot statically. ``dirs_ref`` holds the FULL direction table,
+    so Sobol dimensions stay global (``t - 1``) and the stream is bit-identical
+    to the single-call kernel."""
+    idx = _block_indices(block_paths)
 
-        return logs
+    def step(t, logs):
+        return logs + c0 + vol_sdt * _sobol_z(idx, dirs_ref, t - 1, seed)
 
-    jax.lax.fori_loop(1, n_steps + 1, step_and_store, out_ref[0, :, :],
-                      unroll=False)
+    logs = init_ref[:, :]
+    for k in range(knots):
+        logs = jax.lax.fori_loop(
+            step_start + k * store_every + 1,
+            step_start + (k + 1) * store_every + 1, step, logs, unroll=False,
+        )
+        out_ref[k, :, :] = logs
+
+
+# per-call output cap for the auto chunk size: the tunneled v5e faults
+# reproducibly once a single pallas_call's output reaches ~204MB at 1M paths
+# (SCALING.md §5 bisect: 51-knot/204MB outputs fault, 27-knot/108MB runs
+# clean). 104MB stays at the bisect's measured-clean point (<=26 knots at 1M)
+# rather than inside the untested (108, 204)MB band
+_MAX_OUT_BYTES_PER_CALL = 104 << 20
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "n_paths", "n_steps", "store_every", "seed", "block_paths", "interpret",
-        "s0", "drift", "sigma", "dt",
+        "s0", "drift", "sigma", "dt", "knots_per_call",
     ),
 )
 def gbm_log_pallas(
@@ -195,6 +208,7 @@ def gbm_log_pallas(
     store_every: int = 1,
     block_paths: int = 2048,
     interpret: bool | None = None,
+    knots_per_call: int | None = None,
 ) -> jax.Array:
     """Fused Pallas log-GBM: returns ``(n_paths, n_steps//store_every + 1)``.
 
@@ -202,6 +216,18 @@ def gbm_log_pallas(
     same ``(indices, dims, seed)`` addressing — the Sobol stream matches the
     XLA path bit-for-bit; end values agree to f32 roundoff (see
     tests/test_pallas.py).
+
+    Dense storage grids are generated as a CHAIN of pallas_calls of
+    ``knots_per_call`` knots each (auto-sized to cap any single call's output
+    at ~104MB — the §5 bisect's measured-clean point), threaded through a
+    per-path log-state array: the tunneled v5e faults reproducibly when one
+    call's output reaches ~204MB at 1M paths (SCALING.md §5), and chunking
+    bounds the per-call footprint with ZERO recompute — the chain passes
+    exact f32 state, so results are bitwise identical to the single-call
+    kernel (pinned in tests/test_pallas.py). Known trade: ``step_start`` is
+    baked into each chunk's kernel, so a chain compiles one Mosaic kernel per
+    chunk on the cold call (~114 for a 1M-path daily 10y grid); the compiles
+    are one-time and persist in the jit/XLA caches.
     """
     if interpret is None:
         # Mosaic lowering needs a real TPU; anywhere else run the interpreter
@@ -216,29 +242,79 @@ def gbm_log_pallas(
         raise ValueError("store_every must divide n_steps")
     n_knots = n_steps // store_every + 1
     rows = block_paths // _LANES
+    rows_total = n_paths // _LANES
     dirs = direction_numbers(n_steps)  # (n_steps, 32) uint32
+    c0 = float((drift - 0.5 * sigma * sigma) * dt)
+    vol_sdt = float(sigma * dt**0.5)
 
-    kernel = functools.partial(
-        _gbm_kernel,
-        n_steps=n_steps,
-        store_every=store_every,
-        block_paths=block_paths,
-        seed=seed,
-        c0=float((drift - 0.5 * sigma * sigma) * dt),
-        vol_sdt=float(sigma * dt**0.5),
-        # log-RETURN accumulator, matching the scan engine (SCALING.md §6d):
-        # no log of the initial condition anywhere, s0 scales the output
-        log_s0=0.0,
+    if knots_per_call is None:
+        # 64-knot ceiling: every store site is statically unrolled, so kernel
+        # program size (and compile time) grows with knots-per-call; ~53-knot
+        # kernels are measured-fast to compile, 256-knot ones are not
+        knots_per_call = max(1, min(64, _STATIC_STORE_MAX_KNOTS,
+                                    _MAX_OUT_BYTES_PER_CALL // (n_paths * 4)))
+    if not 1 <= knots_per_call <= _STATIC_STORE_MAX_KNOTS:
+        # < 1 would spin the chunk loop forever (m = 0 never advances k0)
+        raise ValueError(
+            f"knots_per_call {knots_per_call} must be in "
+            f"[1, {_STATIC_STORE_MAX_KNOTS}]"
+        )
+
+    if n_knots <= _STATIC_STORE_MAX_KNOTS and n_knots - 1 <= knots_per_call:
+        kernel = functools.partial(
+            _gbm_kernel,
+            n_steps=n_steps,
+            store_every=store_every,
+            block_paths=block_paths,
+            seed=seed,
+            c0=c0,
+            vol_sdt=vol_sdt,
+            # log-RETURN accumulator, matching the scan engine (SCALING.md
+            # §6d): no log of the initial condition, s0 scales the output
+            log_s0=0.0,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_paths // block_paths,),
+            in_specs=[pl.BlockSpec((n_steps, 32), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((n_knots, rows, _LANES), lambda i: (0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_knots, rows_total, _LANES), jnp.float32
+            ),
+            interpret=interpret,
+        )(dirs)
+        # (knots, path_rows, 128) -> (paths, knots)
+        return jnp.float32(s0) * jnp.exp(out).reshape(n_knots, n_paths).T
+
+    # chunked chain: each call continues from the previous call's last knot
+    init = jnp.zeros((rows_total, _LANES), jnp.float32)
+    chunks = []
+    k0 = 0  # interior knots completed
+    while k0 < n_knots - 1:
+        m = min(knots_per_call, n_knots - 1 - k0)
+        kernel = functools.partial(
+            _gbm_kernel_chunk,
+            step_start=k0 * store_every,
+            knots=m,
+            store_every=store_every,
+            block_paths=block_paths,
+            seed=seed,
+            c0=c0,
+            vol_sdt=vol_sdt,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_paths // block_paths,),
+            in_specs=[pl.BlockSpec((n_steps, 32), lambda i: (0, 0)),
+                      pl.BlockSpec((rows, _LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((m, rows, _LANES), lambda i: (0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, rows_total, _LANES), jnp.float32),
+            interpret=interpret,
+        )(dirs, init)
+        chunks.append(out)
+        init = out[-1]
+        k0 += m
+    log_knots = jnp.concatenate(
+        [jnp.zeros((1, rows_total, _LANES), jnp.float32)] + chunks, axis=0
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_paths // block_paths,),
-        in_specs=[pl.BlockSpec((n_steps, 32), lambda i: (0, 0))],
-        out_specs=pl.BlockSpec((n_knots, rows, _LANES), lambda i: (0, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_knots, n_paths // _LANES, _LANES), jnp.float32
-        ),
-        interpret=interpret,
-    )(dirs)
-    # (knots, path_rows, 128) -> (paths, knots)
-    return jnp.float32(s0) * jnp.exp(out).reshape(n_knots, n_paths).T
+    return jnp.float32(s0) * jnp.exp(log_knots).reshape(n_knots, n_paths).T

@@ -202,11 +202,12 @@ def test_pallas_sv_pension_inversion_matches_xla_scan():
 
 
 @pytest.mark.slow
-def test_pallas_dynamic_store_branch_matches_scan(monkeypatch):
-    # the >_STATIC_STORE_MAX_KNOTS fallback (dynamic-dslice stores) gets zero
-    # coverage from the small-knot tests above once the static unroll exists:
-    # force the threshold down so the SAME shape exercises the dynamic branch,
-    # and pin it against both the scan path and the static-branch output
+def test_pallas_gbm_over_bound_goes_chained_bitwise(monkeypatch):
+    # shapes over _STATIC_STORE_MAX_KNOTS now go down the CHAINED multi-call
+    # path (the dynamic-dslice fallback was deleted after the §5 bisect
+    # hardware-refuted it as a workaround): force the threshold down so the
+    # SAME shape runs chained, and pin it bitwise against the single-call
+    # static output
     import orp_tpu.qmc.pallas_sobol as ps
 
     n_paths, n_steps, store = 512, 16, 2  # 9 knots
@@ -221,14 +222,15 @@ def test_pallas_dynamic_store_branch_matches_scan(monkeypatch):
     )
     monkeypatch.setattr(ps, "_STATIC_STORE_MAX_KNOTS", 4)
     gbm_log_pallas.clear_cache()
-    dyn_out = gbm_log_pallas(
+    chained_out = gbm_log_pallas(
         n_paths, n_steps, s0=100.0, drift=0.08, sigma=0.15, dt=grid.dt,
         seed=1235, store_every=store, block_paths=256, interpret=True,
     )
     gbm_log_pallas.clear_cache()  # don't leak the patched trace to other tests
-    np.testing.assert_allclose(np.asarray(dyn_out), np.asarray(static_out),
-                               rtol=0, atol=0)
-    np.testing.assert_allclose(np.asarray(dyn_out), np.asarray(ref), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(chained_out),
+                               np.asarray(static_out), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(chained_out), np.asarray(ref),
+                               rtol=2e-5)
 
 
 @pytest.mark.slow
@@ -249,3 +251,41 @@ def test_pallas_mf_dynamic_store_branch_matches_static(monkeypatch):
     for key in ("S", "v"):
         np.testing.assert_allclose(np.asarray(dyn_out[key]),
                                    np.asarray(static_out[key]), rtol=0, atol=0)
+
+
+def test_pallas_gbm_chunked_chain_bitwise_matches_single_call():
+    # dense storage runs as a CHAIN of pallas_calls threaded through exact
+    # f32 log-state (SCALING.md §5: bounds any single call's output below
+    # the v5e fault threshold) — results must be BITWISE identical to the
+    # single-call kernel, chunk boundaries included
+    from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
+
+    kw = dict(s0=100.0, drift=0.08, sigma=0.15, dt=1 / 52, seed=1235,
+              store_every=2, block_paths=256, interpret=True)
+    single = gbm_log_pallas(512, 52, knots_per_call=26, **kw)   # 26 knots, 1 call
+    chained = gbm_log_pallas(512, 52, knots_per_call=4, **kw)   # 7 calls (ragged tail)
+    assert single.shape == chained.shape == (512, 27)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(chained))
+
+
+def test_pallas_gbm_chained_beyond_static_bound(monkeypatch):
+    # n_knots > _STATIC_STORE_MAX_KNOTS must go down the chained path (the
+    # old dynamic-store fallback is gone) and still agree with the XLA scan
+    # engine. The bound is monkeypatched small so the scenario runs at
+    # interpret-mode-friendly sizes (real bound 256: tracing hundreds of
+    # statically-unrolled store sites is minutes of compile, not a unit test).
+    from orp_tpu.qmc import pallas_sobol as ps
+    from orp_tpu.sde import TimeGrid, simulate_gbm_log
+
+    monkeypatch.setattr(ps, "_STATIC_STORE_MAX_KNOTS", 8)
+    ps.gbm_log_pallas.clear_cache()
+    n_paths, n_steps = 256, 40
+    out = ps.gbm_log_pallas(n_paths, n_steps, s0=1.0, drift=0.05, sigma=0.2,
+                            dt=1 / 40, seed=7, store_every=2, block_paths=256,
+                            interpret=True, knots_per_call=4)  # 21 knots > 8
+    ps.gbm_log_pallas.clear_cache()
+    assert out.shape == (n_paths, 21)
+    idx = jnp.arange(n_paths, dtype=jnp.uint32)
+    ref = simulate_gbm_log(idx, TimeGrid(1.0, n_steps), 1.0, 0.05, 0.2,
+                           seed=7, store_every=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-5)

@@ -16,9 +16,13 @@ import sys, time
 sys.path.insert(0, {root!r})
 from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 t0 = time.time()
+# knots_per_call pinned to the FULL knot count: this tool bisects the
+# single-call device fault, and the wrapper's auto-chunking (which exists to
+# dodge exactly that fault in production) must not neutralize the probe
 out = gbm_log_pallas({n_paths}, {n_steps}, s0=100.0, drift=0.08, sigma=0.15,
                      dt=1.0/364, seed=1235, store_every={store_every},
-                     block_paths={block_paths})
+                     block_paths={block_paths},
+                     knots_per_call={n_steps} // {store_every})
 out.block_until_ready()
 print("OK", out.shape, round(time.time() - t0, 1))
 """
