@@ -14,6 +14,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
 - ``sweep``     sigma sweep             (Multi Time Step.ipynb#29-30)
 - ``basket``    multi-asset basket-call hedge vs the moment-matched-lognormal
                 oracle (BASELINE.json config 5; no reference analogue)
+- ``greeks``    pathwise-AD greeks of a European option vs the Black-Scholes
+                oracle (no reference analogue — NumPy loops can't differentiate)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 """
 
@@ -291,6 +293,29 @@ def cmd_basket(args):
         _emit_oos(args, oos.report)
 
 
+def cmd_greeks(args):
+    from orp_tpu.risk.greeks import european_greeks
+    from orp_tpu.utils.black_scholes import bs_greeks
+
+    res = european_greeks(
+        args.paths, args.s0, args.strike, args.r, args.sigma, args.T,
+        kind=args.option_type, n_steps=args.steps, seed=args.seed,
+        gamma_bump=args.gamma_bump,
+    )
+    out = {**res.as_dict(), "se": res.se, "n_paths": res.n_paths,
+           "n_steps": res.n_steps}
+    if args.json:
+        print(json.dumps(out))
+        return
+    oracle = bs_greeks(args.s0, args.strike, args.r, args.sigma, args.T,
+                       kind=args.option_type)
+    print(f"{'greek':<7}{'pathwise-AD':>14}{'black-scholes':>15}{'diff':>12}")
+    for name in ("price", "delta", "gamma", "vega", "rho", "theta"):
+        got = out[name]
+        print(f"{name:<7}{got:>14.6f}{oracle[name]:>15.6f}"
+              f"{got - oracle[name]:>+12.2e}")
+
+
 def cmd_calibrate(args):
     from orp_tpu.calib import (
         annualized_drift, estimate_cir_params, log_returns, rolling_volatility,
@@ -407,6 +432,24 @@ def main(argv=None):
     _add_oos_flag(pb)
     _add_quantile_flag(pb)
     pb.set_defaults(fn=cmd_basket)
+
+    pg = sub.add_parser(
+        "greeks",
+        help="pathwise AD greeks of a European option vs Black-Scholes",
+    )
+    pg.add_argument("--paths", type=int, default=1 << 17)
+    pg.add_argument("--steps", type=int, default=52)
+    pg.add_argument("--T", type=float, default=1.0)
+    pg.add_argument("--s0", type=float, default=100.0)
+    pg.add_argument("--strike", type=float, default=100.0)
+    pg.add_argument("--r", type=float, default=0.08)
+    pg.add_argument("--sigma", type=float, default=0.15)
+    pg.add_argument("--option-type", choices=["call", "put"], default="call")
+    pg.add_argument("--seed", type=int, default=1234)
+    pg.add_argument("--gamma-bump", type=float, default=0.01,
+                    help="relative spot bump of the CRN gamma difference")
+    pg.add_argument("--json", action="store_true")
+    pg.set_defaults(fn=cmd_greeks)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
     pc.add_argument("csv")

@@ -11,10 +11,13 @@ from orp_tpu.risk.analytics import (
     var_by_date,
     var_overall,
 )
+from orp_tpu.risk.greeks import GreeksResult, european_greeks
 
 __all__ = [
     "FanChart",
+    "GreeksResult",
     "HedgeReport",
+    "european_greeks",
     "build_report",
     "discounted_payoff_compare",
     "fan_chart",

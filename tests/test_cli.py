@@ -67,6 +67,14 @@ def test_calibrate_csv(tmp_path, capsys):
     assert out["sigma0"] > 0
 
 
+def test_greeks_json(capsys):
+    cli.main(["greeks", "--paths", "16384", "--steps", "13", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) >= {"price", "delta", "gamma", "vega", "rho", "theta", "se"}
+    assert abs(out["delta"] - 0.7285) < 0.02
+    assert out["n_paths"] == 16384
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])
