@@ -139,10 +139,13 @@ def main():
 
         from tools.rqmc_ci import main as rqmc
 
+        # 8 scrambles: a 4-draw CI has 3 dof and its sample SE can read 2-3x
+        # low (measured: the first 4 seeds at 2^18 drew +1.93 +/- 0.34 where
+        # 8 seeds read +0.84 +/- 0.60 — same estimator, honest dof)
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             rqmc(["--paths-log2", "17" if cpu_fallback else "18",
-                  "--scrambles", "4"])
+                  "--scrambles", "4" if cpu_fallback else "8"])
         ci = json.loads(buf.getvalue().strip().splitlines()[-1])
         record.update(rqmc_mean_bp=ci["mean_bp_err"], rqmc_se_bp=ci["se_bp"],
                       rqmc_scrambles=ci["scrambles"],

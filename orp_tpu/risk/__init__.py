@@ -11,13 +11,14 @@ from orp_tpu.risk.analytics import (
     var_by_date,
     var_overall,
 )
-from orp_tpu.risk.greeks import GreeksResult, european_greeks
+from orp_tpu.risk.greeks import GreeksResult, european_greeks, heston_greeks
 
 __all__ = [
     "FanChart",
     "GreeksResult",
     "HedgeReport",
     "european_greeks",
+    "heston_greeks",
     "build_report",
     "discounted_payoff_compare",
     "fan_chart",

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import TypedDict
 
 import jax
 import jax.numpy as jnp
@@ -159,17 +160,12 @@ def european_greeks(
     v, jac = _pathwise_jacobian(
         params, indices, grid, k, is_call, seed, scramble, dtype
     )
-    n = v.shape[0]
 
-    def mean_se(x):
-        m = jnp.mean(x)
-        return float(m), float(jnp.std(x) / jnp.sqrt(n))
-
-    price, se_price = mean_se(v)
-    delta, se_delta = mean_se(jac[:, 0])
-    vega, se_vega = mean_se(jac[:, 1])
-    rho, se_rho = mean_se(jac[:, 2])
-    dv_dtau, se_tau = mean_se(jac[:, 3])
+    price, se_price = _mean_se(v)
+    delta, se_delta = _mean_se(jac[:, 0])
+    vega, se_vega = _mean_se(jac[:, 1])
+    rho, se_rho = _mean_se(jac[:, 2])
+    dv_dtau, se_tau = _mean_se(jac[:, 3])
     theta = -dv_dtau / T  # dV/dt = -(1/T) dV/dtau at tau=1
 
     # CRN central difference of the PATHWISE delta column (not of prices):
@@ -189,5 +185,132 @@ def european_greeks(
             "price": se_price, "delta": se_delta, "vega": se_vega,
             "rho": se_rho, "theta": se_tau / T,
         },
-        n_paths=n, n_steps=n_steps,
+        n_paths=v.shape[0], n_steps=n_steps,
     )
+
+
+# ---------------------------------------------------------------------------
+# Heston: pathwise sensitivities through the full-truncation-Euler scan
+# ---------------------------------------------------------------------------
+
+
+def _mean_se(x) -> tuple[float, float]:
+    """(mean, iid-diagnostic standard error) of a per-path column."""
+    n = x.shape[0]
+    return float(jnp.mean(x)), float(jnp.std(x) / jnp.sqrt(n))
+
+
+def _safe_sqrt(x):
+    """sqrt with subgradient 0 at the truncation boundary: full-truncation
+    Euler clamps v at 0, where ``d sqrt/dv = inf`` would poison every tangent
+    of a path that ever touches the floor. The double-``where`` keeps the
+    primal exact and the tangent finite (0) on the clamped set."""
+    pos = x > 0.0
+    return jnp.where(pos, jnp.sqrt(jnp.where(pos, x, 1.0)), 0.0)
+
+
+def _heston_payoffs(params, indices, grid, k, rho, is_call, seed, scramble, dtype):
+    """Per-path discounted payoff as a differentiable function of
+    ``params = (s0, v0, kappa, theta, xi, r)`` — the same full-truncation
+    recurrence as ``simulate_heston_log`` (kernels.py:406), log-return
+    accumulated, with the correlation ``rho`` held static."""
+    s0, v0, kappa, theta, xi, r = params
+    sdt = jnp.sqrt(jnp.asarray(grid.dt, dtype))
+    rho_c = (1.0 - rho * rho) ** 0.5
+
+    def step(state, z, t, dt):
+        logs, v = state
+        vp = jnp.maximum(v, 0.0)
+        sv = _safe_sqrt(vp)
+        zs = rho * z[:, 1] + rho_c * z[:, 0]
+        logs = logs + (r - 0.5 * vp) * dt + sv * sdt * zs
+        v = v + kappa * (theta - vp) * dt + xi * sv * sdt * z[:, 1]
+        return (logs, v)
+
+    n = indices.shape[0]
+    state0 = (jnp.zeros((n,), dtype), jnp.full((n,), v0, dtype))
+    (acc, _), _ = scan_sde(
+        step, state0, lambda s: s[0], indices, grid, 2, seed,
+        scramble=scramble, store_every=grid.n_steps, dtype=dtype,
+    )
+    s_t = s0 * jnp.exp(acc)
+    payoff = jnp.maximum(s_t - k, 0.0) if is_call else jnp.maximum(k - s_t, 0.0)
+    return jnp.exp(-r * grid.T) * payoff
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("grid", "rho", "is_call", "seed", "scramble", "dtype"),
+)
+def _heston_jacobian(params, indices, grid, k, rho, is_call, seed, scramble, dtype):
+    fn = functools.partial(
+        _heston_payoffs, indices=indices, grid=grid, k=k, rho=rho,
+        is_call=is_call, seed=seed, scramble=scramble, dtype=dtype,
+    )
+    return fn(params), jax.jacfwd(fn)(params)  # (n,), (n, 6)
+
+
+class HestonGreeks(TypedDict):
+    price: float
+    delta: float
+    vega_v0: float
+    vega_kappa: float
+    vega_theta: float
+    vega_xi: float
+    rho_rate: float
+    se: dict[str, float]
+    n_paths: int
+    n_steps: int
+
+
+def heston_greeks(
+    n_paths: int,
+    s0: float,
+    k: float,
+    r: float,
+    T: float,
+    *,
+    v0: float,
+    kappa: float,
+    theta: float,
+    xi: float,
+    rho: float,
+    kind: str = "call",
+    n_steps: int = 364,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> HestonGreeks:
+    """Price + pathwise sensitivities of a European option under Heston, by
+    forward-mode AD through the full-truncation-Euler scan: ``delta`` (spot),
+    ``vega_v0``/``vega_theta``/``vega_kappa``/``vega_xi`` (the four variance-
+    dynamics sensitivities — no closed form exists for any of them) and
+    ``rho_rate``. The correlation ``rho`` stays a static config (its pathwise
+    derivative needs the z-rotation tangent; bump-reprice it with CRN if
+    needed). Returns a flat dict with an ``se`` sub-dict (iid-diagnostic)."""
+    if kind not in ("call", "put"):
+        raise ValueError(f"kind must be 'call' or 'put', got {kind!r}")
+    if not -1.0 <= rho <= 1.0:
+        # (1 - rho^2)**0.5 on a Python float silently goes COMPLEX past +/-1
+        # and would poison the whole simulation far from the bad input
+        raise ValueError(f"rho must be in [-1, 1], got {rho!r}")
+    if indices is None:
+        indices = jnp.arange(n_paths, dtype=jnp.uint32)
+    grid = TimeGrid(T, n_steps)
+    params = jnp.asarray([s0, v0, kappa, theta, xi, r], dtype)
+
+    v, jac = _heston_jacobian(
+        params, indices, grid, k, float(rho), kind == "call", seed, scramble,
+        dtype,
+    )
+    names = ("price", "delta", "vega_v0", "vega_kappa", "vega_theta",
+             "vega_xi", "rho_rate")
+    cols = (v, jac[:, 0], jac[:, 1], jac[:, 2], jac[:, 3], jac[:, 4],
+            jac[:, 5])
+    stats = {name: _mean_se(col) for name, col in zip(names, cols)}
+    out = {name: m for name, (m, _) in stats.items()}
+    out["se"] = {name: s for name, (_, s) in stats.items()}
+    out["n_paths"] = v.shape[0]
+    out["n_steps"] = n_steps
+    return out  # type: ignore[return-value]
