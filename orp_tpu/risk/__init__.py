@@ -11,11 +11,17 @@ from orp_tpu.risk.analytics import (
     var_by_date,
     var_overall,
 )
-from orp_tpu.risk.greeks import GreeksResult, european_greeks, heston_greeks
+from orp_tpu.risk.greeks import (
+    GreeksResult,
+    basket_greeks,
+    european_greeks,
+    heston_greeks,
+)
 
 __all__ = [
     "FanChart",
     "GreeksResult",
+    "basket_greeks",
     "HedgeReport",
     "european_greeks",
     "heston_greeks",

@@ -129,6 +129,74 @@ def main(out_path, only=None):
         return {"rows": [bc.config_1_single_step(), bc.config_2_multi_step_100k(),
                          bc.config_4_heston()]}
 
+    def pension_walk():
+        # the reference Multi config (4,096 paths, dt=1/100, quarterly -> 40
+        # dates, dual 500/100 Adam) AND the GN-IRLS variant of the same walk;
+        # the r2 wall (93-108s cold / 27s warm) predates both TPU numerics
+        # fixes (full-f32 matmuls §6b, no-device-log kernels §6d)
+        import time as _t
+
+        from orp_tpu.api import HedgeRunConfig, SimConfig, TrainConfig, pension_hedge
+
+        sim = SimConfig(n_paths=4096, T=10.0, dt=0.01, rebalance_every=25)
+        out = {}
+        for name, train in (
+            ("adam", TrainConfig(fused=True, shuffle="blocks")),
+            ("gn_irls", TrainConfig(fused=True, shuffle="blocks",
+                                    optimizer="gauss_newton",
+                                    gn_iters_first=60, gn_iters_warm=30)),
+        ):
+            cfg = HedgeRunConfig(sim=sim, train=train)
+
+            def run():
+                t0 = _t.perf_counter()
+                res = pension_hedge(cfg)
+                return _t.perf_counter() - t0, res
+
+            cold_s, res = run()
+            warm_s, res = run()
+            out[name] = {
+                "cold_s": round(cold_s, 1), "warm_s": round(warm_s, 1),
+                "v0": round(float(res.v0), 1),
+            }
+        return out
+
+    def greeks():
+        # pathwise-AD greeks on the chip: 1M-path European jacobian (one
+        # fused scan, 4 tangents) vs closed-form BS, and the 262k-path
+        # 6-tangent Heston batch vs the CF oracle
+        import time as _t
+
+        from orp_tpu.risk.greeks import european_greeks, heston_greeks
+        from orp_tpu.utils.black_scholes import bs_greeks
+        from orp_tpu.utils.heston import heston_call
+
+        def run_euro():
+            t0 = _t.perf_counter()
+            g = european_greeks(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
+                                n_steps=52, seed=1234)
+            return _t.perf_counter() - t0, g
+
+        cold_s, g = run_euro()
+        warm_s, g = run_euro()
+        oracle = bs_greeks(100.0, 100.0, 0.08, 0.15, 1.0)
+        t0 = _t.perf_counter()
+        h = heston_greeks(1 << 18, 100.0, 100.0, 0.08, 1.0, v0=0.0225,
+                          kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6,
+                          n_steps=364, seed=1234)
+        heston_s = _t.perf_counter() - t0
+        h_oracle = heston_call(100.0, 100.0, 0.08, 1.0, v0=0.0225, kappa=1.5,
+                               theta=0.0225, xi=0.25, rho=-0.6)
+        return {
+            "euro_1m": {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+                        **{k: round(v, 6) for k, v in g.as_dict().items()}},
+            "euro_bs_oracle": {k: round(v, 6) for k, v in oracle.items()},
+            "heston_262k": {"wall_s": round(heston_s, 2),
+                            **{k: round(v, 6) for k, v in h.items()
+                               if isinstance(v, float)}},
+            "heston_cf_price": round(h_oracle, 6),
+        }
+
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
@@ -142,6 +210,8 @@ def main(out_path, only=None):
         ("paths_sweep", paths_sweep),
         ("binomial", binom),
         ("baselines", baselines),
+        ("pension_walk", pension_walk),
+        ("greeks", greeks),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -151,7 +221,8 @@ def main(out_path, only=None):
 
 
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
-               "profile", "paths_sweep", "binomial", "baselines")
+               "profile", "paths_sweep", "binomial", "baselines",
+               "pension_walk", "greeks")
 
 
 if __name__ == "__main__":
