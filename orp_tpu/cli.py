@@ -16,6 +16,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 oracle (BASELINE.json config 5; no reference analogue)
 - ``greeks``    pathwise-AD greeks of a European option vs the Black-Scholes
                 oracle (no reference analogue — NumPy loops can't differentiate)
+- ``bermudan``  Bermudan option via Sobol-QMC Longstaff-Schwartz vs the CRR
+                binomial oracle (no reference analogue — no early exercise)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 """
 
@@ -316,6 +318,29 @@ def cmd_greeks(args):
               f"{got - oracle[name]:>+12.2e}")
 
 
+def cmd_bermudan(args):
+    from orp_tpu.train.lsm import bermudan_lsm
+    from orp_tpu.utils.crr import crr_price
+
+    res = bermudan_lsm(
+        args.paths, args.s0, args.strike, args.r, args.sigma, args.T,
+        kind=args.option_type, n_exercise=args.exercise_dates,
+        steps_per_exercise=args.steps_per_exercise, seed=args.seed,
+    )
+    if args.json:
+        print(json.dumps(res))
+        return
+    oracle = crr_price(
+        args.s0, args.strike, args.r, args.sigma, args.T,
+        kind=args.option_type, exercise="bermudan",
+        n_steps=100 * args.exercise_dates, exercise_every=100,
+    )
+    print(f"LSM price          {res['price']:.4f} ± {res['se']:.4f} (SE)")
+    print(f"CRR bermudan       {oracle:.4f}")
+    print(f"european (same paths) {res['european']:.4f}")
+    print(f"early-exercise premium {res['early_exercise_premium']:.4f}")
+
+
 def cmd_calibrate(args):
     from orp_tpu.calib import (
         annualized_drift, estimate_cir_params, log_returns, rolling_volatility,
@@ -450,6 +475,24 @@ def main(argv=None):
                     help="relative spot bump of the CRN gamma difference")
     pg.add_argument("--json", action="store_true")
     pg.set_defaults(fn=cmd_greeks)
+
+    pm = sub.add_parser(
+        "bermudan",
+        help="Bermudan option price by Sobol-QMC Longstaff-Schwartz LSM "
+             "vs the CRR binomial oracle",
+    )
+    pm.add_argument("--paths", type=int, default=1 << 17)
+    pm.add_argument("--exercise-dates", type=int, default=50)
+    pm.add_argument("--steps-per-exercise", type=int, default=4)
+    pm.add_argument("--T", type=float, default=1.0)
+    pm.add_argument("--s0", type=float, default=36.0)
+    pm.add_argument("--strike", type=float, default=40.0)
+    pm.add_argument("--r", type=float, default=0.06)
+    pm.add_argument("--sigma", type=float, default=0.2)
+    pm.add_argument("--option-type", choices=["call", "put"], default="put")
+    pm.add_argument("--seed", type=int, default=1234)
+    pm.add_argument("--json", action="store_true")
+    pm.set_defaults(fn=cmd_bermudan)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
     pc.add_argument("csv")

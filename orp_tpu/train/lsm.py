@@ -1,0 +1,136 @@
+"""Least-squares Monte-Carlo (Longstaff-Schwartz) Bermudan pricing.
+
+The reference's backward-induction engine (``Replicating_Portfolio.py:193-227``)
+is a neural continuation-value regression for EUROPEAN claims — it never
+exercises. This module is the optimal-stopping extension a pricing user
+expects: the same backward walk over dates, but each date compares intrinsic
+value against a regressed continuation value and exercises where intrinsic
+wins (Longstaff-Schwarz 2001 realized-cashflow form).
+
+TPU-first design:
+- The whole backward walk is ONE ``lax.scan`` over exercise dates (static
+  shapes, no data-dependent control flow): the classical "regress only ITM
+  paths" restriction becomes a WEIGHTED normal-equations solve (weight = ITM
+  indicator), which keeps every array (n_paths,) and shards over a
+  ``("paths",)`` mesh with two B×B-sized psums per date (B = basis size, 4).
+- Paths are scrambled-Sobol from the same L2 kernel as every pricer
+  (``simulate_gbm_log``), stored at exercise dates only (``store_every``).
+- The B×B solve runs in full f32 (`precision="highest"`) with a tiny ridge —
+  a Gram matrix of powers is exactly the conditioning regime SCALING.md §6b
+  measured going wrong under TPU's default bf16 matmuls.
+
+Estimator notes: the regressed-policy price is the standard LSM estimator —
+a LOW-biased lower bound from a suboptimal policy, with O(paths^-1/2) noise
+on top; discretization-in-exercise-dates makes Bermudan < American. Pinned
+against a CRR binomial oracle (``utils/crr.py``) in ``tests/test_lsm.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from orp_tpu.sde.grid import TimeGrid
+from orp_tpu.sde.kernels import simulate_gbm_log
+
+
+@functools.partial(jax.jit, static_argnames=("n_basis",))
+def _lsm_walk(s_dates, payoffs, disc, n_basis):
+    """Backward LSM scan. ``s_dates``/``payoffs``: (n, m) at exercise dates
+    t_1..t_m; ``disc``: per-interval discount e^{-r dt}. Returns the (n,)
+    realized discounted cashflows at t_1 (to be discounted once more to 0)."""
+
+    def regress_step(v, inputs):
+        s, pay = inputs  # (n,), (n,) at date j
+        vd = disc * v    # realized future cashflow discounted to date j
+        itm = (pay > 0.0).astype(s.dtype)
+        # standardize s over the ITM set BEFORE taking powers: the Gram of
+        # raw powers is ill-conditioned enough that TPU's f32 matmul
+        # accumulation error blows up through the solve — measured −12¢
+        # (−2.7%) on the 1M-path LS2001 put vs CPU-f32, growing with path
+        # count. Centered/scaled powers span the SAME polynomial space;
+        # cond(Gram) drops ~4 orders of magnitude. (All jnp.mean/sum here
+        # are mesh-safe: XLA inserts psums over a sharded path axis.)
+        wsum = jnp.sum(itm) + 1.0
+        mu = jnp.sum(itm * s) / wsum
+        # sd floor: with ZERO ITM paths the weighted variance is 0 and z
+        # would blow up; clamped, z stays bounded, gram collapses to the
+        # ridge, beta = 0, and the date is a clean no-exercise pass-through
+        sd = jnp.maximum(jnp.sqrt(jnp.sum(itm * (s - mu) ** 2) / wsum), 1e-3)
+        z = (s - mu) / sd
+        x = jnp.stack([z**i for i in range(n_basis)], axis=-1)  # (n, B)
+        xw = x * itm[:, None]
+        gram = jnp.matmul(xw.T, x, precision="highest")
+        rhs = jnp.matmul(xw.T, vd[:, None], precision="highest")[:, 0]
+        # relative ridge + ABSOLUTE floor: trace(gram) is 0 on an all-OTM
+        # date and a purely relative ridge would hand solve() a zero matrix
+        # (NaN beta under jax_debug_nans even though the price survives)
+        gram = gram + (1e-6 * jnp.trace(gram) / n_basis + 1e-6) * jnp.eye(
+            n_basis, dtype=s.dtype
+        )
+        beta = jax.scipy.linalg.solve(gram, rhs, assume_a="pos")
+        cont = jnp.matmul(x, beta[:, None], precision="highest")[:, 0]
+        v = jnp.where((pay > 0.0) & (pay > cont), pay, vd)
+        return v, ()
+
+    # terminal date: exercise iff ITM (continuation is 0 past maturity)
+    v0 = payoffs[:, -1]
+    # walk m-1, ..., 1 (reversed); date t_0=0 has no exercise right
+    rev = lambda a: a[:, :-1][:, ::-1].T  # (m-1, n)
+    v, _ = jax.lax.scan(regress_step, v0, (rev(s_dates), rev(payoffs)))
+    return v
+
+
+def bermudan_lsm(
+    n_paths: int,
+    s0: float,
+    k: float,
+    r: float,
+    sigma: float,
+    T: float,
+    *,
+    kind: str = "put",
+    n_exercise: int = 50,
+    steps_per_exercise: int = 4,
+    n_basis: int = 4,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> dict[str, float]:
+    """Bermudan option price by Sobol-QMC LSM: ``n_exercise`` equally spaced
+    exercise dates (the last = maturity), log-Euler GBM paths with
+    ``steps_per_exercise`` fine steps per date. Returns price + the European
+    price off the SAME paths (the early-exercise premium comes out of one
+    simulation) and an iid-diagnostic SE."""
+    if kind not in ("call", "put"):
+        raise ValueError(f"kind must be 'call' or 'put', got {kind!r}")
+    if indices is None:
+        indices = jnp.arange(n_paths, dtype=jnp.uint32)
+    n_steps = n_exercise * steps_per_exercise
+    grid = TimeGrid(T, n_steps)
+    s = simulate_gbm_log(
+        indices, grid, s0, r, sigma, seed=seed, scramble=scramble,
+        store_every=steps_per_exercise, dtype=dtype,
+    )  # (n, n_exercise + 1) incl. t=0
+    s_dates = s[:, 1:]  # spot at t_1..t_m (regress_step standardizes per date)
+    sign = 1.0 if kind == "call" else -1.0
+    pay = jnp.maximum(sign * (s[:, 1:] - k), 0.0)
+    dt_ex = T / n_exercise
+    disc = jnp.asarray(jnp.exp(-r * dt_ex), dtype)
+
+    v1 = _lsm_walk(s_dates, pay, disc, n_basis)  # cashflows at t_1
+    v0 = disc * v1                               # discount t_1 -> 0
+    price = float(jnp.mean(v0))
+    se = float(jnp.std(v0) / jnp.sqrt(v0.shape[0]))
+    euro = float(jnp.mean(jnp.exp(-r * T) * pay[:, -1]))
+    return {
+        "price": price,
+        "se": se,
+        "european": euro,
+        "early_exercise_premium": price - euro,
+        "n_paths": int(v0.shape[0]),
+        "n_exercise": n_exercise,
+    }

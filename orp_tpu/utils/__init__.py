@@ -1,12 +1,15 @@
 """Cross-cutting utilities: checkpointing, profiling/timing."""
 
-from orp_tpu.utils.black_scholes import bs_call, bs_put
+from orp_tpu.utils.black_scholes import bs_call, bs_greeks, bs_put
 from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
+from orp_tpu.utils.crr import crr_price
 from orp_tpu.utils.profiling import timed, trace
 
 __all__ = [
     "bs_call",
+    "bs_greeks",
     "bs_put",
+    "crr_price",
     "latest_step",
     "load_checkpoint",
     "save_checkpoint",

@@ -75,6 +75,14 @@ def test_greeks_json(capsys):
     assert out["n_paths"] == 16384
 
 
+def test_bermudan_json(capsys):
+    cli.main(["bermudan", "--paths", "16384", "--exercise-dates", "10",
+              "--steps-per-exercise", "2", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) >= {"price", "se", "european", "early_exercise_premium"}
+    assert out["price"] > out["european"] > 0
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])

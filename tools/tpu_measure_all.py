@@ -197,6 +197,29 @@ def main(out_path, only=None):
             "heston_cf_price": round(h_oracle, 6),
         }
 
+    def bermudan():
+        # Sobol-QMC LSM at 1M paths, 50 exercise dates (the LS2001 S0=36
+        # put) vs its CRR oracle — the optimal-stopping walk on the chip
+        import time as _t
+
+        from orp_tpu.train.lsm import bermudan_lsm
+        from orp_tpu.utils.crr import crr_price
+
+        def run():
+            t0 = _t.perf_counter()
+            res = bermudan_lsm(1 << 20, 36.0, 40.0, 0.06, 0.2, 1.0,
+                               n_exercise=50, seed=1234)
+            return _t.perf_counter() - t0, res
+
+        cold_s, res = run()
+        warm_s, res = run()
+        oracle = crr_price(36.0, 40.0, 0.06, 0.2, 1.0, exercise="bermudan",
+                           n_steps=5000, exercise_every=100)
+        return {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+                "price": round(res["price"], 5), "se": round(res["se"], 5),
+                "crr_oracle": round(oracle, 5),
+                "european": round(res["european"], 5)}
+
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
@@ -212,6 +235,7 @@ def main(out_path, only=None):
         ("baselines", baselines),
         ("pension_walk", pension_walk),
         ("greeks", greeks),
+        ("bermudan", bermudan),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -222,7 +246,7 @@ def main(out_path, only=None):
 
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
                "profile", "paths_sweep", "binomial", "baselines",
-               "pension_walk", "greeks")
+               "pension_walk", "greeks", "bermudan")
 
 
 if __name__ == "__main__":
