@@ -18,6 +18,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 oracle (no reference analogue — NumPy loops can't differentiate)
 - ``bermudan``  Bermudan option via Sobol-QMC Longstaff-Schwartz vs the CRR
                 binomial oracle (no reference analogue — no early exercise)
+- ``surface``   price / implied-vol surface over strikes x maturities from
+                ONE Sobol path set (no reference analogue)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 """
 
@@ -318,6 +320,38 @@ def cmd_greeks(args):
               f"{got - oracle[name]:>+12.2e}")
 
 
+def cmd_surface(args):
+    import numpy as np
+
+    from orp_tpu.risk.surface import price_surface
+
+    strikes = [float(x) for x in args.strikes.split(",")]
+    surf = price_surface(
+        args.paths, args.s0, args.r, args.sigma, strikes, args.T,
+        kind=args.option_type, n_maturities=args.maturities,
+        steps_per_maturity=args.steps_per_maturity, seed=args.seed,
+    )
+    if args.json:
+        iv_rows = np.asarray(surf["iv"]).round(6)
+        print(json.dumps({
+            "times": np.asarray(surf["times"]).tolist(),
+            "strikes": strikes,
+            "prices": np.asarray(surf["prices"]).round(6).tolist(),
+            # NaN (price on the no-arbitrage floor) -> null: bare NaN
+            # tokens are not RFC-8259 JSON and break jq/JSON.parse
+            "iv": [[float(v) if np.isfinite(v) else None for v in row]
+                   for row in iv_rows],
+        }))
+        return
+    iv = np.asarray(surf["iv"])
+    times = np.asarray(surf["times"])
+    print("implied-vol surface (rows = maturity, cols = strike; "
+          "nan = price on the no-arbitrage floor)")
+    print(f"{'T \\ K':>7}" + "".join(f"{k:>9.1f}" for k in strikes))
+    for i, t in enumerate(times):
+        print(f"{t:7.3f}" + "".join(f"{v:9.4f}" for v in iv[i]))
+
+
 def cmd_bermudan(args):
     from orp_tpu.train.lsm import bermudan_lsm
     from orp_tpu.utils.crr import crr_price
@@ -475,6 +509,25 @@ def main(argv=None):
                     help="relative spot bump of the CRN gamma difference")
     pg.add_argument("--json", action="store_true")
     pg.set_defaults(fn=cmd_greeks)
+
+    pv = sub.add_parser(
+        "surface",
+        help="European price / implied-vol surface from ONE Sobol path set",
+    )
+    pv.add_argument("--paths", type=int, default=1 << 17)
+    pv.add_argument("--strikes", default="80,90,95,100,105,110,120",
+                    help="comma-separated strike list")
+    pv.add_argument("--maturities", type=int, default=13,
+                    help="equally spaced maturities out to T")
+    pv.add_argument("--steps-per-maturity", type=int, default=4)
+    pv.add_argument("--T", type=float, default=1.0)
+    pv.add_argument("--s0", type=float, default=100.0)
+    pv.add_argument("--r", type=float, default=0.08)
+    pv.add_argument("--sigma", type=float, default=0.15)
+    pv.add_argument("--option-type", choices=["call", "put"], default="call")
+    pv.add_argument("--seed", type=int, default=1234)
+    pv.add_argument("--json", action="store_true")
+    pv.set_defaults(fn=cmd_surface)
 
     pm = sub.add_parser(
         "bermudan",

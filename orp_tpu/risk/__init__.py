@@ -17,6 +17,7 @@ from orp_tpu.risk.greeks import (
     european_greeks,
     heston_greeks,
 )
+from orp_tpu.risk.surface import implied_vol, price_surface
 
 __all__ = [
     "FanChart",
@@ -25,6 +26,8 @@ __all__ = [
     "HedgeReport",
     "european_greeks",
     "heston_greeks",
+    "implied_vol",
+    "price_surface",
     "build_report",
     "discounted_payoff_compare",
     "fan_chart",

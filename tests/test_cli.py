@@ -83,6 +83,16 @@ def test_bermudan_json(capsys):
     assert out["price"] > out["european"] > 0
 
 
+def test_surface_json(capsys):
+    cli.main(["surface", "--paths", "16384", "--strikes", "95,100,105",
+              "--maturities", "4", "--steps-per-maturity", "13", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert len(out["prices"]) == 4 and len(out["prices"][0]) == 3
+    iv = np.asarray(out["iv"], dtype=float)
+    assert np.isfinite(iv[-1]).all()
+    np.testing.assert_allclose(iv[-1, 1], 0.15, atol=5e-3)
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])

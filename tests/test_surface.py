@@ -1,0 +1,115 @@
+"""Price/IV surface (risk/surface.py): the flat-smile round-trip oracle.
+
+Flat-vol GBM paths -> QMC price surface -> Newton implied vol must recover
+the input sigma at every (strike, maturity) node within QMC noise; plus
+no-arbitrage monotonicities and the NaN band outside price bounds.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orp_tpu.risk.surface import implied_vol, price_surface
+from orp_tpu.utils.black_scholes import bs_call, bs_greeks
+
+SIGMA = 0.15
+
+
+@pytest.fixture(scope="module")
+def surf():
+    return price_surface(
+        1 << 16, 100.0, 0.08, SIGMA,
+        strikes=[80.0, 90.0, 100.0, 110.0, 120.0], T=1.0,
+        n_maturities=13, steps_per_maturity=4, seed=21,
+    )
+
+
+def test_surface_prices_match_black_scholes(surf):
+    prices = np.asarray(surf["prices"])
+    times = np.asarray(surf["times"])
+    strikes = np.asarray(surf["strikes"])
+    assert prices.shape == (13, 5)
+    for i in (3, 12):       # a short and the terminal maturity
+        for j in range(5):
+            want, _ = bs_call(100.0, strikes[j], 0.08, SIGMA, times[i])
+            np.testing.assert_allclose(prices[i, j], want, atol=0.035,
+                                       err_msg=f"(T={times[i]}, K={strikes[j]})")
+
+
+def test_flat_smile_roundtrip(surf):
+    """The recovered IV grid must be flat at the simulation sigma."""
+    iv = np.asarray(surf["iv"])
+    # the 3 shortest-dated extreme-wing nodes sit ON the no-arbitrage floor
+    # (deep-ITM K=80 / deep-OTM K=120 at T<=0.15y: time value below QMC
+    # noise) and NaN by design; everything else must invert
+    finite = np.isfinite(iv)
+    assert finite.sum() >= iv.size - 4
+    assert finite[3:, :].all() and finite[:, 1:4].all()
+    # QMC noise in the price maps to IV noise ~ price_err / vega; widest at
+    # the short-dated wings — bound the finite set at 60bp and ATM at 15bp
+    np.testing.assert_allclose(iv[finite], SIGMA, atol=6e-3)
+    np.testing.assert_allclose(iv[-1, 2], SIGMA, atol=1.5e-3)
+
+
+def test_surface_monotonicities(surf):
+    prices = np.asarray(surf["prices"])
+    # calls decrease in strike, increase in maturity (no-arbitrage)
+    assert (np.diff(prices, axis=1) < 0).all()
+    assert (np.diff(prices, axis=0) > -1e-6).all()
+
+
+@pytest.mark.parametrize("kind", ["call", "put"])
+def test_implied_vol_exact_inversion(kind):
+    """Feed exact BS prices (no QMC): Newton must invert to machine-ish
+    sigma for BOTH option kinds (the no-arbitrage band logic is
+    sign-specific)."""
+    strikes = jnp.asarray([70.0, 100.0, 130.0])
+    times = jnp.asarray([0.25, 1.0, 2.0])
+    prices = np.empty((3, 3))
+    for i, t in enumerate(times):
+        for j, k in enumerate(strikes):
+            prices[i, j] = bs_greeks(100.0, float(k), 0.03, 0.22,
+                                     float(t), kind=kind)["price"]
+    iv = np.asarray(implied_vol(jnp.asarray(prices), 100.0, strikes, times,
+                                0.03, kind=kind))
+    np.testing.assert_allclose(iv, 0.22, atol=1e-5)
+
+
+def test_put_surface_flat_smile():
+    surf = price_surface(1 << 15, 100.0, 0.05, 0.2, strikes=[95.0, 105.0],
+                         T=1.0, n_maturities=4, steps_per_maturity=13,
+                         seed=17, kind="put")
+    iv = np.asarray(surf["iv"])
+    assert np.isfinite(iv).all()
+    np.testing.assert_allclose(iv, 0.2, atol=5e-3)
+
+
+def test_implied_vol_nan_outside_bounds():
+    strikes = jnp.asarray([100.0])
+    times = jnp.asarray([1.0])
+    below = jnp.asarray([[0.0]])   # below forward intrinsic for K=S0? no: 0 < lower only if s0>K disc
+    above = jnp.asarray([[200.0]])  # above the s0 upper bound
+    iv_hi = np.asarray(implied_vol(above, 100.0, strikes, times, 0.05))
+    assert np.isnan(iv_hi).all()
+    # price below the forward-intrinsic floor: deep-ITM strike priced at 0
+    iv_lo = np.asarray(implied_vol(below, 100.0, jnp.asarray([50.0]), times, 0.05))
+    assert np.isnan(iv_lo).all()
+
+
+def test_put_surface_parity_at_terminal():
+    call = price_surface(1 << 14, 100.0, 0.05, 0.2, strikes=[100.0], T=1.0,
+                         n_maturities=4, steps_per_maturity=13, seed=3)
+    put = price_surface(1 << 14, 100.0, 0.05, 0.2, strikes=[100.0], T=1.0,
+                        n_maturities=4, steps_per_maturity=13, seed=3,
+                        kind="put")
+    c = float(call["prices"][-1, 0])
+    p = float(put["prices"][-1, 0])
+    # same paths, so c - p = disc * (mean(S_T) - K): the residual is the
+    # QMC drift error of mean(S_T) at 16k paths (~1e-4 rel), not epsilon
+    np.testing.assert_allclose(c - p, 100.0 - 100.0 * np.exp(-0.05), atol=5e-3)
+
+
+def test_kind_validation():
+    with pytest.raises(ValueError):
+        price_surface(128, 100.0, 0.05, 0.2, strikes=[100.0], T=1.0,
+                      kind="digital")
