@@ -3,7 +3,7 @@
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
 from orp_tpu.train.fit import FitConfig, fit, reference_lr_schedule
 from orp_tpu.train.gn import GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball
-from orp_tpu.train.lsm import bermudan_lsm
+from orp_tpu.train.lsm import bermudan_lsm, bermudan_lsm_heston
 from orp_tpu.train.replay import replay_walk
 from orp_tpu.train import losses
 
@@ -18,6 +18,7 @@ __all__ = [
     "fit_gn",
     "fit_gn_pinball",
     "bermudan_lsm",
+    "bermudan_lsm_heston",
     "reference_lr_schedule",
     "replay_walk",
     "losses",

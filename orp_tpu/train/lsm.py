@@ -36,31 +36,62 @@ from orp_tpu.sde.grid import TimeGrid
 from orp_tpu.sde.kernels import simulate_gbm_log
 
 
-@functools.partial(jax.jit, static_argnames=("n_basis",))
-def _lsm_walk(s_dates, payoffs, disc, n_basis):
-    """Backward LSM scan. ``s_dates``/``payoffs``: (n, m) at exercise dates
-    t_1..t_m; ``disc``: per-interval discount e^{-r dt}. Returns the (n,)
-    realized discounted cashflows at t_1 (to be discounted once more to 0)."""
+def _monomial_exponents(n_features: int, degree: int) -> tuple[tuple[int, ...], ...]:
+    """All exponent tuples with total degree <= ``degree`` (the static basis
+    layout; for one feature this is exactly ``1, z, z^2, ..., z^degree``)."""
+    exps: list[tuple[int, ...]] = []
+
+    def rec(prefix: tuple[int, ...], remaining: int, budget: int):
+        if remaining == 0:
+            exps.append(prefix)
+            return
+        for e in range(budget + 1):
+            rec(prefix + (e,), remaining - 1, budget - e)
+
+    rec((), n_features, degree)
+    # sort by total degree then lexicographic: constant column first
+    exps.sort(key=lambda t: (sum(t), t))
+    return tuple(exps)
+
+
+@functools.partial(jax.jit, static_argnames=("degree",))
+def _lsm_walk(feats, payoffs, disc, degree):
+    """Backward LSM scan. ``feats``: (n, m, F) regression features and
+    ``payoffs``: (n, m) at exercise dates t_1..t_m; ``disc``: per-interval
+    discount e^{-r dt}. The continuation basis is every monomial of the
+    standardized features up to total ``degree``. Returns the (n,) realized
+    discounted cashflows at t_1 (to be discounted once more to 0)."""
+    n_features = feats.shape[-1]
+    exps = _monomial_exponents(n_features, degree)
+    n_basis = len(exps)
 
     def regress_step(v, inputs):
-        s, pay = inputs  # (n,), (n,) at date j
+        f, pay = inputs  # (n, F), (n,) at date j
         vd = disc * v    # realized future cashflow discounted to date j
-        itm = (pay > 0.0).astype(s.dtype)
-        # standardize s over the ITM set BEFORE taking powers: the Gram of
-        # raw powers is ill-conditioned enough that TPU's f32 matmul
-        # accumulation error blows up through the solve — measured −12¢
-        # (−2.7%) on the 1M-path LS2001 put vs CPU-f32, growing with path
-        # count. Centered/scaled powers span the SAME polynomial space;
-        # cond(Gram) drops ~4 orders of magnitude. (All jnp.mean/sum here
+        itm = (pay > 0.0).astype(pay.dtype)
+        # standardize every feature over the ITM set BEFORE taking powers:
+        # the Gram of raw powers is ill-conditioned enough that TPU's f32
+        # matmul accumulation error blows up through the solve — measured
+        # −12¢ (−2.7%) on the 1M-path LS2001 put vs CPU-f32, growing with
+        # path count. Centered/scaled monomials span the SAME polynomial
+        # space; cond(Gram) drops ~4 orders of magnitude. (All sums here
         # are mesh-safe: XLA inserts psums over a sharded path axis.)
         wsum = jnp.sum(itm) + 1.0
-        mu = jnp.sum(itm * s) / wsum
+        mu = jnp.sum(itm[:, None] * f, axis=0) / wsum  # (F,)
         # sd floor: with ZERO ITM paths the weighted variance is 0 and z
         # would blow up; clamped, z stays bounded, gram collapses to the
         # ridge, beta = 0, and the date is a clean no-exercise pass-through
-        sd = jnp.maximum(jnp.sqrt(jnp.sum(itm * (s - mu) ** 2) / wsum), 1e-3)
-        z = (s - mu) / sd
-        x = jnp.stack([z**i for i in range(n_basis)], axis=-1)  # (n, B)
+        sd = jnp.maximum(
+            jnp.sqrt(jnp.sum(itm[:, None] * (f - mu) ** 2, axis=0) / wsum),
+            1e-3,
+        )
+        z = (f - mu) / sd  # (n, F)
+        cols = [
+            jnp.prod(jnp.stack([z[:, i] ** e for i, e in enumerate(exp)]), axis=0)
+            if any(exp) else jnp.ones_like(pay)
+            for exp in exps
+        ]
+        x = jnp.stack(cols, axis=-1)  # (n, B)
         xw = x * itm[:, None]
         gram = jnp.matmul(xw.T, x, precision="highest")
         rhs = jnp.matmul(xw.T, vd[:, None], precision="highest")[:, 0]
@@ -68,7 +99,7 @@ def _lsm_walk(s_dates, payoffs, disc, n_basis):
         # date and a purely relative ridge would hand solve() a zero matrix
         # (NaN beta under jax_debug_nans even though the price survives)
         gram = gram + (1e-6 * jnp.trace(gram) / n_basis + 1e-6) * jnp.eye(
-            n_basis, dtype=s.dtype
+            n_basis, dtype=pay.dtype
         )
         beta = jax.scipy.linalg.solve(gram, rhs, assume_a="pos")
         cont = jnp.matmul(x, beta[:, None], precision="highest")[:, 0]
@@ -78,9 +109,37 @@ def _lsm_walk(s_dates, payoffs, disc, n_basis):
     # terminal date: exercise iff ITM (continuation is 0 past maturity)
     v0 = payoffs[:, -1]
     # walk m-1, ..., 1 (reversed); date t_0=0 has no exercise right
-    rev = lambda a: a[:, :-1][:, ::-1].T  # (m-1, n)
-    v, _ = jax.lax.scan(regress_step, v0, (rev(s_dates), rev(payoffs)))
+    feats_rev = jnp.moveaxis(feats[:, :-1][:, ::-1], 0, 1)  # (m-1, n, F)
+    pay_rev = payoffs[:, :-1][:, ::-1].T                    # (m-1, n)
+    v, _ = jax.lax.scan(regress_step, v0, (feats_rev, pay_rev))
     return v
+
+
+def _lsm_price(feats, s_dates, k, kind, r, T, n_exercise, degree, dtype):
+    """Shared estimator tail: payoff sign, the walk, t_1->0 discounting, and
+    the stats dict — ONE copy of the contract for every dynamics variant."""
+    sign = 1.0 if kind == "call" else -1.0
+    pay = jnp.maximum(sign * (s_dates - k), 0.0)
+    disc = jnp.asarray(jnp.exp(-r * (T / n_exercise)), dtype)
+    v0 = disc * _lsm_walk(feats, pay, disc, degree)  # cashflows at t_1 -> 0
+    price = float(jnp.mean(v0))
+    euro = float(jnp.mean(jnp.exp(-r * T) * pay[:, -1]))
+    return {
+        "price": price,
+        "se": float(jnp.std(v0) / jnp.sqrt(v0.shape[0])),
+        "european": euro,
+        "early_exercise_premium": price - euro,
+        "n_paths": int(v0.shape[0]),
+        "n_exercise": n_exercise,
+    }
+
+
+def _validate_kind_indices(kind, indices, n_paths):
+    if kind not in ("call", "put"):
+        raise ValueError(f"kind must be 'call' or 'put', got {kind!r}")
+    if indices is None:
+        indices = jnp.arange(n_paths, dtype=jnp.uint32)
+    return indices
 
 
 def bermudan_lsm(
@@ -105,32 +164,56 @@ def bermudan_lsm(
     ``steps_per_exercise`` fine steps per date. Returns price + the European
     price off the SAME paths (the early-exercise premium comes out of one
     simulation) and an iid-diagnostic SE."""
-    if kind not in ("call", "put"):
-        raise ValueError(f"kind must be 'call' or 'put', got {kind!r}")
-    if indices is None:
-        indices = jnp.arange(n_paths, dtype=jnp.uint32)
-    n_steps = n_exercise * steps_per_exercise
-    grid = TimeGrid(T, n_steps)
+    indices = _validate_kind_indices(kind, indices, n_paths)
+    grid = TimeGrid(T, n_exercise * steps_per_exercise)
     s = simulate_gbm_log(
         indices, grid, s0, r, sigma, seed=seed, scramble=scramble,
         store_every=steps_per_exercise, dtype=dtype,
     )  # (n, n_exercise + 1) incl. t=0
     s_dates = s[:, 1:]  # spot at t_1..t_m (regress_step standardizes per date)
-    sign = 1.0 if kind == "call" else -1.0
-    pay = jnp.maximum(sign * (s[:, 1:] - k), 0.0)
-    dt_ex = T / n_exercise
-    disc = jnp.asarray(jnp.exp(-r * dt_ex), dtype)
+    # single feature (spot), degree n_basis-1 polynomial
+    return _lsm_price(s_dates[:, :, None], s_dates, k, kind, r, T,
+                      n_exercise, n_basis - 1, dtype)
 
-    v1 = _lsm_walk(s_dates, pay, disc, n_basis)  # cashflows at t_1
-    v0 = disc * v1                               # discount t_1 -> 0
-    price = float(jnp.mean(v0))
-    se = float(jnp.std(v0) / jnp.sqrt(v0.shape[0]))
-    euro = float(jnp.mean(jnp.exp(-r * T) * pay[:, -1]))
-    return {
-        "price": price,
-        "se": se,
-        "european": euro,
-        "early_exercise_premium": price - euro,
-        "n_paths": int(v0.shape[0]),
-        "n_exercise": n_exercise,
-    }
+
+def bermudan_lsm_heston(
+    n_paths: int,
+    s0: float,
+    k: float,
+    r: float,
+    T: float,
+    *,
+    v0: float,
+    kappa: float,
+    theta: float,
+    xi: float,
+    rho: float,
+    kind: str = "put",
+    n_exercise: int = 50,
+    steps_per_exercise: int = 4,
+    degree: int = 3,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> dict[str, float]:
+    """Bermudan option under HESTON stochastic volatility: the LSM
+    continuation regression sees BOTH state variables — every monomial of
+    the standardized (spot, variance) pair up to total ``degree`` — so the
+    exercise policy is variance-aware. No tree/PDE oracle exists at this
+    generality; validation (``tests/test_lsm.py``) uses the xi→0 degeneracy
+    (collapses to the CRR-bracketed GBM walk), the CF-oracle European leg
+    off the same paths, and the policy-improvement ordering vs a spot-only
+    regression."""
+    from orp_tpu.sde.kernels import simulate_heston_log
+
+    indices = _validate_kind_indices(kind, indices, n_paths)
+    grid = TimeGrid(T, n_exercise * steps_per_exercise)
+    traj = simulate_heston_log(
+        indices, grid, s0=s0, mu=r, v0=v0, kappa=kappa, theta=theta, xi=xi,
+        rho=rho, seed=seed, scramble=scramble,
+        store_every=steps_per_exercise, dtype=dtype,
+    )
+    s, var = traj["S"][:, 1:], traj["v"][:, 1:]
+    feats = jnp.stack([s, var], axis=-1)  # (n, m, 2)
+    return _lsm_price(feats, s, k, kind, r, T, n_exercise, degree, dtype)
