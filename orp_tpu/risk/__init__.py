@@ -11,6 +11,7 @@ from orp_tpu.risk.analytics import (
     var_by_date,
     var_overall,
 )
+from orp_tpu.risk.asian import asian_call_qmc, geometric_asian_call
 from orp_tpu.risk.greeks import (
     GreeksResult,
     basket_greeks,
@@ -22,9 +23,11 @@ from orp_tpu.risk.surface import implied_vol, price_surface
 __all__ = [
     "FanChart",
     "GreeksResult",
+    "asian_call_qmc",
     "basket_greeks",
     "HedgeReport",
     "european_greeks",
+    "geometric_asian_call",
     "heston_greeks",
     "implied_vol",
     "price_surface",

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from orp_tpu.sde.grid import TimeGrid
-from orp_tpu.sde.kernels import simulate_gbm_log
+from orp_tpu.sde.kernels import simulate_gbm_log, simulate_heston_log
 
 
 def _monomial_exponents(n_features: int, degree: int) -> tuple[tuple[int, ...], ...]:
@@ -205,8 +205,6 @@ def bermudan_lsm_heston(
     (collapses to the CRR-bracketed GBM walk), the CF-oracle European leg
     off the same paths, and the policy-improvement ordering vs a spot-only
     regression."""
-    from orp_tpu.sde.kernels import simulate_heston_log
-
     indices = _validate_kind_indices(kind, indices, n_paths)
     grid = TimeGrid(T, n_exercise * steps_per_exercise)
     traj = simulate_heston_log(

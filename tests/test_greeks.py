@@ -120,6 +120,7 @@ def _cf_fd(name: str, h: float) -> float:
             - price(**{name: base[name] - h})) / (2.0 * h)
 
 
+@pytest.mark.slow
 def test_heston_pathwise_greeks_match_cf_oracle():
     """No closed form exists for Heston variance-dynamics sensitivities; the
     oracle is central FD of the Gil-Pelaez price. 182-step full-truncation

@@ -40,6 +40,7 @@ def test_crr_validation():
         crr_price(36.0, **LS, exercise="asian")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("s0", [36.0, 44.0])
 def test_lsm_put_brackets_crr_bermudan(s0):
     """The LSM policy price is a LOW-biased estimate of the Bermudan value:
@@ -105,6 +106,7 @@ def test_heston_lsm_xi_zero_degenerates_to_crr():
     assert g["price"] > oracle - 0.05
 
 
+@pytest.mark.slow
 def test_heston_lsm_euro_leg_and_premium():
     """No tree oracle exists for the SV walk itself; the European leg off
     the SAME paths must match the characteristic-function put, and the
@@ -124,6 +126,7 @@ def test_heston_lsm_euro_leg_and_premium():
                             kind="chooser")
 
 
+@pytest.mark.slow
 def test_heston_lsm_variance_feature_improves_policy():
     """The 2-feature (S, v) regression is a policy improvement over spot-only
     on the same paths: a better policy can only RAISE the low-biased LSM
