@@ -252,6 +252,26 @@ def main(out_path, only=None):
             "iv_atm_terminal": round(float(iv[-1, 10]), 6),
         }
 
+    def asian():
+        # 1M-path arithmetic-Asian with the geometric CV (risk/asian.py):
+        # the CV leg's closed form is an exact oracle on the chip
+        import time as _t
+
+        from orp_tpu.risk.asian import asian_call_qmc
+
+        def run():
+            t0 = _t.perf_counter()
+            res = asian_call_qmc(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
+                                 seed=1234)
+            return _t.perf_counter() - t0, res
+
+        cold_s, res = run()
+        warm_s, res = run()
+        return {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+                "n_paths": res["n_paths"], "n_avg": res["n_avg"],
+                **{k: round(v, 6) for k, v in res.items()
+                   if isinstance(v, float)}}
+
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
@@ -269,6 +289,7 @@ def main(out_path, only=None):
         ("greeks", greeks),
         ("bermudan", bermudan),
         ("surface", surface),
+        ("asian", asian),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -279,7 +300,7 @@ def main(out_path, only=None):
 
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
                "profile", "paths_sweep", "binomial", "baselines",
-               "pension_walk", "greeks", "bermudan", "surface")
+               "pension_walk", "greeks", "bermudan", "surface", "asian")
 
 
 if __name__ == "__main__":
