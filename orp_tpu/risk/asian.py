@@ -46,6 +46,8 @@ def geometric_asian_call(
     var_w = sum(min(ti, tj) for ti in times for tj in times) / (m * m)
     mu_g = math.log(s0) + (r - 0.5 * sigma * sigma) * tbar
     sd_g = sigma * math.sqrt(var_w)
+    if sd_g == 0.0:  # sigma=0: deterministic average, pure intrinsic
+        return math.exp(-r * T) * max(math.exp(mu_g) - k, 0.0)
     d1 = (mu_g - math.log(k) + sd_g * sd_g) / sd_g
     d2 = d1 - sd_g
     fwd_g = math.exp(mu_g + 0.5 * sd_g * sd_g)

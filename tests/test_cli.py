@@ -93,6 +93,14 @@ def test_surface_json(capsys):
     np.testing.assert_allclose(iv[-1, 1], 0.15, atol=5e-3)
 
 
+def test_asian_json(capsys):
+    cli.main(["asian", "--paths", "16384", "--avg-dates", "13",
+              "--steps-per-avg", "4", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["se"] < out["se_plain"]
+    assert abs(out["geo_sample"] - out["geo_closed"]) < 0.1
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])
