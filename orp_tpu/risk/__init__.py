@@ -12,6 +12,7 @@ from orp_tpu.risk.analytics import (
     var_overall,
 )
 from orp_tpu.risk.asian import asian_call_qmc, geometric_asian_call
+from orp_tpu.risk.barrier import down_and_out_call, down_and_out_call_qmc
 from orp_tpu.risk.greeks import (
     GreeksResult,
     basket_greeks,
@@ -25,6 +26,8 @@ __all__ = [
     "GreeksResult",
     "asian_call_qmc",
     "basket_greeks",
+    "down_and_out_call",
+    "down_and_out_call_qmc",
     "HedgeReport",
     "european_greeks",
     "geometric_asian_call",

@@ -101,6 +101,13 @@ def test_asian_json(capsys):
     assert abs(out["geo_sample"] - out["geo_closed"]) < 0.1
 
 
+def test_barrier_json(capsys):
+    cli.main(["barrier", "--paths", "16384", "--monitor-dates", "13",
+              "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert 0 < out["price"] and 0 < out["knockout_frac"] < 1
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])
