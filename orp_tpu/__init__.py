@@ -10,11 +10,16 @@ Subpackages (layer map mirrors SURVEY.md §1):
 - ``qmc``      L1  scrambled Sobol + Phi^{-1} (pure JAX bit kernels)
 - ``sde``      L2  GBM / CIR-vol / mortality / binomial-population scan kernels
 - ``models``   L4  hedge MLPs (phi, psi heads) as plain pytrees
-- ``train``    L4/L5 losses, LR schedule, early-stopped fit, backward induction
-- ``risk``     L6  VaR / quantile analytics, ledgers, reporting
+- ``train``    L4/L5 losses, LR schedule, early-stopped fit, backward
+               induction; Gauss-Newton/IRLS trainers; Bermudan LSM
+- ``risk``     L6  VaR / quantile analytics, ledgers, reporting; OLS-
+               martingale controls; pathwise-AD greeks; IV surfaces;
+               Asian + barrier pricers
 - ``calib``    side  CIR parameter calibration (OLS closed form)
 - ``parallel``     mesh / sharding / distributed-quantile utilities
 - ``api``      L7  config-driven entry points (``replicating_portfolio`` etc.)
+- ``utils``    oracles (Black-Scholes greeks, Heston CF, CRR tree),
+               checkpointing, profiling, matmul-precision policy
 """
 
 __version__ = "0.1.0"
