@@ -108,6 +108,25 @@ def test_barrier_json(capsys):
     assert 0 < out["price"] and 0 < out["knockout_frac"] < 1
 
 
+def test_sweep_json(capsys):
+    cli.main(["sweep", "--sigmas", "0.1,0.2", "--paths", "256", "--steps",
+              "40", "--rebalance-every", "20", "--epochs-first", "2",
+              "--epochs-warm", "1", "--batch-size", "128", "--json"])
+    rows = json.loads(capsys.readouterr().out.strip())
+    assert [r["sigma"] for r in rows] == [0.1, 0.2]
+    assert all(np.isfinite(r["total"]) for r in rows)
+
+
+def test_basket_json(capsys):
+    cli.main(["basket", "--paths", "512", "--steps", "8",
+              "--rebalance-every", "4", "--s0", "100,100",
+              "--weights", "0.5,0.5", "--sigmas", "0.2,0.15",
+              "--epochs-first", "2", "--epochs-warm", "1",
+              "--batch-size", "256", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert np.isfinite(out["v0_cv"]) and out["oracle_mm"] > 0
+
+
 def test_unknown_command_errors():
     with pytest.raises(SystemExit):
         cli.main(["nope"])
