@@ -62,3 +62,15 @@ def rqmc_stage(paths_log2="20", scrambles="8"):
     return last_json_line(
         ci, ["--paths-log2", paths_log2, "--scrambles", scrambles]
     )
+
+
+def timed_cold_warm(fn):
+    """Run ``fn`` twice and return ``(cold_s, warm_s, last_result)`` — the
+    battery's standard cold-compile/steady-state pair, defined once."""
+    t0 = time.perf_counter()
+    res = fn()
+    cold = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    res = fn()
+    warm = round(time.perf_counter() - t0, 2)
+    return cold, warm, res

@@ -24,7 +24,13 @@ import sys
 HERE = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(HERE))
 
-from tools._measure import Recorder, env_payload, last_json_line, rqmc_stage  # noqa: E402
+from tools._measure import (  # noqa: E402
+    Recorder,
+    env_payload,
+    last_json_line,
+    rqmc_stage,
+    timed_cold_warm,
+)
 
 
 def main(out_path, only=None):
@@ -49,8 +55,6 @@ def main(out_path, only=None):
         # r4: the dual-model walk with BOTH legs on Gauss-Newton (LM-GN mse,
         # IRLS-GN pinball — SCALING.md §3d) at benchmark scale; the wall
         # witnesses the quantile leg's sequential-step collapse on the chip
-        import time as _t
-
         from orp_tpu.api import (EuropeanConfig, SimConfig, TrainConfig,
                                  european_hedge)
 
@@ -62,15 +66,10 @@ def main(out_path, only=None):
             batch_size=(1 << 20) // 64, fused=True, shuffle="blocks",
         )
 
-        def run():
-            t0 = _t.perf_counter()
-            res = european_hedge(euro, sim, train)
-            return _t.perf_counter() - t0, res
-
-        cold_s, res = run()
-        warm_s, res = run()
+        cold_s, warm_s, res = timed_cold_warm(
+            lambda: european_hedge(euro, sim, train))
         return {
-            "cold_s": round(cold_s, 1), "warm_s": round(warm_s, 1),
+            "cold_s": cold_s, "warm_s": warm_s,
             "v0_cv": round(res.report.v0_cv, 5),
             "cv_std": round(res.report.cv_std, 4),
             "var99_overall": round(float(
@@ -134,8 +133,6 @@ def main(out_path, only=None):
         # dates, dual 500/100 Adam) AND the GN-IRLS variant of the same walk;
         # the r2 wall (93-108s cold / 27s warm) predates both TPU numerics
         # fixes (full-f32 matmuls §6b, no-device-log kernels §6d)
-        import time as _t
-
         from orp_tpu.api import HedgeRunConfig, SimConfig, TrainConfig, pension_hedge
 
         sim = SimConfig(n_paths=4096, T=10.0, dt=0.01, rebalance_every=25)
@@ -148,15 +145,10 @@ def main(out_path, only=None):
         ):
             cfg = HedgeRunConfig(sim=sim, train=train)
 
-            def run():
-                t0 = _t.perf_counter()
-                res = pension_hedge(cfg)
-                return _t.perf_counter() - t0, res
-
-            cold_s, res = run()
-            warm_s, res = run()
+            cold_s, warm_s, res = timed_cold_warm(
+                lambda: pension_hedge(cfg))
             out[name] = {
-                "cold_s": round(cold_s, 1), "warm_s": round(warm_s, 1),
+                "cold_s": cold_s, "warm_s": warm_s,
                 "v0": round(float(res.v0), 1),
             }
         return out
@@ -171,14 +163,9 @@ def main(out_path, only=None):
         from orp_tpu.utils.black_scholes import bs_greeks
         from orp_tpu.utils.heston import heston_call
 
-        def run_euro():
-            t0 = _t.perf_counter()
-            g = european_greeks(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
-                                n_steps=52, seed=1234)
-            return _t.perf_counter() - t0, g
-
-        cold_s, g = run_euro()
-        warm_s, g = run_euro()
+        cold_s, warm_s, g = timed_cold_warm(
+            lambda: european_greeks(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
+                                    n_steps=52, seed=1234))
         oracle = bs_greeks(100.0, 100.0, 0.08, 0.15, 1.0)
         t0 = _t.perf_counter()
         h = heston_greeks(1 << 18, 100.0, 100.0, 0.08, 1.0, v0=0.0225,
@@ -188,7 +175,7 @@ def main(out_path, only=None):
         h_oracle = heston_call(100.0, 100.0, 0.08, 1.0, v0=0.0225, kappa=1.5,
                                theta=0.0225, xi=0.25, rho=-0.6)
         return {
-            "euro_1m": {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+            "euro_1m": {"cold_s": cold_s, "warm_s": warm_s,
                         **{k: round(v, 6) for k, v in g.as_dict().items()}},
             "euro_bs_oracle": {k: round(v, 6) for k, v in oracle.items()},
             "heston_262k": {"wall_s": round(heston_s, 2),
@@ -200,22 +187,16 @@ def main(out_path, only=None):
     def bermudan():
         # Sobol-QMC LSM at 1M paths, 50 exercise dates (the LS2001 S0=36
         # put) vs its CRR oracle — the optimal-stopping walk on the chip
-        import time as _t
 
         from orp_tpu.train.lsm import bermudan_lsm
         from orp_tpu.utils.crr import crr_price
 
-        def run():
-            t0 = _t.perf_counter()
-            res = bermudan_lsm(1 << 20, 36.0, 40.0, 0.06, 0.2, 1.0,
-                               n_exercise=50, seed=1234)
-            return _t.perf_counter() - t0, res
-
-        cold_s, res = run()
-        warm_s, res = run()
+        cold_s, warm_s, res = timed_cold_warm(
+            lambda: bermudan_lsm(1 << 20, 36.0, 40.0, 0.06, 0.2, 1.0,
+                                 n_exercise=50, seed=1234))
         oracle = crr_price(36.0, 40.0, 0.06, 0.2, 1.0, exercise="bermudan",
                            n_steps=5000, exercise_every=100)
-        return {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+        return {"cold_s": cold_s, "warm_s": warm_s,
                 "price": round(res["price"], 5), "se": round(res["se"], 5),
                 "crr_oracle": round(oracle, 5),
                 "european": round(res["european"], 5)}
@@ -223,7 +204,6 @@ def main(out_path, only=None):
     def surface():
         # 1M paths x 52 maturities x 21 strikes: the full European IV
         # surface from ONE simulation, Newton-inverted on device
-        import time as _t
 
         import numpy as np
 
@@ -232,19 +212,17 @@ def main(out_path, only=None):
         strikes = [70.0 + 3.0 * i for i in range(21)]
 
         def run():
-            t0 = _t.perf_counter()
             out = price_surface(1 << 20, 100.0, 0.08, 0.15, strikes, 1.0,
                                 n_maturities=52, steps_per_maturity=7,
                                 seed=1234)
             out["iv"].block_until_ready()
-            return _t.perf_counter() - t0, out
+            return out
 
-        cold_s, out = run()
-        warm_s, out = run()
+        cold_s, warm_s, out = timed_cold_warm(run)
         iv = np.asarray(out["iv"])
         finite = np.isfinite(iv)
         return {
-            "cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+            "cold_s": cold_s, "warm_s": warm_s,
             "grid": "52x21", "n_paths": 1 << 20,
             "finite_nodes": int(finite.sum()),
             "iv_max_abs_err_vs_flat": round(
@@ -255,22 +233,36 @@ def main(out_path, only=None):
     def asian():
         # 1M-path arithmetic-Asian with the geometric CV (risk/asian.py):
         # the CV leg's closed form is an exact oracle on the chip
-        import time as _t
 
         from orp_tpu.risk.asian import asian_call_qmc
 
-        def run():
-            t0 = _t.perf_counter()
-            res = asian_call_qmc(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
-                                 seed=1234)
-            return _t.perf_counter() - t0, res
-
-        cold_s, res = run()
-        warm_s, res = run()
-        return {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 2),
+        cold_s, warm_s, res = timed_cold_warm(
+            lambda: asian_call_qmc(1 << 20, 100.0, 100.0, 0.08, 0.15, 1.0,
+                                   seed=1234))
+        return {"cold_s": cold_s, "warm_s": warm_s,
                 "n_paths": res["n_paths"], "n_avg": res["n_avg"],
                 **{k: round(v, 6) for k, v in res.items()
                    if isinstance(v, float)}}
+
+    def barrier():
+        # 1M-path bridge-corrected down-and-out call at a COARSE 13-knot
+        # grid vs the continuous-barrier closed form — the unbiasedness
+        # claim measured on chip
+
+        from orp_tpu.risk.barrier import down_and_out_call, down_and_out_call_qmc
+
+        args = (100.0, 100.0, 90.0, 0.08, 0.25, 1.0)
+
+        cold_s, warm_s, res = timed_cold_warm(
+            lambda: down_and_out_call_qmc(1 << 20, *args, n_monitor=13,
+                                          seed=1234))
+        naive = down_and_out_call_qmc(1 << 20, *args, n_monitor=13,
+                                      bridge=False, seed=1234)
+        return {"cold_s": cold_s, "warm_s": warm_s,
+                "price": round(res["price"], 5), "se": round(res["se"], 5),
+                "oracle": round(down_and_out_call(*args), 5),
+                "naive_price": round(naive["price"], 5),
+                "n_paths": res["n_paths"], "n_monitor": res["n_monitor"]}
 
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
@@ -290,6 +282,7 @@ def main(out_path, only=None):
         ("bermudan", bermudan),
         ("surface", surface),
         ("asian", asian),
+        ("barrier", barrier),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -300,7 +293,8 @@ def main(out_path, only=None):
 
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
                "profile", "paths_sweep", "binomial", "baselines",
-               "pension_walk", "greeks", "bermudan", "surface", "asian")
+               "pension_walk", "greeks", "bermudan", "surface", "asian",
+               "barrier")
 
 
 if __name__ == "__main__":
