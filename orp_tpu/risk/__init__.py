@@ -19,7 +19,7 @@ from orp_tpu.risk.greeks import (
     european_greeks,
     heston_greeks,
 )
-from orp_tpu.risk.surface import implied_vol, price_surface
+from orp_tpu.risk.surface import heston_price_surface, implied_vol, price_surface
 
 __all__ = [
     "FanChart",
@@ -32,6 +32,7 @@ __all__ = [
     "european_greeks",
     "geometric_asian_call",
     "heston_greeks",
+    "heston_price_surface",
     "implied_vol",
     "price_surface",
     "build_report",

@@ -113,3 +113,36 @@ def test_kind_validation():
     with pytest.raises(ValueError):
         price_surface(128, 100.0, 0.05, 0.2, strikes=[100.0], T=1.0,
                       kind="digital")
+    from orp_tpu.risk.surface import heston_price_surface
+
+    with pytest.raises(ValueError):
+        heston_price_surface(128, 100.0, 0.05, strikes=[100.0], T=1.0,
+                             v0=0.04, kappa=1.5, theta=0.04, xi=0.3,
+                             rho=-0.5, kind="digital")
+
+
+@pytest.mark.slow
+def test_heston_surface_skew_and_cf_oracle():
+    """Negative spot-vol correlation must produce a downward smile (steeper
+    short-dated), and the terminal-maturity prices must match the
+    characteristic-function oracle up to Euler bias + QMC noise (measured:
+    ≤1.7 cents at 65k paths, 182 fine steps)."""
+    from orp_tpu.risk.surface import heston_price_surface
+    from orp_tpu.utils.heston import heston_call
+
+    H = dict(v0=0.0225, kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6)
+    strikes = [85.0, 95.0, 100.0, 105.0, 115.0]
+    surf = heston_price_surface(1 << 16, 100.0, 0.08, strikes, 1.0, **H,
+                                n_maturities=13, steps_per_maturity=14,
+                                seed=7)
+    iv = np.asarray(surf["iv"])
+    prices = np.asarray(surf["prices"])
+    # skew: monotone decreasing in strike at every maturity from T/4 out
+    assert (np.diff(iv[3:], axis=1) < 0).all()
+    # short-dated wings steeper than terminal (convexity of the smile term
+    # structure under mean reversion)
+    assert iv[3, 0] - iv[3, -1] > iv[-1, 0] - iv[-1, -1]
+    for j, k in enumerate(strikes):
+        cf = heston_call(100.0, k, 0.08, 1.0, **H)
+        np.testing.assert_allclose(prices[-1, j], cf, atol=0.04,
+                                   err_msg=f"K={k}")
