@@ -46,6 +46,8 @@ def lookback_call_fixed(
         return math.exp(-r * T) * (s0 - k) + lookback_call_fixed(
             s0, s0, r, sigma, T
         )
+    if sigma == 0.0:  # deterministic path: max over [0,T] is s0*e^{rT} (r>0)
+        return math.exp(-r * T) * max(s0 * math.exp(r * T) - k, 0.0)
     sq = sigma * math.sqrt(T)
     d1 = (math.log(s0 / k) + (r + 0.5 * sigma * sigma) * T) / sq
     d2 = d1 - sq
@@ -54,10 +56,16 @@ def lookback_call_fixed(
     #     + (S0/beta) [N(d1) - e^{-rT} (S0/K)^{-beta} N(d1 - beta sq)]
     # (verified against the bridge-max sampler: 16.80 closed vs
     # 16.81 +/- 0.08 QMC at the K=110 config)
+    if beta * sq > 40.0:
+        # sigma -> 0 tail: the Gaussian factor N(d1 - beta*sq) decays like
+        # exp(-(beta*sq)^2/2), crushing the power term that would overflow
+        # a float if evaluated directly — the product is 0 to all precision
+        reflect = 0.0
+    else:
+        reflect = (math.exp(-r * T) * (s0 / k) ** (-beta)
+                   * _N(d1 - beta * sq))
     return (s0 * _N(d1) - k * math.exp(-r * T) * _N(d2)
-            + (s0 / beta) * (_N(d1)
-                             - math.exp(-r * T) * (s0 / k) ** (-beta)
-                             * _N(d1 - beta * sq)))
+            + (s0 / beta) * (_N(d1) - reflect))
 
 
 def lookback_call_qmc(

@@ -264,6 +264,23 @@ def main(out_path, only=None):
                 "naive_price": round(naive["price"], 5),
                 "n_paths": res["n_paths"], "n_monitor": res["n_monitor"]}
 
+    def lookback():
+        # 1M-path exact bridge-max lookback at a coarse 13-knot grid vs the
+        # Conze-Viswanathan closed form, naive knot-max alongside
+        from orp_tpu.risk.lookback import lookback_call_fixed, lookback_call_qmc
+
+        args = (100.0, 110.0, 0.08, 0.25, 1.0)
+        cold_s, warm_s, res = timed_cold_warm(
+            lambda: lookback_call_qmc(1 << 20, *args, n_monitor=13,
+                                      seed=1234))
+        naive = lookback_call_qmc(1 << 20, *args, n_monitor=13,
+                                  bridge=False, seed=1234)
+        return {"cold_s": cold_s, "warm_s": warm_s,
+                "price": round(res["price"], 5), "se": round(res["se"], 5),
+                "oracle": round(lookback_call_fixed(*args), 5),
+                "naive_price": round(naive["price"], 5),
+                "n_paths": res["n_paths"], "n_monitor": res["n_monitor"]}
+
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
@@ -283,6 +300,7 @@ def main(out_path, only=None):
         ("surface", surface),
         ("asian", asian),
         ("barrier", barrier),
+        ("lookback", lookback),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -294,7 +312,7 @@ def main(out_path, only=None):
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
                "profile", "paths_sweep", "binomial", "baselines",
                "pension_walk", "greeks", "bermudan", "surface", "asian",
-               "barrier")
+               "barrier", "lookback")
 
 
 if __name__ == "__main__":
