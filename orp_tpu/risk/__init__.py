@@ -19,7 +19,12 @@ from orp_tpu.risk.greeks import (
     european_greeks,
     heston_greeks,
 )
-from orp_tpu.risk.lookback import lookback_call_fixed, lookback_call_qmc
+from orp_tpu.risk.lookback import (
+    lookback_call_fixed,
+    lookback_call_floating,
+    lookback_call_qmc,
+    lookback_floating_qmc,
+)
 from orp_tpu.risk.surface import heston_price_surface, implied_vol, price_surface
 
 __all__ = [
@@ -36,7 +41,9 @@ __all__ = [
     "heston_price_surface",
     "implied_vol",
     "lookback_call_fixed",
+    "lookback_call_floating",
     "lookback_call_qmc",
+    "lookback_floating_qmc",
     "price_surface",
     "build_report",
     "discounted_payoff_compare",

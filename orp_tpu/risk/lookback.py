@@ -68,25 +68,13 @@ def lookback_call_fixed(
             + (s0 / beta) * (_N(d1) - reflect))
 
 
-def lookback_call_qmc(
-    n_paths: int,
-    s0: float,
-    k: float,
-    r: float,
-    sigma: float,
-    T: float,
-    *,
-    n_monitor: int = 52,
-    steps_per_monitor: int = 1,
-    bridge: bool = True,
-    seed: int = 1234,
-    scramble: str = "owen",
-    indices: jnp.ndarray | None = None,
-    dtype=jnp.float32,
-) -> dict[str, float]:
-    """Fixed-strike lookback call by Sobol-QMC. ``bridge=True`` samples the
-    exact per-interval bridge maximum (unbiased for continuous monitoring);
-    ``bridge=False`` is the naive knot-max, kept to measure its low bias."""
+def _bridge_extreme_knots(
+    n_paths, r, sigma, T, n_monitor, steps_per_monitor, bridge, sign,
+    seed, scramble, indices, dtype,
+):
+    """Shared sampler: (log-knots x (n, m+1), log-extreme x_ext (n,)) where
+    ``sign=+1`` samples the exact per-interval bridge MAXIMUM and ``sign=-1``
+    the minimum (``bridge=False``: the naive knot extreme)."""
     if indices is None:
         indices = jnp.arange(n_paths, dtype=jnp.uint32)
     n_steps = n_monitor * steps_per_monitor
@@ -112,6 +100,7 @@ def lookback_call_qmc(
         1, seed, scramble=scramble, store_every=steps_per_monitor,
         dtype=dtype,
     )  # (n, m+1) incl. t=0
+    extreme = jnp.max if sign > 0 else jnp.min
     if bridge:
         # one extra Sobol dim per monitoring interval, PAST the path dims
         dims = n_steps + jnp.arange(n_monitor, dtype=jnp.uint32)
@@ -120,10 +109,36 @@ def lookback_call_qmc(
         s2 = jnp.asarray(sigma * sigma * (T / n_monitor), dtype)
         d = x[:, 1:] - x[:, :-1]
         m_int = 0.5 * (x[:, :-1] + x[:, 1:]
-                       + jnp.sqrt(d * d - 2.0 * s2 * jnp.log(u)))
-        x_max = jnp.max(m_int, axis=1)
+                       + sign * jnp.sqrt(d * d - 2.0 * s2 * jnp.log(u)))
+        x_ext = extreme(m_int, axis=1)
     else:
-        x_max = jnp.max(x, axis=1)
+        x_ext = extreme(x, axis=1)
+    return x, x_ext
+
+
+def lookback_call_qmc(
+    n_paths: int,
+    s0: float,
+    k: float,
+    r: float,
+    sigma: float,
+    T: float,
+    *,
+    n_monitor: int = 52,
+    steps_per_monitor: int = 1,
+    bridge: bool = True,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jnp.ndarray | None = None,
+    dtype=jnp.float32,
+) -> dict[str, float]:
+    """Fixed-strike lookback call by Sobol-QMC. ``bridge=True`` samples the
+    exact per-interval bridge maximum (unbiased for continuous monitoring);
+    ``bridge=False`` is the naive knot-max, kept to measure its low bias."""
+    _, x_max = _bridge_extreme_knots(
+        n_paths, r, sigma, T, n_monitor, steps_per_monitor, bridge, +1.0,
+        seed, scramble, indices, dtype,
+    )
     s_max = jnp.asarray(s0, dtype) * jnp.exp(x_max)
     v = math.exp(-r * T) * jnp.maximum(s_max - k, 0.0)
     n = v.shape[0]
@@ -131,6 +146,65 @@ def lookback_call_qmc(
         "price": float(jnp.mean(v)),
         "se": float(jnp.std(v)) / math.sqrt(n),
         "mean_smax": float(jnp.mean(s_max)),
+        "n_paths": int(n),
+        "n_monitor": n_monitor,
+    }
+
+
+def lookback_call_floating(
+    s0: float, r: float, sigma: float, T: float
+) -> float:
+    """Continuously-monitored FLOATING-strike lookback call
+    ``S_T - min S`` (Goldman-Sosin-Gatto), min observed from t=0."""
+    if r <= 0.0:
+        raise ValueError("the Goldman-Sosin-Gatto form here assumes r > 0")
+    sq = sigma * math.sqrt(T)
+    if sigma == 0.0:
+        # deterministic path: min is s0 (r>0), payoff s0(e^{rT}-1)
+        return s0 * (1.0 - math.exp(-r * T))
+    a1 = (r + 0.5 * sigma * sigma) * math.sqrt(T) / sigma
+    a2 = a1 - sq
+    beta = 2.0 * r / (sigma * sigma)
+    # C = S0 N(a1) - S0 e^{-rT} N(a2) + (S0/beta)(e^{-rT} N(a2) - N(-a1)):
+    # GSG with m0 = S0, where the reflected-term argument
+    # -a1 + (2r/sigma)sqrt(T) collapses to a2 and (S0/m0)^{-beta} to 1.
+    # The argument SIGN was pinned by the bridge-MIN sampler cross-check
+    # (21.89 closed vs 21.8905 +/- 0.075 QMC) — the same discipline that
+    # caught the fixed-strike exponent error
+    return (s0 * _N(a1) - s0 * math.exp(-r * T) * _N(a2)
+            + (s0 / beta) * (math.exp(-r * T) * _N(a2) - _N(-a1)))
+
+
+def lookback_floating_qmc(
+    n_paths: int,
+    s0: float,
+    r: float,
+    sigma: float,
+    T: float,
+    *,
+    n_monitor: int = 52,
+    steps_per_monitor: int = 1,
+    bridge: bool = True,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jnp.ndarray | None = None,
+    dtype=jnp.float32,
+) -> dict[str, float]:
+    """Floating-strike lookback call ``S_T - min S`` by Sobol-QMC with the
+    exact per-interval bridge MINIMUM (the reflection of the max sampler:
+    ``(x_i + x_{i+1} - sqrt(d^2 - 2 s^2 ln U)) / 2``)."""
+    x, x_min = _bridge_extreme_knots(
+        n_paths, r, sigma, T, n_monitor, steps_per_monitor, bridge, -1.0,
+        seed, scramble, indices, dtype,
+    )
+    s_t = jnp.asarray(s0, dtype) * jnp.exp(x[:, -1])
+    s_min = jnp.asarray(s0, dtype) * jnp.exp(x_min)
+    v = math.exp(-r * T) * (s_t - s_min)  # always >= 0
+    n = v.shape[0]
+    return {
+        "price": float(jnp.mean(v)),
+        "se": float(jnp.std(v)) / math.sqrt(n),
+        "mean_smin": float(jnp.mean(s_min)),
         "n_paths": int(n),
         "n_monitor": n_monitor,
     }
