@@ -16,6 +16,7 @@ from orp_tpu.risk.barrier import down_and_out_call, down_and_out_call_qmc
 from orp_tpu.risk.greeks import (
     GreeksResult,
     basket_greeks,
+    digital_greeks,
     european_greeks,
     heston_greeks,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "GreeksResult",
     "asian_call_qmc",
     "basket_greeks",
+    "digital_greeks",
     "down_and_out_call",
     "down_and_out_call_qmc",
     "HedgeReport",

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import TypedDict
 
 import jax
@@ -191,6 +192,79 @@ def european_greeks(
         },
         n_paths=v.shape[0], n_steps=n_steps,
     )
+
+
+# ---------------------------------------------------------------------------
+# Digital options: likelihood-ratio sensitivities (where pathwise AD fails)
+# ---------------------------------------------------------------------------
+
+
+def digital_greeks(
+    n_paths: int,
+    s0: float,
+    k: float,
+    r: float,
+    sigma: float,
+    T: float,
+    *,
+    kind: str = "call",
+    n_steps: int = 52,
+    seed: int = 1234,
+    scramble: str = "owen",
+    indices: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> dict[str, object]:
+    """Cash-or-nothing digital: price + LIKELIHOOD-RATIO delta/vega.
+
+    The counterpoint to the pathwise estimators above: a digital payoff is
+    an indicator, so the pathwise derivative is a.s. ZERO — IPA is silently
+    wrong, not merely noisy. The likelihood-ratio method differentiates the
+    DENSITY instead: for terminal GBM with ``z = (log(S_T/s0) - (r -
+    sigma^2/2)T) / (sigma sqrt(T))``,
+
+        delta = e^{-rT} E[1_payoff * z / (s0 sigma sqrt(T))]
+        vega  = e^{-rT} E[1_payoff * ((z^2 - 1)/sigma - z sqrt(T))]
+
+    which needs no payoff smoothness at all. Oracles: the closed forms
+    ``e^{-rT} phi(d2)/(s0 sigma sqrt(T))`` and ``-e^{-rT} phi(d2) d1 /
+    sigma`` (``tests/test_greeks.py``). ``z`` comes straight from the
+    scan's accumulated log-return — no device log anywhere (the §6d
+    policy), and no density evaluation on device."""
+    if kind not in ("call", "put"):
+        raise ValueError(f"kind must be 'call' or 'put', got {kind!r}")
+    if indices is None:
+        indices = jnp.arange(n_paths, dtype=jnp.uint32)
+    grid = TimeGrid(T, n_steps)
+    sq = sigma * math.sqrt(T)
+    acc_drift = (r - 0.5 * sigma * sigma) * T
+
+    # the engine's log-return recurrence directly: the accumulator IS the
+    # log-return, so z needs no device log (re-logging s0*exp(acc) would
+    # re-introduce exactly the ulp class SCALING.md §6d eliminated)
+    sdt = jnp.asarray(grid.dt, dtype) ** 0.5
+    c0 = (r - 0.5 * sigma * sigma) * grid.dt
+
+    def step(acc, zz, t, dt):
+        return acc + c0 + sigma * sdt * zz[:, 0]
+
+    acc, _ = scan_sde(
+        step, jnp.zeros(indices.shape, dtype), lambda a: a, indices, grid,
+        1, seed, scramble=scramble, store_every=n_steps, dtype=dtype,
+    )
+    z = (acc - acc_drift) / sq
+    s_t = jnp.asarray(s0, dtype) * jnp.exp(acc)
+    sign = 1.0 if kind == "call" else -1.0
+    hit = (sign * (s_t - k) > 0.0).astype(dtype)
+    disc = jnp.exp(jnp.asarray(-r * T, dtype))
+    price, se_price = _mean_se(disc * hit)
+    delta, se_delta = _mean_se(disc * hit * z / (s0 * sq))
+    vega, se_vega = _mean_se(disc * hit * ((z * z - 1.0) / sigma
+                                           - z * math.sqrt(T)))
+    return {
+        "price": price, "delta": delta, "vega": vega,
+        "se": {"price": se_price, "delta": se_delta, "vega": se_vega},
+        "n_paths": int(hit.shape[0]), "n_steps": n_steps,
+    }
 
 
 # ---------------------------------------------------------------------------
