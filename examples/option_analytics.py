@@ -1,7 +1,7 @@
 """Option analytics beyond the reference: greeks, early exercise, surfaces,
 path-dependent payoffs.
 
-Four capabilities the reference cannot express (its NumPy loops are not
+Five capabilities the reference cannot express (its NumPy loops are not
 differentiable, its walk never exercises, each notebook run prices one
 hard-coded (K, T) point, and it knows only terminal payoffs), each
 validated against an independent oracle:

@@ -87,6 +87,16 @@ def down_and_out_call_qmc(
         # without burning a simulation
         return {"price": 0.0, "se": 0.0, "knockout_frac": 1.0,
                 "n_paths": int(n_paths), "n_monitor": n_monitor}
+    if sigma == 0.0:
+        # Deterministic path s0*e^{rt}: monotone, so the running minimum sits
+        # at an endpoint — no simulation, and no 0/0 in the bridge weight
+        # exponent (which divides by sigma^2 dt).
+        knocked = min(s0, s0 * math.exp(r * T)) <= h
+        price = 0.0 if knocked else (
+            math.exp(-r * T) * max(s0 * math.exp(r * T) - k, 0.0))
+        return {"price": price, "se": 0.0,
+                "knockout_frac": 1.0 if knocked else 0.0,
+                "n_paths": int(n_paths), "n_monitor": n_monitor}
     if indices is None:
         indices = jnp.arange(n_paths, dtype=jnp.uint32)
     grid = TimeGrid(T, n_monitor * steps_per_monitor)

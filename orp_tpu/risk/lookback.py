@@ -56,14 +56,19 @@ def lookback_call_fixed(
     #     + (S0/beta) [N(d1) - e^{-rT} (S0/K)^{-beta} N(d1 - beta sq)]
     # (verified against the bridge-max sampler: 16.80 closed vs
     # 16.81 +/- 0.08 QMC at the K=110 config)
-    if beta * sq > 40.0:
-        # sigma -> 0 tail: the Gaussian factor N(d1 - beta*sq) decays like
-        # exp(-(beta*sq)^2/2), crushing the power term that would overflow
-        # a float if evaluated directly — the product is 0 to all precision
+    nphi = _N(d1 - beta * sq)
+    if beta * sq > 40.0 or nphi == 0.0:
+        # sigma -> 0 and deep-OTM tails: the Gaussian factor N(d1 - beta*sq)
+        # decays like exp(-(d1 - beta*sq)^2/2), crushing the power term —
+        # the product is 0 to all precision while (s0/k)**(-beta) alone
+        # would overflow (beta*ln(k/s0) > 709 is reachable with
+        # beta*sq <= 40, e.g. sigma=0.01, k/s0 > 2.03)
         reflect = 0.0
     else:
-        reflect = (math.exp(-r * T) * (s0 / k) ** (-beta)
-                   * _N(d1 - beta * sq))
+        # log space: exp of the summed exponents instead of the raw power,
+        # so no intermediate overflows for strikes many sigma*sqrt(T) out
+        reflect = math.exp(-r * T - beta * math.log(s0 / k)
+                           + math.log(nphi))
     return (s0 * _N(d1) - k * math.exp(-r * T) * _N(d2)
             + (s0 / beta) * (_N(d1) - reflect))
 

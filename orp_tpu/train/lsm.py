@@ -12,7 +12,9 @@ TPU-first design:
   shapes, no data-dependent control flow): the classical "regress only ITM
   paths" restriction becomes a WEIGHTED normal-equations solve (weight = ITM
   indicator), which keeps every array (n_paths,) and shards over a
-  ``("paths",)`` mesh with two B×B-sized psums per date (B = basis size, 4).
+  ``("paths",)`` mesh with two B×B-sized psums per date (B = basis size:
+  4 for the default spot-only cubic; 10 for the Heston degree-3 basis over
+  (spot, variance)).
 - Paths are scrambled-Sobol from the same L2 kernel as every pricer
   (``simulate_gbm_log``), stored at exercise dates only (``store_every``).
 - The B×B solve runs in full f32 (`precision="highest"`) with a tiny ridge —

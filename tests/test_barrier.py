@@ -66,3 +66,20 @@ def test_closed_form_sigma_zero():
     assert abs(got - want) < 1e-12
     # negative rate decays the path into the barrier -> knocked out
     assert down_and_out_call(100.0, 100.0, 95.0, -0.08, 0.0, 1.0) == 0.0
+
+
+def test_qmc_sigma_zero_no_bridge_division():
+    # sigma=0 short-circuits before the bridge weight's 1/(sigma^2 dt)
+    # exponent — deterministic drifting path, intrinsic if it clears h
+    import math
+
+    res = down_and_out_call_qmc(128, 100.0, 100.0, 90.0, 0.08, 0.0, 1.0)
+    want = math.exp(-0.08) * (100.0 * math.exp(0.08) - 100.0)
+    assert abs(res["price"] - want) < 1e-12
+    assert res["se"] == 0.0 and res["knockout_frac"] == 0.0
+    # negative rate decays the path into the barrier -> knocked out
+    out = down_and_out_call_qmc(128, 100.0, 100.0, 95.0, -0.08, 0.0, 1.0)
+    assert out["price"] == 0.0 and out["knockout_frac"] == 1.0
+    # matches the closed form's own sigma=0 branch at both configs
+    assert res["price"] == down_and_out_call(100.0, 100.0, 90.0, 0.08, 0.0, 1.0)
+    assert out["price"] == down_and_out_call(100.0, 100.0, 95.0, -0.08, 0.0, 1.0)

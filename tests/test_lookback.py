@@ -78,3 +78,17 @@ def test_bridge_grid_invariance():
     coarse = lookback_call_qmc(1 << 15, *ARGS, n_monitor=13, seed=3)
     fine = lookback_call_qmc(1 << 15, *ARGS, n_monitor=104, seed=3)
     assert abs(coarse["price"] - fine["price"]) < 3 * coarse["se"]
+
+
+def test_closed_form_deep_otm_no_overflow():
+    # small sigma makes beta = 2r/sigma^2 huge while beta*sq stays small:
+    # at sigma=0.01, k=2.1*s0, beta*ln(k/s0) ~ 742 > 709 would overflow the
+    # raw power (s0/k)**(-beta); the log-space reflect term must return the
+    # correct (zero-to-precision) price instead of raising OverflowError
+    got = lookback_call_fixed(100.0, 210.0, 0.05, 0.01, 1.0)
+    assert got == 0.0 or 0.0 < got < 1e-200
+    # and a merely-far strike still prices finitely and monotonically
+    near = lookback_call_fixed(100.0, 120.0, 0.05, 0.01, 1.0)
+    far = lookback_call_fixed(100.0, 150.0, 0.05, 0.01, 1.0)
+    assert near >= far >= got >= 0.0
+    assert np.isfinite(near) and np.isfinite(far)

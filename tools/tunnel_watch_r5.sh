@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 revival watcher: probe the axon tunnel every 8 min; when it
+# revives, refresh the STALE on-chip battery rows (VERDICT r4 item 1 — the
+# baselines/paths_sweep/binomial rows were measured PRE-numerics-fix, and
+# rqmc_ci is where the r4 post-fix refresh wedged) plus a fresh north_star
+# and profile under the shipped numerics, into TPU_MEASURE_r5.jsonl.
+#
+# Wedge discipline (SCALING.md §6): the probe is a timeout subprocess so the
+# loop survives a wedged tunnel; each battery invocation is a separate
+# interpreter under a hard `timeout` so a mid-stage wedge kills that group
+# and lets the next group record what it can. No Pallas shape probes here —
+# those can fault the chip and wedge the tunnel (SCALING.md §5).
+cd "$(dirname "$0")/.."
+OUT="${1:-TPU_MEASURE_r5.jsonl}"
+while true; do
+  ALIVE=$(python - <<'PY'
+from _tunnel_probe import probe_device_info
+info = probe_device_info(90)
+print("yes" if info is not None and info["platform"] != "cpu" else "no")
+PY
+  )
+  echo "$(date +%H:%M:%S) tunnel alive: $ALIVE"
+  if [ "$ALIVE" = "yes" ]; then
+    RC=0
+    # group 1: the headline + the stage the r4 refresh died on
+    timeout 5400 python tools/tpu_measure_all.py "$OUT" \
+      --stages north_star,rqmc_ci || RC=$?
+    # group 2: the stale pre-fix rows
+    timeout 5400 python tools/tpu_measure_all.py "$OUT" \
+      --stages baselines,paths_sweep,binomial || RC=$?
+    # group 3: profile (feeds the r5 MFU accounting)
+    timeout 3600 python tools/tpu_measure_all.py "$OUT" \
+      --stages profile || RC=$?
+    echo "$(date +%H:%M:%S) r5 revival battery done rc=$RC"
+    exit $RC
+  fi
+  sleep 480
+done
