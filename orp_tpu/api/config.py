@@ -164,6 +164,13 @@ class HestonConfig:
     xi: float = 0.25
     rho: float = -0.6
     option_type: str = "call"
+    # variance-transition scheme: "qe" (Andersen QE-M, moment-matched per
+    # step + martingale-corrected asset drift — prices within ~1bp directly
+    # on coarse grids) | "euler" (full-truncation, needs a fine dt ladder;
+    # the only scheme the pallas engine implements) | None (engine-aware:
+    # "euler" under engine='pallas', else "qe" — resolved in
+    # api/pipelines.resolve_heston_scheme). VERDICT r4 item 2.
+    scheme: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)

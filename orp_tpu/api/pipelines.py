@@ -51,6 +51,7 @@ from orp_tpu.sde import (
     simulate_gbm_basket,
     simulate_gbm_log,
     simulate_heston_log,
+    simulate_heston_qe,
     simulate_pension,
 )
 from orp_tpu.train.backward import BackwardConfig, BackwardResult, backward_induction
@@ -125,8 +126,27 @@ def _simulate_euro_paths(euro: EuropeanConfig, sim: SimConfig, mesh, grid, name:
     )
 
 
+def resolve_heston_scheme(scheme: str | None, engine: str, name: str = "heston") -> str:
+    """``HestonConfig.scheme=None`` resolves engine-aware: "euler" under the
+    pallas engine (its only scheme — a bare ``engine='pallas'`` invocation
+    predating the scheme field must keep working), else "qe". An EXPLICIT
+    "qe" + pallas is a contradiction and raises."""
+    if scheme is None:
+        return "euler" if engine == "pallas" else "qe"
+    if scheme not in ("qe", "euler"):
+        raise ValueError(f"{name}: unknown HestonConfig.scheme {scheme!r}")
+    if engine == "pallas" and scheme != "euler":
+        raise ValueError(
+            f"{name}: the pallas engine implements the 'euler' scheme "
+            "only; use HestonConfig(scheme='euler') or engine='scan'"
+        )
+    return scheme
+
+
 def _simulate_heston_paths(h: HestonConfig, sim: SimConfig, mesh, grid, name: str):
-    """The heston pipelines' path sim (engine branch shared by hedge + oos)."""
+    """The heston pipelines' path sim (engine x scheme branch shared by
+    hedge + oos)."""
+    scheme = resolve_heston_scheme(h.scheme, sim.engine, name)
     if sim.engine == "pallas":
         _check_pallas(sim, mesh, name)
         return heston_log_pallas(
@@ -136,7 +156,8 @@ def _simulate_heston_paths(h: HestonConfig, sim: SimConfig, mesh, grid, name: st
             block_paths=min(1024, sim.n_paths),
         )
     idx = path_indices(sim.n_paths, mesh)
-    return simulate_heston_log(
+    sim_fn = simulate_heston_qe if scheme == "qe" else simulate_heston_log
+    return sim_fn(
         idx, grid, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa, theta=h.theta,
         xi=h.xi, rho=h.rho, seed=sim.seed_fund,
         scramble=sim.scramble, store_every=sim.rebalance_every,

@@ -182,6 +182,7 @@ def cmd_heston(args):
     h = HestonConfig(
         s0=args.s0, strike=args.strike, r=args.r, v0=args.v0, kappa=args.kappa,
         theta=args.theta, xi=args.xi, rho=args.rho, option_type=args.option_type,
+        scheme=args.scheme,  # None -> engine-aware (resolve_heston_scheme)
     )
     sim = SimConfig(
         n_paths=args.paths, T=args.T, dt=args.T / args.steps,
@@ -449,7 +450,7 @@ def cmd_calibrate(args):
           f"mu={out['mu']:.5f}  sigma0={out['sigma0']:.5f}")
 
 
-def main(argv=None):
+def build_parser():
     p = argparse.ArgumentParser(prog="orp_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
@@ -488,6 +489,10 @@ def main(argv=None):
     ph.add_argument("--option-type", choices=["call", "put"], default="call")
     ph.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
+    ph.add_argument("--scheme", choices=["qe", "euler"], default=None,
+                    help="variance transition: Andersen QE-M (coarse-grid "
+                    "accurate) or full-truncation Euler; default qe for the "
+                    "scan engine, euler for pallas (its only scheme)")
     _add_train_flags(ph)
     _add_oos_flag(ph)
     _add_quantile_flag(ph)
@@ -642,8 +647,11 @@ def main(argv=None):
     pc.add_argument("--years", type=float, default=10.0)
     pc.add_argument("--json", action="store_true")
     pc.set_defaults(fn=cmd_calibrate)
+    return p
 
-    args = p.parse_args(argv)
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
     args.fn(args)
 
 

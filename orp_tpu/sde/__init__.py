@@ -7,6 +7,7 @@ from orp_tpu.sde.kernels import (
     simulate_gbm_basket,
     simulate_gbm_log,
     simulate_heston_log,
+    simulate_heston_qe,
     simulate_pension,
 )
 from orp_tpu.sde import payoffs
@@ -20,6 +21,7 @@ __all__ = [
     "simulate_gbm_basket",
     "simulate_gbm_log",
     "simulate_heston_log",
+    "simulate_heston_qe",
     "simulate_pension",
     "payoffs",
 ]

@@ -452,6 +452,123 @@ def simulate_heston_log(
     return traj
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "grid", "scramble", "store_every", "dtype", "psi_c",
+        # scalar dynamics as STATIC python floats: the QE step constants
+        # (E, c1, c2, K0..K4) are host-f64 transcendentals of the params —
+        # keeping them out of the trace avoids device-f32 constant
+        # evaluation (SCALING.md §6d) at the cost of a retrace per config,
+        # which is how configs are used (frozen dataclasses)
+        "s0", "mu", "v0", "kappa", "theta", "xi", "rho",
+    ),
+)
+def simulate_heston_qe(
+    indices: jax.Array,
+    grid: TimeGrid,
+    *,
+    s0: float,
+    mu: float,
+    v0: float,
+    kappa: float,
+    theta: float,
+    xi: float,
+    rho: float = 0.0,
+    seed: int = 1234,
+    scramble: str = "owen",
+    store_every: int = 1,
+    dtype=jnp.float32,
+    psi_c: float = 1.5,
+) -> dict[str, jax.Array]:
+    """Andersen QE-M Heston: weak-order-matched variance sampling + the
+    martingale-corrected log-asset step (Andersen 2008, §3.2.4 + §4.2-4.3).
+
+    Replaces ``simulate_heston_log``'s full-truncation Euler where step-size
+    bias matters: Euler at 52 coarse steps is several bp off the CF oracle
+    and needs a 7x-finer grid to get close, while QE matches the CONDITIONAL
+    mean and variance of the exact CIR transition per step and so prices
+    within ~1bp directly on the rebalance grid.  The martingale correction
+    (per-path ``K0*``) makes ``E[e^{-mu t} S_t] = s0`` hold exactly in
+    expectation — which the hedged-CV estimator (discounted-S martingale
+    increments, ``api/pipelines.py``) relies on.
+
+    Variance branch per step (psi = s^2/m^2 of the exact CIR transition):
+    quadratic ``a(b+Zv)^2`` for psi <= psi_c, mass-at-zero exponential for
+    psi > psi_c — selected per path with ``jnp.where`` (branchless; both
+    sides are computed with guarded inputs, so no NaN leaks from the
+    inactive branch).  The exponential branch's uniform is the CDF
+    complement ``ndtr(-Zv)`` of the same Sobol normal that feeds the
+    quadratic branch, preserving the pure-(indices, seed) QMC structure.
+
+    No reference analogue (its SV sim is Euler vol-CIR,
+    ``Replicating_Portfolio.py:280-289``); this is the framework's own
+    accuracy standard applied to its Heston leg (VERDICT r4 item 2).
+    """
+    import math as _math
+
+    dt = grid.dt
+    # per-step constants in HOST f64 (never a device transcendental of a
+    # large constant — SCALING.md §6d), cast once at trace time
+    E = _math.exp(-kappa * dt)
+    c1 = xi * xi * E * (1.0 - E) / kappa          # s^2 = c1*v + c2
+    c2 = theta * xi * xi * (1.0 - E) ** 2 / (2.0 * kappa)
+    g1 = g2 = 0.5                                  # central integrated-var weights
+    k1 = g1 * dt * (kappa * rho / xi - 0.5) - rho / xi
+    k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
+    k3 = g1 * dt * (1.0 - rho * rho)
+    k4 = g2 * dt * (1.0 - rho * rho)
+    A = k2 + 0.5 * k4                              # mgf argument of v_next
+    mu_dt = mu * dt
+    tiny = jnp.asarray(1e-12, dtype)
+
+    def step(state, z, t, dt_):
+        logs, v = state
+        zs, zv = z[:, 0], z[:, 1]
+        m = theta + (v - theta) * E               # exact conditional mean
+        s2 = v * c1 + c2                          # exact conditional variance
+        psi = s2 / jnp.maximum(m * m, tiny)
+        # quadratic branch (psi <= psi_c): v' = a (b + Zv)^2
+        invpsi = 2.0 / jnp.maximum(psi, tiny)
+        tq = jnp.maximum(invpsi - 1.0, 0.0)       # >= 1/3 where active
+        b2 = tq + jnp.sqrt(invpsi) * jnp.sqrt(tq)
+        a = m / (1.0 + b2)
+        v_q = a * jnp.square(jnp.sqrt(b2) + zv)
+        # exponential branch (psi > psi_c): P[v'=0] = p, else rate beta
+        p = jnp.clip((psi - 1.0) / (psi + 1.0), 0.0, 1.0 - 1e-6)
+        beta = (1.0 - p) / jnp.maximum(m, tiny)
+        u_comp = jnp.maximum(jax.scipy.special.ndtr(-zv), tiny)  # 1 - U
+        v_e = jnp.where(
+            u_comp >= 1.0 - p, 0.0, jnp.log((1.0 - p) / u_comp) / beta
+        )
+        quad = psi <= psi_c
+        v_next = jnp.where(quad, v_q, v_e)
+        # martingale correction K0* = -ln E[exp(A v')|v] - (k1 + k3/2) v
+        # (Andersen §4.3; closed form per branch, guarded where inactive)
+        den_q = jnp.maximum(1.0 - 2.0 * A * a, 1e-6)
+        ln_m_q = A * b2 * a / den_q - 0.5 * jnp.log(den_q)
+        ln_m_e = jnp.log(
+            jnp.maximum(p + beta * (1.0 - p) / jnp.maximum(beta - A, tiny), tiny)
+        )
+        k0s = -jnp.where(quad, ln_m_q, ln_m_e) - (k1 + 0.5 * k3) * v
+        gauss = jnp.sqrt(jnp.maximum(k3 * v + k4 * v_next, 0.0)) * zs
+        logs = logs + mu_dt + k0s + k1 * v + k2 * v_next + gauss
+        return (logs, v_next)
+
+    n = indices.shape[0]
+    # log-return accumulator: no device log(s0) — SCALING.md §6d
+    state0 = (
+        jnp.zeros((n,), dtype),
+        jnp.full((n,), jnp.asarray(v0, dtype), dtype),
+    )
+    _, traj = scan_sde(
+        step, state0,
+        lambda s: {"S": jnp.asarray(s0, dtype) * jnp.exp(s[0]), "v": s[1]},
+        indices, grid, 2, seed, scramble=scramble, store_every=store_every, dtype=dtype,
+    )
+    return traj
+
+
 # ---------------------------------------------------------------------------
 # Correlated multi-asset GBM basket (BASELINE.json config 5)
 # ---------------------------------------------------------------------------

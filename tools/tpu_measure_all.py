@@ -126,7 +126,8 @@ def main(out_path, only=None):
         from benchmarks import baseline_configs as bc
 
         return {"rows": [bc.config_1_single_step(), bc.config_2_multi_step_100k(),
-                         bc.config_4_heston()]}
+                         # the RQMC price leg has its own stage (heston_qe)
+                         bc.config_4_heston(include_rqmc=False)]}
 
     def pension_walk():
         # the reference Multi config (4,096 paths, dt=1/100, quarterly -> 40
@@ -281,6 +282,21 @@ def main(out_path, only=None):
                 "naive_price": round(naive["price"], 5),
                 "n_paths": res["n_paths"], "n_monitor": res["n_monitor"]}
 
+    def heston_qe():
+        # r5: the Andersen QE-M scheme on chip — RQMC CI vs the CF oracle
+        # (4 scrambles x 262k paths at the shipped 104-step battery grid,
+        # CPU-f32 reference -0.4 +/- 0.7bp) plus the scheme-vs-scheme wall
+        from benchmarks.baseline_configs import heston4_oracle, heston_price_rqmc
+
+        oracle = heston4_oracle()
+        cold_s, warm_s, (mean, se, prices) = timed_cold_warm(
+            lambda: heston_price_rqmc(n_paths=1 << 18, n_scrambles=4))
+        return {"cold_s": cold_s, "warm_s": warm_s,
+                "price_rqmc": round(mean, 5), "oracle_cf": round(oracle, 5),
+                "err_bp": round((mean - oracle) / oracle * 1e4, 2),
+                "se_bp": round(se / oracle * 1e4, 2),
+                "per_scramble": [round(p, 5) for p in prices]}
+
     # value-ordered: the headline wall/accuracy numbers land first so a
     # mid-run tunnel death (SCALING.md §5) still leaves the round's key
     # evidence in the file (all stages here use the scan engine; Pallas
@@ -301,6 +317,7 @@ def main(out_path, only=None):
         ("asian", asian),
         ("barrier", barrier),
         ("lookback", lookback),
+        ("heston_qe", heston_qe),
     ]
     assert [n for n, _ in all_stages] == list(STAGE_NAMES)
     for name, fn in all_stages:
@@ -312,7 +329,7 @@ def main(out_path, only=None):
 STAGE_NAMES = ("north_star", "gn_dual_walk", "gn_oneshot", "rqmc_ci",
                "profile", "paths_sweep", "binomial", "baselines",
                "pension_walk", "greeks", "bermudan", "surface", "asian",
-               "barrier", "lookback")
+               "barrier", "lookback", "heston_qe")
 
 
 if __name__ == "__main__":

@@ -25,9 +25,9 @@ PY
     # group 1: the headline + the stage the r4 refresh died on
     timeout 5400 python tools/tpu_measure_all.py "$OUT" \
       --stages north_star,rqmc_ci || RC=$?
-    # group 2: the stale pre-fix rows
+    # group 2: the stale pre-fix rows + the r5 QE scheme witness
     timeout 5400 python tools/tpu_measure_all.py "$OUT" \
-      --stages baselines,paths_sweep,binomial || RC=$?
+      --stages baselines,paths_sweep,binomial,heston_qe || RC=$?
     # group 3: profile (feeds the r5 MFU accounting)
     timeout 3600 python tools/tpu_measure_all.py "$OUT" \
       --stages profile || RC=$?
