@@ -35,6 +35,62 @@ def _device_alive(timeout_s: int = 150) -> bool:
     return info is not None and info["platform"] != "cpu"
 
 
+def last_tpu_summary(repo=None):
+    """Last-known-good ON-CHIP record from the committed
+    ``TPU_MEASURE_r*.jsonl`` batteries, for embedding in a CPU-fallback
+    artifact: the driver-captured bench must carry hardware witness even
+    when the tunnel is dead at snapshot time (VERDICT r4 weak 3 / item 3).
+
+    Scans rounds newest-first; within a file takes the LAST non-error
+    north_star-family and rqmc_ci-family stage lines (file order follows
+    measurement order, so later lines reflect the shipped numerics — the r4
+    file ends with post-logfix re-runs) and the nearest preceding env line
+    as provenance. Returns None when no on-chip battery exists."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(repo) if repo else pathlib.Path(__file__).resolve().parent
+    files = sorted(root.glob("TPU_MEASURE_r*.jsonl"),
+                   key=lambda p: int(re.search(r"r(\d+)", p.stem).group(1)),
+                   reverse=True)
+    for path in files:
+        env = north = rqmc = None
+        cur_env = None
+        for line in path.read_text().splitlines():
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            stage = d.get("stage", "")
+            if "error" in d:
+                continue
+            if stage.startswith("env") or stage.endswith("_env"):
+                if d.get("platform") not in (None, "cpu"):
+                    cur_env = d
+            elif stage.startswith("north_star") and "cold" in d:
+                north, env = d, cur_env
+            elif stage.startswith("rqmc_ci") and "mean_bp_err" in d:
+                rqmc = d
+        if north is None or env is None:
+            continue
+        out = {
+            "source": path.name,
+            "device": env.get("device"),
+            "measured_at": env.get("time"),
+            "stage": north["stage"],
+            "cold_wall_s": north["cold"].get("wall_s"),
+            "warm_wall_s": north["warm"].get("wall_s"),
+            "acv_bp_err": north["warm"].get("bp_err"),
+            "v0_acv": north["warm"].get("v0_acv"),
+        }
+        if rqmc is not None:
+            out["rqmc_mean_bp"] = rqmc["mean_bp_err"]
+            out["rqmc_se_bp"] = rqmc["se_bp"]
+            out["rqmc_stage"] = rqmc["stage"]
+        return out
+    return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -101,6 +157,11 @@ def main():
     }
     if cpu_fallback:
         record["cpu_fallback"] = True  # NOT a TPU number; tunnel was dead
+        last = last_tpu_summary()
+        if last is not None:
+            # hardware witness: the last committed on-chip battery's
+            # headline, so this artifact still carries a TPU record
+            record["last_tpu"] = last
 
     # second perf axis: the end-to-end north-star hedge (1M paths, 52 weekly
     # dates, v0_cv vs Black-Scholes). Failures degrade to an error note rather
@@ -126,6 +187,9 @@ def main():
             hedge_cv_std=hedge["cv_std"],
             hedge_bs=hedge["bs"],
             hedge_paths=hedge["paths"],
+            # the raw fan-chart number, pinned since r5 (PARITY.md network-
+            # estimator ladder; golden band in test_golden.py)
+            hedge_v0_network=hedge["v0_network"],
         )
     except Exception as e:  # noqa: BLE001
         record.update(hedge_error=f"{type(e).__name__}: {e}")

@@ -120,14 +120,26 @@ def heston_price_rqmc(n_paths=1 << 18, n_scrambles=4, n_steps=104, **dyn):
     grid = TimeGrid(1.0, n_steps)
     idx = jnp.arange(n_paths, dtype=jnp.uint32)
     disc = exp(-r * grid.T)
+    # the exact-mean control rides QE-M's martingale correction, which the
+    # kernel only applies when A = K2 + K4/2 <= 0 (it falls back to plain-QE
+    # drift for strongly positive rho — see simulate_heston_qe). With the
+    # fallback active the control's true mean is O(dt) nonzero and would
+    # SHIFT the estimate by c*E[ctrl] while the scramble CI stayed tight —
+    # so use the raw payoff mean there (honest CI, just wider).
+    dt, rho, xi, kappa = grid.dt, p["rho"], p["xi"], p["kappa"]
+    A = (0.5 * dt * (kappa * rho / xi - 0.5) + rho / xi
+         + 0.25 * dt * (1.0 - rho * rho))
+    use_cv = A <= 0.0
     prices = []
     for seed in range(11, 11 + n_scrambles):
         traj = simulate_heston_qe(idx, grid, seed=seed, store_every=n_steps, **p)
         st = np.asarray(traj["S"][:, -1], np.float64)
         pay = disc * np.maximum(st - 100.0, 0.0)
-        ctrl = disc * st - s0  # exact zero mean under QE-M
-        c = np.cov(pay, ctrl)[0, 1] / np.var(ctrl)
-        prices.append(float((pay - c * ctrl).mean()))
+        if use_cv:
+            ctrl = disc * st - s0  # exact zero mean under QE-M
+            c = np.cov(pay, ctrl)[0, 1] / np.var(ctrl)
+            pay = pay - c * ctrl
+        prices.append(float(pay.mean()))
     arr = np.asarray(prices)
     se = float(arr.std(ddof=1) / np.sqrt(n_scrambles)) if n_scrambles > 1 else 0.0
     return float(arr.mean()), se, prices

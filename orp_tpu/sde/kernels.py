@@ -491,7 +491,12 @@ def simulate_heston_qe(
     within ~1bp directly on the rebalance grid.  The martingale correction
     (per-path ``K0*``) makes ``E[e^{-mu t} S_t] = s0`` hold exactly in
     expectation — which the hedged-CV estimator (discounted-S martingale
-    increments, ``api/pipelines.py``) relies on.
+    increments, ``api/pipelines.py``) relies on.  K0* exists only when
+    ``A = K2 + K4/2 <= 0`` (every ``rho <= 0`` config and mildly positive
+    ones); for strongly positive rho the kernel falls back to plain-QE
+    drift (uncorrected K0) rather than silently clamping a divergent MGF —
+    the fallback is trace-time static and pinned in
+    ``tests/test_heston_qe.py``.
 
     Variance branch per step (psi = s^2/m^2 of the exact CIR transition):
     quadratic ``a(b+Zv)^2`` for psi <= psi_c, mass-at-zero exponential for
@@ -543,14 +548,27 @@ def simulate_heston_qe(
         )
         quad = psi <= psi_c
         v_next = jnp.where(quad, v_q, v_e)
-        # martingale correction K0* = -ln E[exp(A v')|v] - (k1 + k3/2) v
-        # (Andersen §4.3; closed form per branch, guarded where inactive)
-        den_q = jnp.maximum(1.0 - 2.0 * A * a, 1e-6)
-        ln_m_q = A * b2 * a / den_q - 0.5 * jnp.log(den_q)
-        ln_m_e = jnp.log(
-            jnp.maximum(p + beta * (1.0 - p) / jnp.maximum(beta - A, tiny), tiny)
-        )
-        k0s = -jnp.where(quad, ln_m_q, ln_m_e) - (k1 + 0.5 * k3) * v
+        if A <= 0.0:
+            # martingale correction K0* = -ln E[exp(A v')|v] - (k1 + k3/2) v
+            # (Andersen §4.3; closed form per branch). A <= 0 (every
+            # rho <= 0 config, and small-positive-rho ones) guarantees both
+            # MGFs exist: 1 - 2Aa >= 1 and beta - A >= beta > 0, so the
+            # floors below never bind on ACTIVE lanes — they only keep the
+            # inactive branch of the jnp.where NaN-free.
+            den_q = jnp.maximum(1.0 - 2.0 * A * a, 1e-6)
+            ln_m_q = A * b2 * a / den_q - 0.5 * jnp.log(den_q)
+            ln_m_e = jnp.log(jnp.maximum(
+                p + beta * (1.0 - p) / jnp.maximum(beta - A, tiny), tiny))
+            k0s = -jnp.where(quad, ln_m_q, ln_m_e) - (k1 + 0.5 * k3) * v
+        else:
+            # A > 0 (strongly positive rho): the exponential-branch MGF
+            # diverges for lanes with beta <= A, so K0* does not exist —
+            # clamping would SILENTLY bias the drift instead. Fall back to
+            # Andersen's uncorrected K0 = -rho kappa theta dt / xi (§3.2.4,
+            # plain QE): still weak-order matched, only the exact-in-mean
+            # discounted-spot property is lost. A is trace-time static, so
+            # this branch costs nothing where it doesn't apply.
+            k0s = -rho * kappa * theta * dt / xi
         gauss = jnp.sqrt(jnp.maximum(k3 * v + k4 * v_next, 0.0)) * zs
         logs = logs + mu_dt + k0s + k1 * v + k2 * v_next + gauss
         return (logs, v_next)

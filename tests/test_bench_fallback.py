@@ -1,0 +1,57 @@
+"""bench.py CPU-fallback hardware witness (VERDICT r4 item 3).
+
+When the axon tunnel is dead at snapshot time the driver bench records a
+CPU number; ``last_tpu_summary`` must then surface the newest committed
+on-chip battery so the artifact still carries TPU evidence. No JAX needed —
+this is pure JSONL parsing of the round records.
+"""
+
+import json
+
+from bench import last_tpu_summary
+
+
+def test_r4_battery_headline_surfaced():
+    # the committed r4 file ends with the post-logfix re-runs; the summary
+    # must pick THOSE (shipped numerics), not the pre-fix first pass
+    out = last_tpu_summary()
+    assert out is not None
+    assert out["source"].startswith("TPU_MEASURE_r")
+    assert out["device"] and out["measured_at"]
+    # post-logfix north-star: |acv| well under 1bp, warm wall ~11s —
+    # pre-fix passes read -2.8bp, so a loose band still pins the selection
+    assert abs(out["acv_bp_err"]) < 1.0, out
+    assert 0 < out["warm_wall_s"] < 60
+    assert out["cold_wall_s"] >= out["warm_wall_s"]
+    # the rqmc CI rode along (the last non-error rqmc line)
+    assert "rqmc_mean_bp" in out and out["rqmc_se_bp"] > 0
+
+
+def test_round_ordering_and_error_skip(tmp_path):
+    env = {"stage": "env", "platform": "tpu", "device": "v5", "time": "t"}
+    ns = {"stage": "north_star", "cold": {"wall_s": 50.0, "bp_err": -1.0},
+          "warm": {"wall_s": 9.0, "bp_err": -0.1, "v0_acv": 10.39}}
+    bad_rq = {"stage": "rqmc_ci", "error": "transport: tunnel died"}
+    ok_rq = {"stage": "rqmc_ci", "mean_bp_err": 0.2, "se_bp": 0.2}
+    (tmp_path / "TPU_MEASURE_r3.jsonl").write_text("\n".join(
+        json.dumps(d) for d in
+        [env, {**ns, "warm": {**ns["warm"], "wall_s": 99.0}}, ok_rq]))
+    # r10 sorts numerically after r3 (not lexically: "r10" < "r3" as str);
+    # its rqmc line errored, so the summary carries no rqmc fields rather
+    # than silently reaching into the older round
+    (tmp_path / "TPU_MEASURE_r10.jsonl").write_text("\n".join(
+        json.dumps(d) for d in [env, ns, bad_rq]))
+    out = last_tpu_summary(repo=tmp_path)
+    assert out["source"] == "TPU_MEASURE_r10.jsonl"
+    assert out["warm_wall_s"] == 9.0
+    assert "rqmc_mean_bp" not in out
+
+
+def test_cpu_only_battery_yields_none(tmp_path):
+    # a file whose env never saw a non-cpu platform is no hardware witness
+    (tmp_path / "TPU_MEASURE_r1.jsonl").write_text("\n".join([
+        json.dumps({"stage": "env", "platform": "cpu", "time": "t"}),
+        json.dumps({"stage": "north_star", "cold": {}, "warm": {}}),
+    ]))
+    assert last_tpu_summary(repo=tmp_path) is None
+    assert last_tpu_summary(repo=tmp_path / "nowhere") is None

@@ -154,8 +154,7 @@ def test_heston_scheme_flag_and_engine_default(capsys):
     assert resolve_heston_scheme(parser_args.scheme, parser_args.engine) == "euler"
     assert resolve_heston_scheme(None, "scan") == "qe"
     assert resolve_heston_scheme("euler", "scan") == "euler"
-    import pytest as _pytest
-    with _pytest.raises(ValueError):
+    with pytest.raises(ValueError):
         resolve_heston_scheme("qe", "pallas")
-    with _pytest.raises(ValueError):
+    with pytest.raises(ValueError):
         resolve_heston_scheme("milstein", "scan")

@@ -59,16 +59,23 @@ def test_golden_liability_level():
     assert abs(float(s_T.mean()) - 1.923e6) / 1.923e6 < 0.03
 
 
+@functools.lru_cache(maxsize=None)
+def _euro_flagship_run(seed: int):
+    """One Euro#18-20 flagship hedge per seed, memoised: seed 1234 is the
+    reference config (shared by the single-seed pin and the 3-seed VaR
+    mean). Config comes from tools/parity_runs.euro_flagship_cfg — the same
+    definition the measurement tool runs."""
+    from tools.parity_runs import euro_flagship_cfg
+
+    return european_hedge(*euro_flagship_cfg(seed))
+
+
 @pytest.mark.slow
 def test_golden_euro_flagship_hedge():
     # Euro#18/#20(out): V0=11.352 (learned) vs discounted 10.479; phi0=0.10456,
     # psi0=0.89544 — the reference's headline numbers at its exact config
     # (4096 Sobol paths, 52 weekly steps, MSE-only, inputs /S0)
-    res = european_hedge(
-        EuropeanConfig(),
-        SimConfig(n_paths=4096, T=1.0, dt=1 / 364, rebalance_every=7),
-        TrainConfig(dual_mode="mse_only"),
-    )
+    res = _euro_flagship_run(1234)
     assert abs(res.v0 - 11.352) / 11.352 < 0.04, res.v0
     assert abs(res.phi0 - 0.10456) < 0.02, res.phi0
     assert abs(res.psi0 - 0.89544) < 0.02, res.psi0
@@ -84,6 +91,18 @@ def test_golden_euro_flagship_hedge():
     resid_T = np.asarray(res.backward.var_residuals[:, -1]) * 100.0
     assert abs(resid_T.std() - 1.7504) / 1.7504 < 0.15, resid_T.std()
     assert abs(resid_T.mean() - (-0.1675)) < 0.15, resid_T.mean()
+
+
+@pytest.mark.slow
+def test_golden_euro_var99_three_seed_mean():
+    # VERDICT r4 item 4: the +-25% single-seed VaR band above is wide enough
+    # to hide a real quantile-leg regression; the 3-seed MEAN halves it.
+    # Measured (R5_SEED_PINS.jsonl, CPU f32): 3.918 / 3.990 / 4.119 ->
+    # mean 4.009 (-1.0% vs Euro#16's 4.05, seed spread +-2.5%)
+    v99s = [float(_euro_flagship_run(s).report.var_overall[1])
+            for s in (1234, 7, 99)]
+    mean = float(np.mean(v99s))
+    assert abs(mean - 4.05) / 4.05 < 0.125, (v99s, mean)
 
 
 @functools.lru_cache(maxsize=None)
@@ -187,24 +206,50 @@ def test_benchmark_default_matches_measured_row():
     assert (cfg.init_lambda, cfg.lambda_up) == (1e-4, 3.0)
 
 
+@functools.lru_cache(maxsize=None)
+def _sigma_sweep_run(sigma: float, seed: int):
+    """One Multi#28/#30 sweep walk per (sigma, seed), memoised — config
+    from tools/parity_runs.sigma_sweep_cfg, the same definition the
+    measurement tool runs, so pin and measurement can never drift."""
+    from orp_tpu.api import pension_hedge
+    from tools.parity_runs import sigma_sweep_cfg
+
+    res = pension_hedge(sigma_sweep_cfg(sigma, seed))
+    return float(res.phi0 + res.psi0)
+
+
 @pytest.mark.slow
 def test_golden_sigma_sweep_values():
     # Multi#30(out) totals at the as-executed params (mu=0.09464 — cell #9
     # rebound mu before #28 ran): sigma=.15 -> 967,728.6; sigma=.30 ->
     # 1,222,431. Measured r3: -0.6% and -6.7% (PARITY.md) — the high-sigma
     # quantile uplift is the most seed-sensitive statistic in the repo, hence
-    # the asymmetric bands.
-    from orp_tpu.api import replicating_portfolio
-    from tools.parity_runs import MULTI28_PARAMS, REF_SHARED
+    # the asymmetric bands (the 3-seed mean pins below are the tight ones).
+    total15 = _sigma_sweep_run(0.15, 1234)
+    assert abs(total15 - 967_728.6) / 967_728.6 < 0.03, total15
+    total30 = _sigma_sweep_run(0.30, 1234)
+    assert abs(total30 - 1_222_431) / 1_222_431 < 0.10, total30
+    assert total30 > total15  # vol monotonicity (Multi#30 table)
 
-    train = REF_SHARED
-    phi15, psi15 = replicating_portfolio(
-        dict(MULTI28_PARAMS, sigma=0.15), train=train)
-    assert abs((phi15 + psi15) - 967_728.6) / 967_728.6 < 0.03, phi15 + psi15
-    phi30, psi30 = replicating_portfolio(
-        dict(MULTI28_PARAMS, sigma=0.30), train=train)
-    assert abs((phi30 + psi30) - 1_222_431) / 1_222_431 < 0.10, phi30 + psi30
-    assert phi30 + psi30 > phi15 + psi15  # vol monotonicity (Multi#30 table)
+
+@pytest.mark.slow
+def test_golden_sigma_sweep_three_seed_means():
+    # VERDICT r4 item 4: the +-10% sigma=.30 band halved via 3-seed means.
+    # Measured (R5_SEED_PINS.jsonl, CPU f32): sigma=.15 -> 962,291 /
+    # 967,526 / 973,568 (mean +0.01% vs reference, spread +-0.6%);
+    # sigma=.30 -> 1,140,013 / 1,120,586 / 1,151,011 (mean 1,137,203,
+    # -6.97%, spread +-1.3%). The -7% at sigma=.30 is a STABLE offset of
+    # the learned quantile uplift vs the reference's single-seed TF1 row
+    # (its own rerun of sigma=.15 moved -1.4%, Multi#30 vs #26); pin it as
+    # a band around the measured anchor so a drift in either direction
+    # fails, with the loose reference-side band halved to +-9.5..-4.5%.
+    seeds = (1234, 7, 99)
+    mean15 = float(np.mean([_sigma_sweep_run(0.15, s) for s in seeds]))
+    assert abs(mean15 - 967_728.6) / 967_728.6 < 0.015, mean15
+    mean30 = float(np.mean([_sigma_sweep_run(0.30, s) for s in seeds]))
+    rel30 = (mean30 - 1_222_431) / 1_222_431
+    assert -0.095 < rel30 < -0.045, (mean30, rel30)
+    assert abs(mean30 - 1_137_203) / 1_137_203 < 0.025, mean30
 
 
 @pytest.mark.slow
@@ -231,3 +276,66 @@ def test_golden_pension_three_seed_mean():
     v0s = [_pension_shared_run(seed).v0 for seed in (1234, 7, 99)]
     mean = float(np.mean(v0s))
     assert abs(mean - 981_038) / 981_038 < 0.025, (v0s, mean)
+
+
+@functools.lru_cache(maxsize=None)
+def _pension_gn_run(seed: int, hybrid: bool):
+    """The shipped GN dual-walk variants of the Multi#25-26 config, memoised
+    per (seed, quantile-leg choice): hybrid=True is GN-MSE + Adam-quantile
+    (cfg.gn_quantile=False), hybrid=False the full GN-IRLS walk."""
+    import dataclasses
+
+    from orp_tpu.api import pension_hedge
+    from tools.parity_runs import seeds3_gn_cfg
+
+    cfg = seeds3_gn_cfg(seed)
+    if hybrid:
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, gn_quantile=False))
+    return pension_hedge(cfg)
+
+
+@pytest.mark.slow
+def test_golden_pension_gn_hybrid_three_seed_mean():
+    # VERDICT r4 item 4: a 3-seed mean for the GN dual walk, like Adam's.
+    # The hybrid mode (GN on the MSE leg, Adam on the quantile leg) matches
+    # Adam's quality at GN's MSE-leg speed: measured 970,938 / 959,028 /
+    # 962,210 -> mean 964,059 (-1.73% vs Multi#26's 981,038)
+    v0s = [_pension_gn_run(seed, True).v0 for seed in (1234, 7, 99)]
+    mean = float(np.mean(v0s))
+    assert abs(mean - 981_038) / 981_038 < 0.025, (v0s, mean)
+
+
+@pytest.mark.slow
+def test_golden_pension_gn_irls_three_seed_mean():
+    # The FULL GN-IRLS walk (both legs Gauss-Newton) carries a stable -2.8%
+    # V0 offset from the IRLS pinball leg at q=0.99 (~41 exceedances at 4096
+    # paths; more iterations do NOT move it — 60/30, 90/45 and 150/75 all
+    # land -2.9..-3.3% on seed 1234, and weight_floor 1e-2..1e-4 spans
+    # -3.7..-2.9%). Measured (R5_SEED_PINS.jsonl): 948,871 / 951,809 /
+    # 961,143 -> mean 953,941 (-2.76%). Dual pin: a loose band vs the
+    # reference AND a tight band vs the measured anchor, so a regression in
+    # EITHER direction (including "silently improved" numerics changes that
+    # would invalidate the documented offset) trips the test.
+    v0s = [_pension_gn_run(seed, False).v0 for seed in (1234, 7, 99)]
+    mean = float(np.mean(v0s))
+    assert abs(mean - 981_038) / 981_038 < 0.04, (v0s, mean)
+    assert abs(mean - 953_941) / 953_941 < 0.015, (v0s, mean)
+
+
+@pytest.mark.slow
+def test_golden_north_star_network_estimator_band():
+    # VERDICT r4 item 6: the raw network V0 (the fan-chart number) was
+    # measured but never pinned. It is a CONVERGENCE artifact that shrinks
+    # with scale/iterations — measured ladder (PARITY.md): -180bp at this
+    # config (65k, GN 60/30), -107bp at 131k GN 150/75 (CPU), -60bp at 1M
+    # on chip, -2bp at 1M CPU-f32 — always biased LOW, and always two
+    # orders better than the reference's +926bp (Euro#20(out)). The band
+    # pins both the magnitude (within 3.5% of BS) and the direction; the
+    # sub-bp estimators users should quote are v0_acv/v0_cv (pinned
+    # elsewhere at +-1-2bp).
+    from benchmarks.north_star import main as ns
+
+    r = ns(n_paths=1 << 16, gn_iters=(60, 30), quiet=True)
+    rel = (r["v0_network"] - r["bs"]) / r["bs"]
+    assert -0.035 < rel < 0.005, (r["v0_network"], r["bs"], rel)

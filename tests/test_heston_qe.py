@@ -22,11 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from benchmarks.baseline_configs import HESTON4, heston4_oracle
 from orp_tpu.sde import TimeGrid, simulate_heston_qe
 from orp_tpu.utils.heston import heston_call
 
-CFG4 = dict(v0=0.0225, kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6)
-KW4 = dict(s0=100.0, mu=0.08, **CFG4)
+# ONE definition of the battery dynamics (benchmarks.baseline_configs) so a
+# future retune cannot desync the pins from the measurement stages
+KW4 = dict(HESTON4)
+CFG4 = {k: v for k, v in HESTON4.items() if k not in ("s0", "mu")}
 # Feller-violating: 2 kappa theta = 0.04 < xi^2 = 1 -> v hits zero often,
 # exercising the exponential (mass-at-zero) branch
 FELLER_BAD = dict(s0=100.0, mu=0.05, v0=0.04, kappa=0.5, theta=0.04,
@@ -130,9 +133,35 @@ def test_qe_substep_battery_pin():
     -0.4 +/- 0.7 bp."""
     from benchmarks.baseline_configs import heston_price_rqmc
 
-    oracle = heston_call(100.0, 100.0, 0.08, 1.0, **CFG4)
+    oracle = heston4_oracle()
     mean, se, _ = heston_price_rqmc(n_paths=1 << 18, n_scrambles=8,
                                     n_steps=104)
     err_bp = (mean - oracle) / oracle * 1e4
     se_bp = se / oracle * 1e4
     assert abs(err_bp) < 2.0 + 2.0 * se_bp, (mean, oracle, err_bp, se_bp)
+
+
+def test_positive_rho_plain_qe_fallback():
+    # A = K2 + K4/2 > 0 (strongly positive rho): the exponential-branch MGF
+    # of K0* diverges for beta <= A lanes, so the kernel must use plain-QE
+    # drift instead of a clamped correction. Prices stay finite and near
+    # the CF oracle (plain QE's drift bias is O(dt)); the martingale
+    # property is APPROXIMATE here, not exact.
+    kw = dict(s0=100.0, mu=0.05, v0=0.04, kappa=0.5, theta=0.04,
+              xi=0.3, rho=0.8)
+    n = 1 << 16
+    traj = simulate_heston_qe(
+        jnp.arange(n, dtype=jnp.uint32), TimeGrid(1.0, 52), seed=11,
+        store_every=52, **kw)
+    st = np.asarray(traj["S"][:, -1], np.float64)
+    assert np.isfinite(st).all()
+    disc = exp(-0.05)
+    mart = disc * st.mean()
+    assert abs(mart - 100.0) < 1.0, mart  # plain QE: ~O(dt) drift bias
+    pay = disc * np.maximum(st - 100.0, 0.0)
+    ctrl = disc * st - mart  # centre on the SAMPLE mean (not exact here)
+    c = np.cov(pay, ctrl)[0, 1] / np.var(ctrl)
+    price = float((pay - c * ctrl).mean())
+    oracle = heston_call(100.0, 100.0, 0.05, 1.0, **{
+        k: v for k, v in kw.items() if k not in ("s0", "mu")})
+    assert abs(price - oracle) / oracle < 0.02, (price, oracle)

@@ -95,6 +95,53 @@ def seeds3_cfg(seed: int) -> HedgeRunConfig:
     )
 
 
+def euro_flagship_cfg(seed: int = 1234):
+    """Euro#18-20 flagship config (4096 Sobol paths, 52 weekly steps,
+    MSE-only), seeds rebound for multi-seed pins. Seed 1234 IS the
+    reference config. Shared by tools/r5_seed_pins.py and test_golden.py —
+    one definition, so pin and measurement can never drift."""
+    from orp_tpu.api import EuropeanConfig
+
+    return (
+        EuropeanConfig(),
+        SimConfig(n_paths=4096, T=1.0, dt=1 / 364, rebalance_every=7,
+                  seed=seed, seed_fund=seed + 1),
+        TrainConfig(dual_mode="mse_only", seed=seed),
+    )
+
+
+def sigma_sweep_cfg(sigma: float, seed: int = 1234) -> HedgeRunConfig:
+    """Multi#28/#30 sweep walk config at ``sigma``, seeds rebound — shared
+    by the measurement tool and the golden pins (same contract as
+    euro_flagship_cfg)."""
+    import dataclasses
+
+    from orp_tpu.api.pipelines import _cfg_from_params
+
+    cfg = _cfg_from_params(dict(MULTI28_PARAMS, sigma=sigma))
+    return dataclasses.replace(
+        cfg,
+        sim=dataclasses.replace(cfg.sim, seed=seed, seed_fund=seed + 1),
+        train=dataclasses.replace(REF_SHARED, seed=seed),
+    )
+
+
+def seeds3_gn_cfg(seed: int) -> HedgeRunConfig:
+    """The SHIPPED GN-IRLS variant of the Multi#25-26 walk (the 60/30
+    config `tools/tpu_measure_all.py` pension_walk measures), seeds rebound
+    for the 3-seed-mean pin (VERDICT r4 item 4). One definition shared by
+    tool and test, like seeds3_cfg."""
+    import dataclasses
+
+    base = seeds3_cfg(seed)
+    return dataclasses.replace(
+        base, train=dataclasses.replace(
+            base.train, optimizer="gauss_newton",
+            gn_iters_first=60, gn_iters_warm=30,
+        )
+    )
+
+
 def run_single():
     res = pension_hedge(single_step_cfg())
     return {

@@ -200,6 +200,22 @@ def main(n_log2=20):
     jax.block_until_ready(res.values)
     stamps["gn_walk_warm"] = time.perf_counter() - t0
 
+    # achieved-FLOP/s + MFU per phase (VERDICT r4 item 5): analytic useful-
+    # arithmetic counts (orp_tpu/utils/flops.py, XLA-census-validated) over
+    # the measured walls — shapes taken from the very objects timed above
+    # (n_dates from the trajectory, steps from sim, iters from gn_cfg), so
+    # a profile-config change can never desync the FLOP ledger
+    from orp_tpu.utils import flops as F
+
+    stamps["flops_sim"] = F.phase_report(
+        F.sim_flops(n_paths, sim.n_steps), stamps["sim"])
+    stamps["flops_gn_walk"] = F.phase_report(
+        F.gn_walk_flops(n_paths, n_dates, gn_cfg.gn_iters_first,
+                        gn_cfg.gn_iters_warm), stamps["gn_walk_warm"])
+    stamps["flops_adam_walk"] = F.phase_report(
+        F.adam_walk_flops(n_paths, n_dates, train.epochs_first,
+                          train.epochs_warm), stamps["fused_walk_warm"])
+
     stamps = {
         k: round(v, 3) if isinstance(v, float) else v for k, v in stamps.items()
     }

@@ -1,0 +1,79 @@
+"""Round-5 multi-seed measurement battery for the golden-band tightening
+(VERDICT r4 item 4): the numbers behind
+
+  1. the GN-IRLS pension 3-seed mean pin (seeds3_gn_cfg),
+  2. the euro-flagship VaR99 3-seed mean (replacing the +-25% single-seed
+     band),
+  3. the sigma-sweep totals' 3-seed means (replacing the +-10% band at
+     sigma=.30).
+
+Appends one JSON line per run to R5_SEED_PINS.jsonl so a mid-run death
+keeps partial evidence; the derived means land in tests/test_golden.py with
+the measured spreads quoted in the comments.
+
+Usage: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+           python tools/r5_seed_pins.py [out.jsonl]
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+SEEDS = (1234, 7, 99)  # the seeds the Adam 3-seed mean pin already uses
+
+
+def main(out_path):
+    out = pathlib.Path(out_path)
+
+    def emit(row):
+        with out.open("a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+
+    from orp_tpu.api import european_hedge, pension_hedge
+    from tools.parity_runs import (euro_flagship_cfg, seeds3_gn_cfg,
+                                   sigma_sweep_cfg)
+
+    import dataclasses
+
+    for hybrid in (False, True):
+        # hybrid=True: GN on the MSE leg, Adam on the quantile leg
+        # (gn_quantile=False) — the mode whose 3-seed mean meets the +-2.5%
+        # reference band; hybrid=False: the full GN-IRLS walk with its
+        # stable -2.8% IRLS-at-q=.99 offset (both pinned in test_golden.py)
+        name = "pension_gn_hybrid" if hybrid else "pension_gn_irls"
+        for seed in SEEDS:
+            cfg = seeds3_gn_cfg(seed)
+            if hybrid:
+                cfg = dataclasses.replace(cfg, train=dataclasses.replace(
+                    cfg.train, gn_quantile=False))
+            t0 = time.time()
+            res = pension_hedge(cfg)
+            emit({"battery": name, "seed": seed, "v0": res.v0,
+                  "phi0": res.phi0, "psi0": res.psi0,
+                  "ref_v0": 981_038, "wall_s": round(time.time() - t0, 1)})
+
+    for seed in SEEDS:
+        t0 = time.time()
+        res = european_hedge(*euro_flagship_cfg(seed))
+        emit({"battery": "euro_var99", "seed": seed,
+              "var99": float(res.report.var_overall[1]),
+              "var995": float(res.report.var_overall[2]),
+              "v0": res.v0, "ref_var99": 4.05,
+              "wall_s": round(time.time() - t0, 1)})
+
+    for sigma, ref in ((0.15, 967_728.6), (0.30, 1_222_431.0)):
+        for seed in SEEDS:
+            t0 = time.time()
+            res = pension_hedge(sigma_sweep_cfg(sigma, seed))
+            emit({"battery": "sigma_sweep", "sigma": sigma, "seed": seed,
+                  "total": float(res.phi0 + res.psi0), "ref_total": ref,
+                  "wall_s": round(time.time() - t0, 1)})
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else str(HERE / "R5_SEED_PINS.jsonl"))
