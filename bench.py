@@ -50,9 +50,12 @@ def last_tpu_summary(repo=None):
     import re
 
     root = pathlib.Path(repo) if repo else pathlib.Path(__file__).resolve().parent
-    files = sorted(root.glob("TPU_MEASURE_r*.jsonl"),
-                   key=lambda p: int(re.search(r"r(\d+)", p.stem).group(1)),
-                   reverse=True)
+    rounds = []
+    for p in root.glob("TPU_MEASURE_r*.jsonl"):
+        m = re.search(r"r(\d+)", p.stem)
+        if m:  # scratch files like TPU_MEASURE_rerun.jsonl are not rounds
+            rounds.append((int(m.group(1)), p))
+    files = [p for _, p in sorted(rounds, reverse=True)]
     for path in files:
         env = north = rqmc = None
         cur_env = None
@@ -65,12 +68,16 @@ def last_tpu_summary(repo=None):
             if "error" in d:
                 continue
             if stage.startswith("env") or stage.endswith("_env"):
-                if d.get("platform") not in (None, "cpu"):
-                    cur_env = d
+                # a cpu env line INVALIDATES the running provenance: stages
+                # after it were measured off-chip and must not inherit the
+                # earlier TPU device tag
+                cur_env = d if d.get("platform") not in (None, "cpu") else None
             elif stage.startswith("north_star") and "cold" in d:
-                north, env = d, cur_env
+                if cur_env is not None:  # only TPU-witnessed stages count
+                    north, env = d, cur_env
             elif stage.startswith("rqmc_ci") and "mean_bp_err" in d:
-                rqmc = d
+                if cur_env is not None:
+                    rqmc = d
         if north is None or env is None:
             continue
         out = {

@@ -126,10 +126,9 @@ def heston_price_rqmc(n_paths=1 << 18, n_scrambles=4, n_steps=104, **dyn):
     # fallback active the control's true mean is O(dt) nonzero and would
     # SHIFT the estimate by c*E[ctrl] while the scramble CI stayed tight —
     # so use the raw payoff mean there (honest CI, just wider).
-    dt, rho, xi, kappa = grid.dt, p["rho"], p["xi"], p["kappa"]
-    A = (0.5 * dt * (kappa * rho / xi - 0.5) + rho / xi
-         + 0.25 * dt * (1.0 - rho * rho))
-    use_cv = A <= 0.0
+    from orp_tpu.sde.kernels import qe_mgf_argument
+
+    use_cv = qe_mgf_argument(p["kappa"], p["xi"], p["rho"], grid.dt) <= 0.0
     prices = []
     for seed in range(11, 11 + n_scrambles):
         traj = simulate_heston_qe(idx, grid, seed=seed, store_every=n_steps, **p)

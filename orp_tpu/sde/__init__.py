@@ -2,6 +2,7 @@
 
 from orp_tpu.sde.grid import TimeGrid, bond_curve, reduce_grid
 from orp_tpu.sde.kernels import (
+    qe_mgf_argument,
     scan_sde,
     simulate_gbm_arithmetic,
     simulate_gbm_basket,
@@ -15,6 +16,7 @@ from orp_tpu.sde import payoffs
 __all__ = [
     "TimeGrid",
     "bond_curve",
+    "qe_mgf_argument",
     "reduce_grid",
     "scan_sde",
     "simulate_gbm_arithmetic",

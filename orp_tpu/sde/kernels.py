@@ -452,6 +452,22 @@ def simulate_heston_log(
     return traj
 
 
+_QE_G1 = 0.5  # central integrated-variance weights (gamma1 = gamma2)
+
+
+def qe_mgf_argument(kappa: float, xi: float, rho: float, dt: float) -> float:
+    """``A = K2 + K4/2`` — the argument of ``E[exp(A v')]`` inside QE-M's
+    martingale correction. The SINGLE definition of the correction's
+    validity condition (``A <= 0``): ``simulate_heston_qe`` branches on it
+    and estimator-side code (``benchmarks.baseline_configs
+    .heston_price_rqmc``'s exact-mean control gate) must consult the same
+    formula, never a re-derived copy."""
+    g2 = _QE_G1
+    k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
+    k4 = g2 * dt * (1.0 - rho * rho)
+    return k2 + 0.5 * k4
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -518,12 +534,12 @@ def simulate_heston_qe(
     E = _math.exp(-kappa * dt)
     c1 = xi * xi * E * (1.0 - E) / kappa          # s^2 = c1*v + c2
     c2 = theta * xi * xi * (1.0 - E) ** 2 / (2.0 * kappa)
-    g1 = g2 = 0.5                                  # central integrated-var weights
+    g1 = g2 = _QE_G1                               # central integrated-var weights
     k1 = g1 * dt * (kappa * rho / xi - 0.5) - rho / xi
     k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
     k3 = g1 * dt * (1.0 - rho * rho)
     k4 = g2 * dt * (1.0 - rho * rho)
-    A = k2 + 0.5 * k4                              # mgf argument of v_next
+    A = qe_mgf_argument(kappa, xi, rho, dt)        # = k2 + k4/2
     mu_dt = mu * dt
     tiny = jnp.asarray(1e-12, dtype)
 

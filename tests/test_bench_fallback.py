@@ -55,3 +55,26 @@ def test_cpu_only_battery_yields_none(tmp_path):
     ]))
     assert last_tpu_summary(repo=tmp_path) is None
     assert last_tpu_summary(repo=tmp_path / "nowhere") is None
+    # a non-round scratch file matching the glob must not crash the scan
+    (tmp_path / "TPU_MEASURE_rerun.jsonl").write_text("not json\n")
+    assert last_tpu_summary(repo=tmp_path) is None
+
+
+def test_cpu_env_invalidates_provenance(tmp_path):
+    # tunnel dies mid-battery: stages logged AFTER a cpu env line are
+    # off-chip and must neither inherit the earlier TPU device tag nor
+    # clobber the TPU-witnessed rows that preceded them
+    env_tpu = {"stage": "env", "platform": "tpu", "device": "v5", "time": "T1"}
+    env_cpu = {"stage": "env", "platform": "cpu", "time": "T2"}
+    ns = lambda wall: {"stage": "north_star",
+                       "cold": {"wall_s": wall + 40, "bp_err": -1.0},
+                       "warm": {"wall_s": wall, "bp_err": -0.1,
+                                "v0_acv": 10.39}}
+    rq_tpu = {"stage": "rqmc_ci", "mean_bp_err": 0.26, "se_bp": 0.21}
+    rq_cpu = {"stage": "rqmc_ci", "mean_bp_err": 9.99, "se_bp": 9.99}
+    (tmp_path / "TPU_MEASURE_r2.jsonl").write_text("\n".join(
+        json.dumps(d) for d in
+        [env_tpu, ns(9.0), rq_tpu, env_cpu, ns(99.0), rq_cpu]))
+    out = last_tpu_summary(repo=tmp_path)
+    assert out["warm_wall_s"] == 9.0 and out["measured_at"] == "T1"
+    assert out["rqmc_mean_bp"] == 0.26

@@ -158,3 +158,19 @@ def test_heston_scheme_flag_and_engine_default(capsys):
         resolve_heston_scheme("qe", "pallas")
     with pytest.raises(ValueError):
         resolve_heston_scheme("milstein", "scan")
+
+
+def test_lookback_json(capsys):
+    cli.main([
+        "lookback", "--paths", "4096", "--monitor-dates", "13", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert np.isfinite(out["price"]) and out["oracle"] > 0
+    # exact bridge-extreme sampling is unbiased from any grid: 4096 Sobol
+    # paths land within a few SE of the closed form
+    assert abs(out["price"] - out["oracle"]) < 6 * out["se"] + 0.05
+    cli.main([
+        "lookback", "--paths", "4096", "--floating", "--json",
+    ])
+    out_f = json.loads(capsys.readouterr().out.strip())
+    assert abs(out_f["price"] - out_f["oracle"]) < 6 * out_f["se"] + 0.05
