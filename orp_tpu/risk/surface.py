@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 
 from orp_tpu.sde.grid import TimeGrid
-from orp_tpu.sde.kernels import simulate_gbm_log, simulate_heston_log
+from orp_tpu.sde.kernels import (simulate_gbm_log, simulate_heston_log,
+                                 simulate_heston_qe)
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))
@@ -136,6 +137,7 @@ def heston_price_surface(
     scramble: str = "owen",
     indices: jax.Array | None = None,
     with_iv: bool = True,
+    scheme: str = "qe",
     dtype=jnp.float32,
 ) -> dict[str, jax.Array]:
     """The same one-simulation surface under HESTON dynamics: here the
@@ -143,12 +145,19 @@ def heston_price_surface(
     correlation tilts the smile), not a flat line — the surface tool is
     model-free, only the path generator changes. Validated node-by-node
     against the Gil-Pelaez characteristic-function oracle
-    (``tests/test_surface.py``)."""
+    (``tests/test_surface.py``). ``scheme``: "qe" (Andersen QE-M, default
+    since r5 — per-step moment matching removes the Euler fine-step bias
+    at every maturity knot simultaneously) or "euler" (full-truncation)."""
     indices, strikes, grid = _surface_prelude(
         kind, indices, n_paths, strikes, T, n_maturities,
         steps_per_maturity, dtype,
     )
-    traj = simulate_heston_log(
+    sim = {"qe": simulate_heston_qe, "euler": simulate_heston_log}.get(scheme)
+    if sim is None:
+        raise ValueError(
+            f"heston_price_surface: unknown scheme {scheme!r} "
+            "(expected 'qe' or 'euler')")
+    traj = sim(
         indices, grid, s0=s0, mu=r, v0=v0, kappa=kappa, theta=theta, xi=xi,
         rho=rho, seed=seed, scramble=scramble,
         store_every=steps_per_maturity, dtype=dtype,

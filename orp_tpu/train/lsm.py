@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from orp_tpu.sde.grid import TimeGrid
-from orp_tpu.sde.kernels import simulate_gbm_log, simulate_heston_log
+from orp_tpu.sde.kernels import (simulate_gbm_log, simulate_heston_log,
+                                 simulate_heston_qe)
 
 
 def _monomial_exponents(n_features: int, degree: int) -> tuple[tuple[int, ...], ...]:
@@ -197,6 +198,7 @@ def bermudan_lsm_heston(
     seed: int = 1234,
     scramble: str = "owen",
     indices: jax.Array | None = None,
+    scheme: str = "qe",
     dtype=jnp.float32,
 ) -> dict[str, float]:
     """Bermudan option under HESTON stochastic volatility: the LSM
@@ -206,10 +208,17 @@ def bermudan_lsm_heston(
     generality; validation (``tests/test_lsm.py``) uses the xi→0 degeneracy
     (collapses to the CRR-bracketed GBM walk), the CF-oracle European leg
     off the same paths, and the policy-improvement ordering vs a spot-only
-    regression."""
+    regression. ``scheme``: "qe" (Andersen QE-M, default since r5 — the
+    exercise-date marginals are moment-matched without a fine substep
+    ladder) or "euler" (full-truncation)."""
     indices = _validate_kind_indices(kind, indices, n_paths)
     grid = TimeGrid(T, n_exercise * steps_per_exercise)
-    traj = simulate_heston_log(
+    sim = {"qe": simulate_heston_qe, "euler": simulate_heston_log}.get(scheme)
+    if sim is None:
+        raise ValueError(
+            f"bermudan_lsm_heston: unknown scheme {scheme!r} "
+            "(expected 'qe' or 'euler')")
+    traj = sim(
         indices, grid, s0=s0, mu=r, v0=v0, kappa=kappa, theta=theta, xi=xi,
         rho=rho, seed=seed, scramble=scramble,
         store_every=steps_per_exercise, dtype=dtype,

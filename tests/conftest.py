@@ -24,11 +24,22 @@ jax.config.update("jax_enable_x64", True)
 # compiles of the same fused-walk/fit programs (~8-16s each, re-done every
 # run). Separate dir from the benchmark cache (.jax_cache): the test env
 # differs (x64 + virtual 8-device CPU) and mixing would churn both.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+#
+# ORP_TESTS_NO_COMPILE_CACHE=1 disables it (debug knob). Context: XLA
+# reproducibly SEGFAULTS compiling (or cache-serializing) the large
+# fused-GN-walk program after ~260 prior compiles in ONE process (4/4
+# single-process full-suite runs, r5 session; crash position-dependent,
+# every implicated test passes in its tier) — a process-lifetime XLA
+# fault, not a repo bug, and NOT cache-specific (it moved from the
+# serialize path to backend_compile when the cache was off). The per-round
+# gate therefore runs the two tiers as TWO processes (see pytest.ini),
+# each with this cache enabled as usual.
+if not os.environ.get("ORP_TESTS_NO_COMPILE_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -125,8 +125,11 @@ def test_kind_validation():
 def test_heston_surface_skew_and_cf_oracle():
     """Negative spot-vol correlation must produce a downward smile (steeper
     short-dated), and the terminal-maturity prices must match the
-    characteristic-function oracle up to Euler bias + QMC noise (measured:
-    ≤1.7 cents at 65k paths, 182 fine steps)."""
+    characteristic-function oracle up to QMC noise — since r5 the surface
+    runs the QE-M scheme by default, so scheme bias is sub-cent (measured
+    ≤0.5 cents at 52 total steps; 65k-path QMC noise ~2 cents dominates
+    and sets the 4-cent atol; the r4 Euler run at the same grid read
+    ≤1.9 cents of bias)."""
     from orp_tpu.risk.surface import heston_price_surface
     from orp_tpu.utils.heston import heston_call
 
