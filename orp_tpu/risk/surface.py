@@ -28,8 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from orp_tpu.sde.grid import TimeGrid
-from orp_tpu.sde.kernels import (simulate_gbm_log, simulate_heston_log,
-                                 simulate_heston_qe)
+from orp_tpu.sde.kernels import heston_sim_fn, simulate_gbm_log
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))
@@ -152,11 +151,7 @@ def heston_price_surface(
         kind, indices, n_paths, strikes, T, n_maturities,
         steps_per_maturity, dtype,
     )
-    sim = {"qe": simulate_heston_qe, "euler": simulate_heston_log}.get(scheme)
-    if sim is None:
-        raise ValueError(
-            f"heston_price_surface: unknown scheme {scheme!r} "
-            "(expected 'qe' or 'euler')")
+    sim = heston_sim_fn(scheme)
     traj = sim(
         indices, grid, s0=s0, mu=r, v0=v0, kappa=kappa, theta=theta, xi=xi,
         rho=rho, seed=seed, scramble=scramble,

@@ -653,3 +653,18 @@ def simulate_gbm_basket(
         scramble=scramble, store_every=store_every, dtype=dtype,
     )
     return s0 * jnp.exp(traj)
+
+
+def heston_sim_fn(scheme: str):
+    """The ONE scheme-name -> Heston kernel mapping, shared by every
+    scheme-parameterized consumer (``risk/surface.py``, ``train/lsm.py``,
+    ``tools/heston_scheme_ladder.py``) so adding a scheme cannot leave the
+    consumers accepting different sets. (``api/pipelines
+    .resolve_heston_scheme`` layers the engine-aware ``None`` default on
+    top of this for the pipeline configs.)"""
+    try:
+        return {"qe": simulate_heston_qe, "euler": simulate_heston_log}[scheme]
+    except KeyError:
+        raise ValueError(
+            f"unknown Heston scheme {scheme!r} (expected 'qe' or 'euler')"
+        ) from None

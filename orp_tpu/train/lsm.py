@@ -35,8 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from orp_tpu.sde.grid import TimeGrid
-from orp_tpu.sde.kernels import (simulate_gbm_log, simulate_heston_log,
-                                 simulate_heston_qe)
+from orp_tpu.sde.kernels import heston_sim_fn, simulate_gbm_log
 
 
 def _monomial_exponents(n_features: int, degree: int) -> tuple[tuple[int, ...], ...]:
@@ -213,11 +212,7 @@ def bermudan_lsm_heston(
     ladder) or "euler" (full-truncation)."""
     indices = _validate_kind_indices(kind, indices, n_paths)
     grid = TimeGrid(T, n_exercise * steps_per_exercise)
-    sim = {"qe": simulate_heston_qe, "euler": simulate_heston_log}.get(scheme)
-    if sim is None:
-        raise ValueError(
-            f"bermudan_lsm_heston: unknown scheme {scheme!r} "
-            "(expected 'qe' or 'euler')")
+    sim = heston_sim_fn(scheme)
     traj = sim(
         indices, grid, s0=s0, mu=r, v0=v0, kappa=kappa, theta=theta, xi=xi,
         rho=rho, seed=seed, scramble=scramble,

@@ -126,17 +126,17 @@ def test_heston_surface_skew_and_cf_oracle():
     """Negative spot-vol correlation must produce a downward smile (steeper
     short-dated), and the terminal-maturity prices must match the
     characteristic-function oracle up to QMC noise — since r5 the surface
-    runs the QE-M scheme by default, so scheme bias is sub-cent (measured
-    ≤0.5 cents at 52 total steps; 65k-path QMC noise ~2 cents dominates
-    and sets the 4-cent atol; the r4 Euler run at the same grid read
-    ≤1.9 cents of bias)."""
+    runs the QE-M scheme by default on the COARSE grid the PARITY.md row
+    documents (4 substeps/maturity, 52 total: measured ≤0.5 cents of
+    scheme bias, where 182-step Euler read ≤1.9; the 65k-path QMC noise
+    ~2 cents dominates and sets the 4-cent atol)."""
     from orp_tpu.risk.surface import heston_price_surface
     from orp_tpu.utils.heston import heston_call
 
     H = dict(v0=0.0225, kappa=1.5, theta=0.0225, xi=0.25, rho=-0.6)
     strikes = [85.0, 95.0, 100.0, 105.0, 115.0]
     surf = heston_price_surface(1 << 16, 100.0, 0.08, strikes, 1.0, **H,
-                                n_maturities=13, steps_per_maturity=14,
+                                n_maturities=13, steps_per_maturity=4,
                                 seed=7)
     iv = np.asarray(surf["iv"])
     prices = np.asarray(surf["prices"])

@@ -1,0 +1,109 @@
+"""The Heston scheme x step-count bias ladder behind the r5 QE-M claims.
+
+Measures, for each (config, scheme, n_steps) rung, the RQMC price error vs
+the CF oracle: K independent Owen scrambles x ``n_paths`` Sobol paths, with
+the exact-mean discounted-terminal-spot control variate on EVERY rung (both
+QE-M and the log-Euler scheme keep disc*S_T an exact martingale — the
+log-Euler -v/2 drift correction is Jensen-exact per step, so the control is
+valid for both). The scramble-to-scramble spread is the honest QMC error
+bar — the per-run iid-SE formula overestimates for Sobol points (PARITY.md
+r5 Heston row).
+
+Rungs: the HESTON4 battery dynamics (benign: both schemes within ~1.5bp)
+AND the Feller-violating config where the scheme DECIDES the answer.
+Truncates + rewrites the output file (the shipped record must never
+accumulate duplicate rungs across reruns). Shipped ``HESTON_QE_r5.jsonl``
+(16 scrambles x 262k, CPU f32):
+
+    heston4:    euler/52 -0.2bp  euler/364 -0.1bp  qe/52 -1.5bp  qe/104 -0.4bp
+    feller_bad: euler/52 +324bp  euler/364 +35bp   qe/52 -1.3bp
+    (+- 0.7-2.0bp scramble SE each)
+
+Usage: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+           python tools/heston_scheme_ladder.py [out.jsonl] [--scrambles K]
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+RUNGS = (("euler", 52), ("euler", 364), ("qe", 52), ("qe", 104))
+
+# where the scheme choice actually decides the answer: a Feller-violating
+# config (2 kappa theta = 0.04 << xi^2 = 1, v absorbs at 0 on most paths)
+# — full-truncation Euler's reflection bias blows up at coarse steps while
+# QE's mass-at-zero exponential branch samples the transition in law
+FELLER_BAD = dict(s0=100.0, mu=0.05, v0=0.04, kappa=0.5, theta=0.04,
+                  xi=1.0, rho=-0.9)
+
+
+def main(out_path, n_scrambles=16, n_paths=1 << 18):
+    import numpy as np
+
+    from benchmarks.baseline_configs import HESTON4, heston4_oracle
+    from orp_tpu.sde import TimeGrid
+    from orp_tpu.sde.kernels import heston_sim_fn
+    from orp_tpu.utils.heston import heston_call
+
+    oracle = heston4_oracle()
+    out = pathlib.Path(out_path)
+    out.write_text("")  # fresh record; per-rung appends below keep crash
+    # partials without ever accumulating duplicates across reruns
+
+    # euler rungs reuse heston_price_rqmc's estimator shape but with the
+    # Euler kernel; both log-Euler and QE-M keep disc*S_T an exact
+    # martingale (the log-Euler -v/2 correction is Jensen-exact per step),
+    # so the same exact-mean control applies to every rung here
+    import jax.numpy as jnp
+
+    def rung_price(scheme, n_steps, seed, dyn):
+        sim = heston_sim_fn(scheme)
+        grid = TimeGrid(1.0, n_steps)
+        idx = jnp.arange(n_paths, dtype=jnp.uint32)
+        traj = sim(idx, grid, seed=seed, store_every=n_steps, **dyn)
+        st = np.asarray(traj["S"][:, -1], np.float64)
+        disc = np.exp(-dyn["mu"] * grid.T)
+        pay = disc * np.maximum(st - 100.0, 0.0)
+        ctrl = disc * st - dyn["s0"]
+        c = np.cov(pay, ctrl)[0, 1] / np.var(ctrl)
+        return float((pay - c * ctrl).mean())
+
+    fb_oracle = heston_call(100.0, 100.0, FELLER_BAD["mu"], 1.0, **{
+        k: v for k, v in FELLER_BAD.items() if k not in ("s0", "mu")})
+    batteries = (
+        [("heston4", HESTON4, oracle, s, n) for s, n in RUNGS]
+        + [("feller_bad", FELLER_BAD, fb_oracle, s, n)
+           for s, n in (("euler", 52), ("euler", 364), ("qe", 52))]
+    )
+    for config, dyn, orc, scheme, n_steps in batteries:
+        t0 = time.time()
+        prices = [rung_price(scheme, n_steps, seed, dyn)
+                  for seed in range(11, 11 + n_scrambles)]
+        arr = np.asarray(prices)
+        row = {
+            "config": config, "scheme": scheme, "n_steps": n_steps,
+            "n_paths": n_paths, "n_scrambles": n_scrambles,
+            "oracle_cf": round(orc, 5),
+            "mean": round(float(arr.mean()), 5),
+            "err_bp": round(float((arr.mean() - orc) / orc * 1e4), 2),
+            "se_bp": round(float(
+                arr.std(ddof=1) / np.sqrt(n_scrambles) / orc * 1e4), 2),
+            "wall_s": round(time.time() - t0, 1),
+        }
+        with out.open("a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    k = 16
+    if "--scrambles" in argv:
+        i = argv.index("--scrambles")
+        k = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    main(argv[0] if argv else str(HERE / "HESTON_QE_r5.jsonl"), n_scrambles=k)
