@@ -166,10 +166,10 @@ class HestonConfig:
     option_type: str = "call"
     # variance-transition scheme: "qe" (Andersen QE-M, moment-matched per
     # step + martingale-corrected asset drift — prices within ~1bp directly
-    # on coarse grids) | "euler" (full-truncation, needs a fine dt ladder;
-    # the only scheme the pallas engine implements) | None (engine-aware:
-    # "euler" under engine='pallas', else "qe" — resolved in
-    # api/pipelines.resolve_heston_scheme). VERDICT r4 item 2.
+    # on coarse grids) | "euler" (full-truncation, needs a fine dt ladder)
+    # | None (= "qe"). Both schemes run on BOTH engines (scan and pallas —
+    # r5 heston_qe_pallas); resolved in api/pipelines.resolve_heston_scheme.
+    # VERDICT r4 item 2.
     scheme: str | None = None
 
 

@@ -38,7 +38,8 @@ from orp_tpu.api.config import (
     StochVolConfig,
     TrainConfig,
 )
-from orp_tpu.qmc.pallas_mf import heston_log_pallas, pension_pallas
+from orp_tpu.qmc.pallas_mf import (heston_log_pallas, heston_qe_pallas,
+                                   pension_pallas)
 from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.parallel.mesh import path_indices
@@ -127,29 +128,25 @@ def _simulate_euro_paths(euro: EuropeanConfig, sim: SimConfig, mesh, grid, name:
 
 
 def resolve_heston_scheme(scheme: str | None, engine: str, name: str = "heston") -> str:
-    """``HestonConfig.scheme=None`` resolves engine-aware: "euler" under the
-    pallas engine (its only scheme — a bare ``engine='pallas'`` invocation
-    predating the scheme field must keep working), else "qe". An EXPLICIT
-    "qe" + pallas is a contradiction and raises."""
+    """``HestonConfig.scheme=None`` defaults to "qe" (both engines implement
+    both schemes since the r5 ``heston_qe_pallas`` kernel); an explicit
+    scheme must be a known one. ``engine`` stays in the signature for
+    validation symmetry with the pre-r5 engine-aware contract."""
     if scheme is None:
-        return "euler" if engine == "pallas" else "qe"
+        return "qe"
     if scheme not in ("qe", "euler"):
         raise ValueError(f"{name}: unknown HestonConfig.scheme {scheme!r}")
-    if engine == "pallas" and scheme != "euler":
-        raise ValueError(
-            f"{name}: the pallas engine implements the 'euler' scheme "
-            "only; use HestonConfig(scheme='euler') or engine='scan'"
-        )
     return scheme
 
 
 def _simulate_heston_paths(h: HestonConfig, sim: SimConfig, mesh, grid, name: str):
     """The heston pipelines' path sim (engine x scheme branch shared by
-    hedge + oos)."""
+    hedge + oos) — the full 2x2 engine/scheme matrix."""
     scheme = resolve_heston_scheme(h.scheme, sim.engine, name)
     if sim.engine == "pallas":
         _check_pallas(sim, mesh, name)
-        return heston_log_pallas(
+        pallas_fn = heston_qe_pallas if scheme == "qe" else heston_log_pallas
+        return pallas_fn(
             sim.n_paths, sim.n_steps, s0=h.s0, mu=h.r, v0=h.v0, kappa=h.kappa,
             theta=h.theta, xi=h.xi, rho=h.rho, dt=grid.dt, seed=sim.seed_fund,
             store_every=sim.rebalance_every,

@@ -185,7 +185,7 @@ def cmd_heston(args):
     h = HestonConfig(
         s0=args.s0, strike=args.strike, r=args.r, v0=args.v0, kappa=args.kappa,
         theta=args.theta, xi=args.xi, rho=args.rho, option_type=args.option_type,
-        scheme=args.scheme,  # None -> engine-aware (resolve_heston_scheme)
+        scheme=args.scheme,  # None -> "qe" (resolve_heston_scheme)
     )
     sim = SimConfig(
         n_paths=args.paths, T=args.T, dt=args.T / args.steps,
@@ -526,8 +526,8 @@ def build_parser():
                     help="path simulator: XLA scan or fused Pallas kernel")
     ph.add_argument("--scheme", choices=["qe", "euler"], default=None,
                     help="variance transition: Andersen QE-M (coarse-grid "
-                    "accurate) or full-truncation Euler; default qe for the "
-                    "scan engine, euler for pallas (its only scheme)")
+                    "accurate; default) or full-truncation Euler — both "
+                    "available on both engines")
     _add_train_flags(ph)
     _add_oos_flag(ph)
     _add_quantile_flag(ph)

@@ -17,6 +17,7 @@ Sobol integers; tests/test_pallas.py).
 
 Reference semantics carried over (via the scan kernels they mirror):
 - Heston full-truncation Euler        ``sde/kernels.py simulate_heston_log``
+- Heston Andersen QE-M (r5)           ``sde/kernels.py simulate_heston_qe``
 - pension fund arithmetic Euler       ``Replicating_Portfolio.py:60-65``
 - CIR-vol fund (SV mode, dt quirk)    ``Replicating_Portfolio.py:280-289``
 - mortality intensity                 ``Replicating_Portfolio.py:71-76``
@@ -187,6 +188,96 @@ def heston_log_pallas(
         # log-return accumulator (state0 = 0, S = s0*exp): same §6d policy as
         # the scan engine — keeps the s0-proportionality pin engine-universal
         init_vals=(0.0, v0), out_slots=(0, 1), interpret=interpret,
+    )
+    return {"S": jnp.float32(s0) * jnp.exp(logs), "v": v}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_paths", "n_steps", "store_every", "seed", "block_paths", "interpret",
+        "s0", "mu", "v0", "kappa", "theta", "xi", "rho", "dt", "psi_c",
+    ),
+)
+def heston_qe_pallas(
+    n_paths: int,
+    n_steps: int,
+    *,
+    s0: float,
+    mu: float,
+    v0: float,
+    kappa: float,
+    theta: float,
+    xi: float,
+    rho: float,
+    dt: float,
+    seed: int = 1234,
+    store_every: int = 1,
+    block_paths: int = 1024,
+    interpret: bool | None = None,
+    psi_c: float = 1.5,
+) -> dict[str, jax.Array]:
+    """Fused 2-factor Heston under the Andersen QE-M scheme — the Pallas
+    twin of ``sde.kernels.simulate_heston_qe`` (same host-f64 step
+    constants, same branchless quadratic/exponential selection, same
+    martingale correction with the identical ``A <= 0`` validity fallback).
+
+    One deliberate numerical difference: the variance factor is drawn as
+    the RAW scrambled-Sobol uniform (``uniform_factors``) so the
+    exponential branch's complement is the EXACT ``1 - u`` instead of the
+    scan path's f32 ``ndtr(-ndtri(u))`` round trip; the quadratic branch
+    then applies the same AS241 inverse normal in-kernel. Trajectories
+    therefore match the scan kernel to elementwise-f32 tolerance (pinned in
+    ``tests/test_pallas.py``), not bitwise.
+    """
+    from orp_tpu.sde.kernels import qe_step_constants
+
+    # ONE host-f64 derivation shared with the scan twin — the two engines
+    # cannot silently disagree on the transition constants
+    C = qe_step_constants(kappa, theta, xi, rho, dt)
+    E, c1, c2 = C["E"], C["c1"], C["c2"]
+    k1, k2, k3, k4, A = C["k1"], C["k2"], C["k3"], C["k4"], C["A"]
+    mu_dt = mu * dt
+    tiny = 1e-12  # python float: a jnp scalar here would be a captured
+    # constant, which pallas_call refuses
+
+    def step(state, z, t):
+        logs, v = state
+        zs, u = z[0], z[1]                        # normal, raw uniform
+        zv = _ndtri_f32(u)
+        m = theta + (v - theta) * E
+        s2 = v * c1 + c2
+        psi = s2 / jnp.maximum(m * m, tiny)
+        invpsi = 2.0 / jnp.maximum(psi, tiny)
+        tq = jnp.maximum(invpsi - 1.0, 0.0)
+        b2 = tq + jnp.sqrt(invpsi) * jnp.sqrt(tq)
+        a = m / (1.0 + b2)
+        v_q = a * jnp.square(jnp.sqrt(b2) + zv)
+        p = jnp.clip((psi - 1.0) / (psi + 1.0), 0.0, 1.0 - 1e-6)
+        beta = (1.0 - p) / jnp.maximum(m, tiny)
+        u_comp = jnp.maximum(1.0 - u, tiny)       # exact complement
+        v_e = jnp.where(
+            u_comp >= 1.0 - p, 0.0, jnp.log((1.0 - p) / u_comp) / beta
+        )
+        quad = psi <= psi_c
+        v_next = jnp.where(quad, v_q, v_e)
+        if A <= 0.0:
+            den_q = jnp.maximum(1.0 - 2.0 * A * a, 1e-6)
+            ln_m_q = A * b2 * a / den_q - 0.5 * jnp.log(den_q)
+            ln_m_e = jnp.log(jnp.maximum(
+                p + beta * (1.0 - p) / jnp.maximum(beta - A, tiny), tiny))
+            k0s = -jnp.where(quad, ln_m_q, ln_m_e) - (k1 + 0.5 * k3) * v
+        else:
+            k0s = -rho * kappa * theta * dt / xi
+        gauss = jnp.sqrt(jnp.maximum(k3 * v + k4 * v_next, 0.0)) * zs
+        logs = logs + mu_dt + k0s + k1 * v + k2 * v_next + gauss
+        return (logs, v_next)
+
+    logs, v = _run_mf(
+        n_paths, n_steps, store_every=store_every, block_paths=block_paths,
+        seed=seed, n_factors=2, used_factors=(0, 1), step_fn=step,
+        init_vals=(0.0, v0), out_slots=(0, 1), interpret=interpret,
+        uniform_factors=(1,),
     )
     return {"S": jnp.float32(s0) * jnp.exp(logs), "v": v}
 

@@ -455,17 +455,42 @@ def simulate_heston_log(
 _QE_G1 = 0.5  # central integrated-variance weights (gamma1 = gamma2)
 
 
+def qe_step_constants(kappa: float, theta: float, xi: float, rho: float,
+                      dt: float) -> dict[str, float]:
+    """The QE-M per-step constants in HOST f64 — the SINGLE derivation
+    consumed by BOTH the scan kernel (``simulate_heston_qe``) and its
+    Pallas twin (``qmc.pallas_mf.heston_qe_pallas``), so the two engines
+    cannot silently disagree on the transition: ``E`` (mean-reversion
+    factor), ``c1``/``c2`` (conditional variance ``s^2 = c1*v + c2``),
+    ``k1..k4`` (Andersen's integrated-variance drift weights at the
+    central ``_QE_G1`` gammas), and ``A = k2 + k4/2`` (the MGF argument
+    whose sign decides martingale-correction validity)."""
+    import math as _math
+
+    E = _math.exp(-kappa * dt)
+    g1 = g2 = _QE_G1
+    k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
+    k4 = g2 * dt * (1.0 - rho * rho)
+    return {
+        "E": E,
+        "c1": xi * xi * E * (1.0 - E) / kappa,
+        "c2": theta * xi * xi * (1.0 - E) ** 2 / (2.0 * kappa),
+        "k1": g1 * dt * (kappa * rho / xi - 0.5) - rho / xi,
+        "k2": k2,
+        "k3": g1 * dt * (1.0 - rho * rho),
+        "k4": k4,
+        "A": k2 + 0.5 * k4,
+    }
+
+
 def qe_mgf_argument(kappa: float, xi: float, rho: float, dt: float) -> float:
     """``A = K2 + K4/2`` — the argument of ``E[exp(A v')]`` inside QE-M's
     martingale correction. The SINGLE definition of the correction's
     validity condition (``A <= 0``): ``simulate_heston_qe`` branches on it
     and estimator-side code (``benchmarks.baseline_configs
     .heston_price_rqmc``'s exact-mean control gate) must consult the same
-    formula, never a re-derived copy."""
-    g2 = _QE_G1
-    k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
-    k4 = g2 * dt * (1.0 - rho * rho)
-    return k2 + 0.5 * k4
+    formula, never a re-derived copy. (A is theta-free, hence the dummy.)"""
+    return qe_step_constants(kappa, 0.0, xi, rho, dt)["A"]
 
 
 @functools.partial(
@@ -526,20 +551,13 @@ def simulate_heston_qe(
     ``Replicating_Portfolio.py:280-289``); this is the framework's own
     accuracy standard applied to its Heston leg (VERDICT r4 item 2).
     """
-    import math as _math
-
     dt = grid.dt
     # per-step constants in HOST f64 (never a device transcendental of a
-    # large constant — SCALING.md §6d), cast once at trace time
-    E = _math.exp(-kappa * dt)
-    c1 = xi * xi * E * (1.0 - E) / kappa          # s^2 = c1*v + c2
-    c2 = theta * xi * xi * (1.0 - E) ** 2 / (2.0 * kappa)
-    g1 = g2 = _QE_G1                               # central integrated-var weights
-    k1 = g1 * dt * (kappa * rho / xi - 0.5) - rho / xi
-    k2 = g2 * dt * (kappa * rho / xi - 0.5) + rho / xi
-    k3 = g1 * dt * (1.0 - rho * rho)
-    k4 = g2 * dt * (1.0 - rho * rho)
-    A = qe_mgf_argument(kappa, xi, rho, dt)        # = k2 + k4/2
+    # large constant — SCALING.md §6d), cast once at trace time; ONE
+    # derivation shared with the Pallas twin (qe_step_constants)
+    C = qe_step_constants(kappa, theta, xi, rho, dt)
+    E, c1, c2 = C["E"], C["c1"], C["c2"]
+    k1, k2, k3, k4, A = C["k1"], C["k2"], C["k3"], C["k4"], C["A"]
     mu_dt = mu * dt
     tiny = jnp.asarray(1e-12, dtype)
 
@@ -660,8 +678,8 @@ def heston_sim_fn(scheme: str):
     scheme-parameterized consumer (``risk/surface.py``, ``train/lsm.py``,
     ``tools/heston_scheme_ladder.py``) so adding a scheme cannot leave the
     consumers accepting different sets. (``api/pipelines
-    .resolve_heston_scheme`` layers the engine-aware ``None`` default on
-    top of this for the pipeline configs.)"""
+    .resolve_heston_scheme`` layers the ``None``-default on top of this for
+    the pipeline configs.)"""
     try:
         return {"qe": simulate_heston_qe, "euler": simulate_heston_log}[scheme]
     except KeyError:

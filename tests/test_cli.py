@@ -142,20 +142,19 @@ def test_heston_scheme_flag_and_engine_default(capsys):
     ])
     out = json.loads(capsys.readouterr().out.strip())
     assert np.isfinite(out["v0_cv"])
-    # the parser leaves --scheme unset as None; the PIPELINE resolves it
-    # engine-aware (pallas's only scheme is euler, so a bare
-    # `--engine pallas` keeps working; the pallas lowering itself needs a
-    # TPU backend, so the resolution is pinned here rather than end-to-end)
+    # the parser leaves --scheme unset as None; the PIPELINE resolves it to
+    # "qe" on EITHER engine (since r5's heston_qe_pallas the full 2x2
+    # engine/scheme matrix exists; the pallas lowering itself needs a TPU
+    # backend, so the resolution is pinned here rather than end-to-end)
     from orp_tpu.api.pipelines import resolve_heston_scheme
 
     parser_args = cli.build_parser().parse_args(
         ["heston", "--engine", "pallas"])
     assert parser_args.scheme is None
-    assert resolve_heston_scheme(parser_args.scheme, parser_args.engine) == "euler"
+    assert resolve_heston_scheme(parser_args.scheme, parser_args.engine) == "qe"
     assert resolve_heston_scheme(None, "scan") == "qe"
     assert resolve_heston_scheme("euler", "scan") == "euler"
-    with pytest.raises(ValueError):
-        resolve_heston_scheme("qe", "pallas")
+    assert resolve_heston_scheme("qe", "pallas") == "qe"
     with pytest.raises(ValueError):
         resolve_heston_scheme("milstein", "scan")
 

@@ -289,3 +289,59 @@ def test_pallas_gbm_chained_beyond_static_bound(monkeypatch):
     ref = simulate_gbm_log(idx, TimeGrid(1.0, n_steps), 1.0, 0.05, 0.2,
                            seed=7, store_every=2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-5)
+
+
+def test_pallas_heston_qe_matches_xla_scan():
+    # the QE-M twin kernel: identical host-f64 step constants and branch
+    # logic; the variance factor rides the RAW Sobol uniform so the
+    # exponential branch complement is the exact 1-u (the scan path's
+    # ndtr(-ndtri(u)) round trip differs at f32 level) — so agreement is
+    # elementwise-f32, not bitwise
+    from orp_tpu.qmc.pallas_mf import heston_qe_pallas
+    from orp_tpu.sde import simulate_heston_qe
+
+    kw = dict(s0=100.0, mu=0.08, v0=0.0225, kappa=1.5, theta=0.0225,
+              xi=0.25, rho=-0.6)
+    n_paths, n_steps, store = 2048, 16, 4
+    ref = simulate_heston_qe(
+        jnp.arange(n_paths, dtype=jnp.uint32), TimeGrid(1.0, n_steps),
+        seed=1235, store_every=store, **kw)
+    got = heston_qe_pallas(
+        n_paths, n_steps, dt=1.0 / n_steps, seed=1235, store_every=store,
+        block_paths=512, interpret=True, **kw)
+    # measured: S 3.5e-7 max rel, v 1.4e-4 max rel (ndtri-impl delta in the
+    # quadratic branch tail)
+    np.testing.assert_allclose(np.asarray(got["S"]), np.asarray(ref["S"]),
+                               rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(got["v"]), np.asarray(ref["v"]),
+                               rtol=2e-3, atol=1e-6)
+
+
+def test_pallas_heston_qe_exponential_branch_in_law():
+    # Feller-violating config: the mass-at-zero exponential branch fires on
+    # ~3/4 of paths; the pallas and scan kernels must agree in LAW. The
+    # two sides' zero decisions are NOT the same floats (scan compares
+    # ndtr(-ndtri(u)), pallas the exact 1-u, and the thresholds ride
+    # trajectories agreeing to ~1e-3) so the zero-mass fractions can
+    # legitimately differ by a few borderline paths — the pin is a small
+    # tolerance, not exact equality (measured: equal at this seed).
+    from orp_tpu.qmc.pallas_mf import heston_qe_pallas
+    from orp_tpu.sde import simulate_heston_qe
+
+    kw = dict(s0=100.0, mu=0.05, v0=0.04, kappa=0.5, theta=0.04,
+              xi=1.0, rho=-0.9)
+    n = 1 << 14
+    ref = simulate_heston_qe(
+        jnp.arange(n, dtype=jnp.uint32), TimeGrid(1.0, 26),
+        seed=11, store_every=26, **kw)
+    got = heston_qe_pallas(n, 26, dt=1.0 / 26, seed=11, store_every=26,
+                           block_paths=1024, interpret=True, **kw)
+    rv = np.asarray(ref["v"])[:, -1]
+    gv = np.asarray(got["v"])[:, -1]
+    frac_r, frac_g = (rv == 0.0).mean(), (gv == 0.0).mean()
+    assert frac_r > 0.3 and frac_g > 0.3, (frac_r, frac_g)
+    np.testing.assert_allclose(frac_g, frac_r, atol=0.005)
+    np.testing.assert_allclose(gv.mean(), rv.mean(), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(got["S"])[:, -1].mean(),
+        np.asarray(ref["S"])[:, -1].mean(), rtol=1e-4)
