@@ -201,6 +201,36 @@ def main():
     except Exception as e:  # noqa: BLE001
         record.update(hedge_error=f"{type(e).__name__}: {e}")
 
+    # third perf axis: the serving path (orp_tpu/serve) — train a small
+    # European policy, bench the bucketed engine + micro-batcher, and write
+    # the standalone BENCH_serve.json artifact so the bench trajectory
+    # tracks serving alongside sim throughput and the hedge headline.
+    # Failures degrade to an error note rather than sinking the sim metric.
+    try:
+        from orp_tpu.api import (EuropeanConfig, SimConfig, TrainConfig,
+                                 european_hedge)
+        from orp_tpu.serve import serve_bench, write_bench_record
+
+        policy = european_hedge(
+            EuropeanConfig(),
+            SimConfig(n_paths=2048, T=1.0, dt=1 / 52, rebalance_every=4),
+            TrainConfig(dual_mode="mse_only", epochs_first=40, epochs_warm=15),
+        )
+        srec = serve_bench(policy)
+        write_bench_record(
+            srec,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_serve.json"),
+        )
+        record.update(
+            serve_req_per_s=srec["value"],
+            serve_p99_ms=srec["p99_ms"],
+            serve_rows_per_s=srec["rows_per_s"],
+            serve_cache_hit_rate=srec["cache_hit_rate"],
+        )
+    except Exception as e:  # noqa: BLE001
+        record.update(serve_error=f"{type(e).__name__}: {e}"[:200])
+
     # measured error bar for the price (tools/rqmc_ci.py): mean +/- SE over
     # independent Owen scrambles — makes the record defensible even when the
     # single-seed hedge draw above lands outside +/-1bp

@@ -198,6 +198,39 @@ def _check_oos_args(name, trained, seed, train, allow_in_sample,
         )
 
 
+def _check_policy_compat(name, trained, model, n_dates):
+    """Up-front shape guard for the *_oos pipelines: the trained per-date
+    params (in-memory result OR loaded serve bundle) must be exactly what
+    ``model`` over ``n_dates`` dates implies — a clean error naming both
+    signatures, raised BEFORE the replay instead of a shape error inside it.
+
+    Returns the model the replay must use: the TRAINED one when the result
+    carries it — shape-invariant architecture fields (leaky-ReLU slope,
+    init_scale, dtype) are properties of the policy, not of the evaluation
+    config, and the guard above can only see shapes — else ``model``."""
+    from orp_tpu.utils.fingerprint import verify_policy_compat
+
+    params = trained.backward.params1_by_date
+    if params is None:
+        raise ValueError(
+            f"{name}: trained result has no per-date params "
+            "(params1_by_date is None) — it cannot be replayed"
+        )
+    verify_policy_compat(name, model, n_dates, params)
+    trained_model = getattr(trained, "model", None)
+    return model if trained_model is None else trained_model
+
+
+def _maybe_export(result: "PipelineResult", export_dir) -> "PipelineResult":
+    """Shared ``export_dir`` hook: persist the trained policy as a serve
+    bundle right after training (orp_tpu/serve/bundle.py)."""
+    if export_dir is not None:
+        from orp_tpu.serve.bundle import export_bundle
+
+        export_bundle(result, export_dir)
+    return result
+
+
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
     return BackwardConfig(
         epochs_first=t.epochs_first,
@@ -241,6 +274,9 @@ class PipelineResult:
     holdings_combine: str | None = None
     cost_of_capital: float | None = None  # enters the replayed value/holdings
     # combine (_date_outputs_core) exactly like dual_mode — *_oos checks it too
+    model: HedgeMLP | None = None   # the hedge net this run trained/replayed —
+    # what a serve bundle must reconstruct at load (serve/bundle.py); every
+    # pipeline sets it
 
     @property
     def v0(self) -> float:
@@ -267,6 +303,7 @@ def european_hedge(
     *,
     mesh=None,
     quantile_method: str = "sort",
+    export_dir: str | None = None,
 ) -> PipelineResult:
     """Weekly-rebalanced European option hedge (``European Options.ipynb``).
 
@@ -314,11 +351,15 @@ def european_hedge(
     )
     _attach_cv_price(report, res, s, payoff, euro.r, times,
                      strike_over_s0=euro.strike / euro.s0)
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                           sim_seed=sim.seed_fund,
-                           dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+    return _maybe_export(
+        PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                       sim_seed=sim.seed_fund,
+                       dual_mode=train.dual_mode,
+                       holdings_combine=train.holdings_combine,
+                       cost_of_capital=train.cost_of_capital,
+                       model=model),
+        export_dir,
+    )
 
 
 def european_oos(
@@ -343,11 +384,22 @@ def european_oos(
     result would be the in-sample ledgers relabeled as OOS. No reference
     analogue: the reference's ledgers are all in-sample (RP.py:224 reuses
     the training ``X0``). See ``orp_tpu/train/replay.py``.
+
+    ``trained`` may also be a loaded serve bundle
+    (``orp_tpu.serve.load_bundle``) — a bundle carries the same per-date
+    params and combine-semantics fields as an in-memory result, so a policy
+    exported on one box evaluates out-of-sample on another (every ``*_oos``
+    entry point accepts either).
     """
     from orp_tpu.train.replay import replay_walk
 
     _check_quantile_method(quantile_method)
     _check_oos_args("european_oos", trained, sim.seed_fund, train, allow_in_sample)
+    model = HedgeMLP(n_features=1, constrain_self_financing=euro.constrain_self_financing)
+    # policy/config shape compatibility BEFORE the path sim: a mismatched
+    # head or date count fails here with both signatures named, not as a
+    # shape error inside the replayed forward after the sim spend
+    model = _check_policy_compat("european_oos", trained, model, sim.n_rebalance)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     # the helper honours the training engine: pallas and scan agree only to
@@ -357,7 +409,6 @@ def european_oos(
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
     s0 = euro.s0
-    model = HedgeMLP(n_features=1, constrain_self_financing=euro.constrain_self_financing)
 
     res = replay_walk(
         model,
@@ -384,7 +435,8 @@ def european_oos(
                            sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
                            holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+                           cost_of_capital=train.cost_of_capital,
+                           model=model)
 
 
 def heston_hedge(
@@ -394,6 +446,7 @@ def heston_hedge(
     *,
     mesh=None,
     quantile_method: str = "sort",
+    export_dir: str | None = None,
 ) -> PipelineResult:
     """European hedge under risk-neutral Heston stochastic vol (BASELINE.json
     config 4). The hedge net sees features ``(S_t/S0, v_t)`` — the variance
@@ -427,11 +480,15 @@ def heston_hedge(
     )
     _attach_cv_price(report, res, s, payoff, h.r, times,
                      strike_over_s0=h.strike / h.s0)
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                           sim_seed=sim.seed_fund,
-                           dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+    return _maybe_export(
+        PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
+                       sim_seed=sim.seed_fund,
+                       dual_mode=train.dual_mode,
+                       holdings_combine=train.holdings_combine,
+                       cost_of_capital=train.cost_of_capital,
+                       model=model),
+        export_dir,
+    )
 
 
 def heston_oos(
@@ -451,6 +508,8 @@ def heston_oos(
     _check_quantile_method(quantile_method)
     _check_oos_args("heston_oos", trained, sim.seed_fund, train, allow_in_sample)
     h = heston or HestonConfig()
+    model = HedgeMLP(n_features=2)
+    model = _check_policy_compat("heston_oos", trained, model, sim.n_rebalance)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     traj = _simulate_heston_paths(h, sim, mesh, grid, "heston_oos")
@@ -459,7 +518,6 @@ def heston_oos(
     b = bond_curve(coarse, h.r, dtype)
     payoff = payoffs.european(s[:, -1], h.strike, h.option_type)
     s0 = h.s0
-    model = HedgeMLP(n_features=2)
     res = replay_walk(
         model, trained.backward, jnp.stack([s / s0, v], axis=-1),
         s / s0, b / s0, payoff / s0, _backward_cfg(train),
@@ -476,7 +534,8 @@ def heston_oos(
                           sim_seed=sim.seed_fund,
                            dual_mode=train.dual_mode,
                            holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+                           cost_of_capital=train.cost_of_capital,
+                           model=model)
 
 
 
@@ -559,6 +618,7 @@ def basket_hedge(
     mesh=None,
     quantile_method: str = "sort",
     instruments: str = "basket",
+    export_dir: str | None = None,
 ) -> PipelineResult:
     """A-asset basket-call hedge (BASELINE.json config 5; no reference
     analogue — the multi-asset extension of ``European Options.ipynb``).
@@ -605,11 +665,15 @@ def basket_hedge(
         basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
         quantile_method,
     )
-    return PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
-                           sim_seed=sim.seed_fund,
-                           dual_mode=train.dual_mode,
-                           holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+    return _maybe_export(
+        PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
+                       sim_seed=sim.seed_fund,
+                       dual_mode=train.dual_mode,
+                       holdings_combine=train.holdings_combine,
+                       cost_of_capital=train.cost_of_capital,
+                       model=model),
+        export_dir,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -676,6 +740,10 @@ def basket_oos(
     _check_oos_args("basket_oos", trained, sim.seed_fund, train, allow_in_sample)
     (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
      hedge_prices) = _basket_setup(basket, sim, mesh, instruments, "basket_oos")
+    # (the basket model head depends on the instruments mode resolved inside
+    # _basket_setup, so the guard runs after the sim here — still before the
+    # replay's opaque shape error)
+    model = _check_policy_compat("basket_oos", trained, model, sim.n_rebalance)
     res = replay_walk(
         model, trained.backward, s / jnp.asarray(basket.s0, dtype),
         hedge_prices, b / norm, payoff / norm, _backward_cfg(train),
@@ -688,11 +756,13 @@ def basket_oos(
                           adjustment_factor=norm, sim_seed=sim.seed_fund,
                           dual_mode=train.dual_mode,
                           holdings_combine=train.holdings_combine,
-                           cost_of_capital=train.cost_of_capital)
+                          cost_of_capital=train.cost_of_capital,
+                          model=model)
 
 
 def pension_hedge(
-    cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None, quantile_method: str = "sort"
+    cfg: HedgeRunConfig = HedgeRunConfig(), *, mesh=None,
+    quantile_method: str = "sort", export_dir: str | None = None,
 ) -> PipelineResult:
     """Dynamic pension-liability hedge (``Replicating_Portfolio.py:29-235``; SV
     variant per ``:237-459`` when ``cfg.sv`` is set).
@@ -734,11 +804,15 @@ def pension_hedge(
         adjustment_factor=adjustment,
         quantile_method=quantile_method,
     )
-    return PipelineResult(
-        report=report, backward=res, times=times, adjustment_factor=adjustment,
-        sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
-        holdings_combine=cfg.train.holdings_combine,
-        cost_of_capital=cfg.train.cost_of_capital,
+    return _maybe_export(
+        PipelineResult(
+            report=report, backward=res, times=times, adjustment_factor=adjustment,
+            sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
+            holdings_combine=cfg.train.holdings_combine,
+            cost_of_capital=cfg.train.cost_of_capital,
+            model=model,
+        ),
+        export_dir,
     )
 
 
@@ -765,6 +839,8 @@ def pension_oos(
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
     _check_oos_args("pension_oos", trained, s.seed, cfg.train,
                     allow_in_sample, seed_field="seed")
+    model = HedgeMLP(n_features=3)
+    model = _check_policy_compat("pension_oos", trained, model, s.n_rebalance)
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
     traj = _simulate_pension_paths(cfg, mesh, grid, "pension_oos")
@@ -774,7 +850,6 @@ def pension_oos(
     pop_n = pop / a.n0
     payoff_y = payoffs.pension_floor(y[:, -1], a.guarantee)
     terminal = payoff_y * pop_n[:, -1]
-    model = HedgeMLP(n_features=3)
     res = replay_walk(
         model, trained.backward, jnp.stack([y, pop_n, lam], axis=-1),
         y, b, terminal, _backward_cfg(cfg.train),
@@ -790,6 +865,7 @@ def pension_oos(
         sim_seed=s.seed, dual_mode=cfg.train.dual_mode,
         holdings_combine=cfg.train.holdings_combine,
         cost_of_capital=cfg.train.cost_of_capital,
+        model=model,
     )
 
 

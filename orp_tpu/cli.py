@@ -28,6 +28,11 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 sampling vs the Conze-Viswanathan / Goldman-Sosin-Gatto
                 closed forms (no reference analogue)
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
+- ``export``    train a hedge pipeline and export the policy as a serve
+                bundle (``orp_tpu/serve/bundle.py``); the hedge commands'
+                ``--export-dir`` does the same inline after a full run
+- ``serve-bench`` load a bundle and benchmark the serving path (bucketed
+                engine + micro-batcher), emitting ``BENCH_serve.json``
 """
 
 from __future__ import annotations
@@ -95,6 +100,13 @@ def _add_train_flags(p):
                         "products over row blocks of this size (O(block*P) "
                         "fit memory; 1.5x faster walk on CPU)")
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
+
+
+def _add_export_flag(p):
+    p.add_argument("--export-dir", default=None,
+                   help="after training, export the policy as a serve "
+                        "bundle to this directory (load with "
+                        "orp_tpu.serve.load_bundle / serve-bench)")
 
 
 def _add_oos_flag(p):
@@ -168,7 +180,8 @@ def cmd_euro(args):
     )
     train = _train_cfg(args, "mse_only")
     _check_oos_seed(args, sim.seed_fund, "seed_fund")
-    res = european_hedge(euro, sim, train, quantile_method=args.quantile_method)
+    res = european_hedge(euro, sim, train, quantile_method=args.quantile_method,
+                         export_dir=args.export_dir)
     _emit(args, res.report)
     if args.oos_seed is not None:
         oos = european_oos(
@@ -193,7 +206,8 @@ def cmd_heston(args):
     )
     train = _train_cfg(args, "mse_only")
     _check_oos_seed(args, sim.seed_fund, "seed_fund")
-    res = heston_hedge(h, sim, train, quantile_method=args.quantile_method)
+    res = heston_hedge(h, sim, train, quantile_method=args.quantile_method,
+                       export_dir=args.export_dir)
     pricer = heston_call if h.option_type == "call" else heston_put
     oracle = pricer(h.s0, h.strike, h.r, args.T, v0=h.v0, kappa=h.kappa,
                     theta=h.theta, xi=h.xi, rho=h.rho)
@@ -231,7 +245,8 @@ def cmd_pension(args):
         train=_train_cfg(args, "separate"),
     )
     _check_oos_seed(args, cfg.sim.seed, "seed")
-    res = pension_hedge(cfg, quantile_method=args.quantile_method)
+    res = pension_hedge(cfg, quantile_method=args.quantile_method,
+                        export_dir=args.export_dir)
     _emit(args, res.report)
     if args.oos_seed is not None:
         from orp_tpu.api import pension_oos
@@ -284,6 +299,7 @@ def cmd_basket(args):
         bcfg, sim, train,
         quantile_method=args.quantile_method,
         instruments=args.instruments,
+        export_dir=args.export_dir,
     )
     rep = res.report
     extra = {
@@ -434,7 +450,10 @@ def cmd_surface(args):
     times = np.asarray(surf["times"])
     print("implied-vol surface (rows = maturity, cols = strike; "
           "nan = price on the no-arbitrage floor)")
-    print(f"{'T \\ K':>7}" + "".join(f"{k:>9.1f}" for k in strikes))
+    # no backslash inside the f-string expression: a SyntaxError on every
+    # Python < 3.12, which made the whole CLI unimportable there
+    corner = "T \\ K"
+    print(f"{corner:>7}" + "".join(f"{k:>9.1f}" for k in strikes))
     for i, t in enumerate(times):
         print(f"{t:7.3f}" + "".join(f"{v:9.4f}" for v in iv[i]))
 
@@ -460,6 +479,63 @@ def cmd_bermudan(args):
     print(f"CRR bermudan       {oracle:.4f}")
     print(f"european (same paths) {res['european']:.4f}")
     print(f"early-exercise premium {res['early_exercise_premium']:.4f}")
+
+
+def cmd_export(args):
+    """Train the selected pipeline at the given size and export the policy
+    bundle — the dedicated export path (the hedge commands' --export-dir
+    covers the export-after-a-full-reporting-run shape)."""
+    from orp_tpu.api import (
+        EuropeanConfig, HedgeRunConfig, HestonConfig, SimConfig, european_hedge,
+        heston_hedge, pension_hedge,
+    )
+    from orp_tpu.serve.bundle import load_bundle
+
+    train = _train_cfg(args, "mse_only" if args.pipeline != "pension" else "separate")
+    if args.pipeline == "pension":
+        cfg = HedgeRunConfig(
+            sim=SimConfig(n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+                          rebalance_every=args.rebalance_every),
+            train=train,
+        )
+        res = pension_hedge(cfg, export_dir=args.out)
+    else:
+        sim = SimConfig(n_paths=args.paths, T=args.T, dt=args.T / args.steps,
+                        rebalance_every=args.rebalance_every)
+        fn = european_hedge if args.pipeline == "euro" else heston_hedge
+        model_cfg = EuropeanConfig() if args.pipeline == "euro" else HestonConfig()
+        res = fn(model_cfg, sim, train, export_dir=args.out)
+    # prove the artifact loads before reporting success (a broken export
+    # should fail HERE, not at serve time)
+    bundle = load_bundle(args.out)
+    out = {
+        "out": args.out,
+        "pipeline": args.pipeline,
+        "n_dates": bundle.n_dates,
+        "v0": res.v0,
+        "fingerprint": bundle.fingerprint,
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"exported {args.pipeline} policy ({bundle.n_dates} dates, "
+              f"v0={res.v0:,.4f}) -> {args.out}")
+
+
+def cmd_serve_bench(args):
+    from orp_tpu.serve import load_bundle, serve_bench, write_bench_record
+
+    bundle = load_bundle(args.bundle)
+    record = serve_bench(
+        bundle,
+        n_requests=args.requests,
+        batch_sizes=tuple(int(x) for x in args.batch_sizes.split(",")),
+        batcher_requests=args.batcher_requests,
+        max_wait_us=args.max_wait_us,
+    )
+    if args.out:
+        write_bench_record(record, args.out)
+    print(json.dumps(record))
 
 
 def cmd_calibrate(args):
@@ -506,6 +582,7 @@ def build_parser():
     _add_train_flags(pe)
     _add_oos_flag(pe)
     _add_quantile_flag(pe)
+    _add_export_flag(pe)
     pe.set_defaults(fn=cmd_euro)
 
     ph = sub.add_parser("heston", help="European hedge under Heston stochastic vol")
@@ -531,6 +608,7 @@ def build_parser():
     _add_train_flags(ph)
     _add_oos_flag(ph)
     _add_quantile_flag(ph)
+    _add_export_flag(ph)
     ph.set_defaults(fn=cmd_heston)
 
     pp = sub.add_parser("pension", help="pension-liability hedge")
@@ -550,6 +628,7 @@ def build_parser():
     _add_train_flags(pp)
     _add_oos_flag(pp)
     _add_quantile_flag(pp)
+    _add_export_flag(pp)
     pp.set_defaults(fn=cmd_pension)
 
     ps = sub.add_parser("sweep", help="sigma sweep")
@@ -581,6 +660,7 @@ def build_parser():
     _add_train_flags(pb)
     _add_oos_flag(pb)
     _add_quantile_flag(pb)
+    _add_export_flag(pb)
     pb.set_defaults(fn=cmd_basket)
 
     pg = sub.add_parser(
@@ -696,6 +776,42 @@ def build_parser():
     pm.add_argument("--seed", type=int, default=1234)
     pm.add_argument("--json", action="store_true")
     pm.set_defaults(fn=cmd_bermudan)
+
+    px = sub.add_parser(
+        "export",
+        help="train a hedge pipeline and export the policy as a serve bundle",
+    )
+    px.add_argument("--pipeline", choices=["euro", "heston", "pension"],
+                    default="euro")
+    px.add_argument("--out", required=True, help="bundle directory to write")
+    px.add_argument("--paths", type=int, default=4096)
+    px.add_argument("--steps", type=int, default=364)
+    px.add_argument("--rebalance-every", type=int, default=7)
+    px.add_argument("--T", type=float, default=1.0)
+    _add_train_flags(px)
+    px.set_defaults(fn=cmd_export)
+
+    psb = sub.add_parser(
+        "serve-bench",
+        help="benchmark the serving path of an exported bundle "
+             "(bucketed engine + micro-batcher); emits BENCH_serve.json",
+    )
+    psb.add_argument("--bundle", required=True, help="bundle directory "
+                     "(orp export / --export-dir output)")
+    psb.add_argument("--requests", type=int, default=200)
+    psb.add_argument("--batch-sizes", default="1,7,64,1000",
+                     help="comma-separated request sizes the schedule cycles")
+    psb.add_argument("--batcher-requests", type=int, default=256,
+                     help="single-row burst size for the micro-batcher phase")
+    psb.add_argument("--max-wait-us", type=float, default=500.0,
+                     help="micro-batcher coalescing window")
+    psb.add_argument("--out", default="BENCH_serve.json",
+                     help="record file to write ('' skips the file; the "
+                          "record always prints as one JSON line)")
+    psb.add_argument("--json", action="store_true",
+                     help="accepted for uniformity with the other "
+                          "subcommands; the record always prints as JSON")
+    psb.set_defaults(fn=cmd_serve_bench)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
     pc.add_argument("csv")

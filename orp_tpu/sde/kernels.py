@@ -261,7 +261,15 @@ def _binomial_step(key, t, indices, n_prev, p, z, mode, neg_log_p=None):
     if mode == "exact":
         kt = jax.random.fold_in(key, t)
         pkeys = jax.vmap(jax.random.fold_in, (None, 0))(kt, indices)
-        draw = jax.vmap(jax.random.binomial)(pkeys, n_prev, p)
+        # under enable_x64 jax.random.binomial's internal lax.clamp mixes
+        # weak-f64 literals with f32 operands and raises (jax 0.4.x), so feed
+        # it f64 there; with x64 off keep the inputs as-is (an f64 request
+        # would only downgrade to f32 with a per-trace UserWarning)
+        if jax.config.jax_enable_x64:
+            nb, pb = n_prev.astype(jnp.float64), p.astype(jnp.float64)
+        else:
+            nb, pb = n_prev, p
+        draw = jax.vmap(jax.random.binomial)(pkeys, nb, pb)
         return jnp.asarray(draw, n_prev.dtype)
     if mode == "inversion":
         u = jax.scipy.special.ndtr(z)

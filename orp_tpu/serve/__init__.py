@@ -1,0 +1,33 @@
+"""L8 serving: exportable hedge-policy bundles + a batched evaluation engine.
+
+The training pipelines (L7) end with a ``PipelineResult`` — per-date trained
+params plus in-sample ledgers — that dies with the process. This layer turns
+that into production artifacts and serves them:
+
+- ``bundle``  — export/load a trained policy as an on-disk bundle
+  (orbax params + JSON metadata + run-fingerprint guard);
+- ``engine``  — jit-compiled ``evaluate(date_idx, states) -> (phi, psi, v)``
+  with shape-bucketed executable caching (arbitrary request sizes hit a
+  small fixed set of compiled programs);
+- ``batcher`` — micro-batching: coalesce many small synchronous requests
+  into one device batch (max-batch / max-wait policy);
+- ``metrics`` — p50/p95/p99 latency + throughput counters;
+- ``bench``   — the ``serve-bench`` mode emitting ``BENCH_serve.json``.
+"""
+
+from orp_tpu.serve.batcher import MicroBatcher
+from orp_tpu.serve.bench import serve_bench, write_bench_record
+from orp_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
+from orp_tpu.serve.engine import HedgeEngine
+from orp_tpu.serve.metrics import ServingMetrics
+
+__all__ = [
+    "HedgeEngine",
+    "MicroBatcher",
+    "PolicyBundle",
+    "ServingMetrics",
+    "export_bundle",
+    "load_bundle",
+    "serve_bench",
+    "write_bench_record",
+]

@@ -1,0 +1,125 @@
+"""serve-bench: measure the serving path, emit a ``BENCH_serve.json`` record.
+
+Two phases over one loaded policy:
+
+1. **engine** — direct ``HedgeEngine.evaluate`` calls cycling a mixed
+   batch-size schedule (default 1/7/64/1000 — the acceptance shapes) across
+   all rebalance dates. Warmup pre-touches every bucket once, so the
+   recorded window is compile-free; the cache counters then prove at most
+   one compile per bucket.
+2. **batcher** — a burst of single-row submissions through ``MicroBatcher``,
+   the dispatch-amortisation story: many tiny synchronous requests, few
+   device batches.
+
+The record is one flat JSON object in the ``BENCH_r*.json`` style (a
+``metric``/``value``/``unit`` headline plus namespaced detail keys), written
+by ``write_bench_record`` (CLI ``serve-bench``) and merged into the round
+artifact by the ``bench.py`` hook.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import numpy as np
+
+from orp_tpu.serve.batcher import MicroBatcher
+from orp_tpu.serve.engine import HedgeEngine
+from orp_tpu.serve.metrics import ServingMetrics
+
+DEFAULT_BATCH_SIZES = (1, 7, 64, 1000)
+
+
+def _request_stream(rng, n_requests, batch_sizes, n_dates, n_features):
+    """Deterministic synthetic request schedule: sizes cycle the schedule,
+    dates cycle the walk, features sit near the training normalisation
+    (moneyness ~ 1)."""
+    for i in range(n_requests):
+        n = batch_sizes[i % len(batch_sizes)]
+        date_idx = i % n_dates
+        feats = 1.0 + 0.1 * rng.standard_normal((n, n_features))
+        yield date_idx, feats.astype(np.float32)
+
+
+def serve_bench(
+    policy,
+    *,
+    n_requests: int = 200,
+    batch_sizes: tuple[int, ...] = DEFAULT_BATCH_SIZES,
+    batcher_requests: int = 256,
+    max_wait_us: float = 500.0,
+    seed: int = 0,
+) -> dict:
+    """Run both phases against ``policy`` (a ``PolicyBundle`` or a trained
+    ``PipelineResult``) and return the bench record."""
+    engine = HedgeEngine(policy)
+    n_features = engine.model.n_features
+    rng = np.random.default_rng(seed)
+
+    # warmup: one evaluation per REACHABLE bucket — not just the schedule's
+    # own sizes but every power-of-two up to the batcher's max coalesced
+    # batch, because the batcher phase dispatches timing-dependent sizes and
+    # a first-touch compile inside the measured window would dominate p99
+    b = engine.min_bucket
+    top = engine.bucket_for(max(batch_sizes))
+    while b <= top:
+        engine.evaluate(0, np.ones((b, n_features), np.float32))
+        b *= 2
+    warm_misses = engine.misses
+
+    metrics = ServingMetrics()
+    for date_idx, feats in _request_stream(
+            rng, n_requests, batch_sizes, engine.n_dates, n_features):
+        t0 = time.perf_counter()
+        engine.evaluate(date_idx, feats)
+        metrics.record(time.perf_counter() - t0, feats.shape[0])
+    engine_summary = metrics.summary()
+    cache = engine.cache_info()
+    served = cache["hits"] + cache["misses"]
+
+    # batcher phase: a burst of single-row requests, coalesced
+    bmetrics = ServingMetrics()
+    with MicroBatcher(engine, max_batch=max(batch_sizes),
+                      max_wait_us=max_wait_us, metrics=bmetrics) as mb:
+        futures = [
+            mb.submit(i % engine.n_dates,
+                      1.0 + 0.1 * rng.standard_normal((1, n_features)))
+            for i in range(batcher_requests)
+        ]
+        for f in futures:
+            f.result()
+    batcher_summary = bmetrics.summary()
+    dispatches = engine.cache_info()["hits"] + engine.cache_info()["misses"] - served
+
+    record = {
+        "metric": "serve_requests_per_sec",
+        "value": engine_summary["requests_per_s"],
+        "unit": "req/s",
+        "n_requests": n_requests,
+        "batch_sizes": list(batch_sizes),
+        "n_dates": engine.n_dates,
+        "p50_ms": engine_summary["p50_ms"],
+        "p95_ms": engine_summary["p95_ms"],
+        "p99_ms": engine_summary["p99_ms"],
+        "rows_per_s": engine_summary["rows_per_s"],
+        "cache_hit_rate": round(cache["hits"] / max(served, 1), 4),
+        "cache_buckets": cache["buckets"],
+        "cache_misses_after_warmup": cache["misses"] - warm_misses,
+        "batcher_requests": batcher_requests,
+        "batcher_dispatches": dispatches,
+        "batcher_requests_per_s": batcher_summary["requests_per_s"],
+        "batcher_p99_ms": batcher_summary["p99_ms"],
+    }
+    import jax
+
+    record["platform"] = jax.devices()[0].platform
+    return record
+
+
+def write_bench_record(record: dict, path: str | pathlib.Path = "BENCH_serve.json") -> None:
+    """Persist the record as the round's serving artifact (one JSON object,
+    trailing newline, BENCH_r* style)."""
+    p = pathlib.Path(path)
+    p.write_text(json.dumps(record, indent=1, sort_keys=False) + "\n")

@@ -1,0 +1,209 @@
+"""Hedge-policy bundles: export a trained walk to disk, load it back verified.
+
+A *bundle* is the deployable unit the training pipelines produce: the per-date
+MLP params (``BackwardResult.policy_state()`` — params + tiny fit metrics,
+never the O(paths x dates) training ledgers), the model architecture, the
+value/holdings combine semantics, and the evaluation metadata (rebalance-knot
+times, report scale). Layout::
+
+    <dir>/bundle.json           architecture + combine semantics + metadata
+    <dir>/run_fingerprint.txt   compatibility guard (utils/fingerprint.py)
+    <dir>/policy/0/...          orbax pytree of policy_state()
+
+Loading verifies twice: the fingerprint side file must match the string
+recomputed from ``bundle.json`` (catches a hand-edited or mixed directory),
+and the restored params must have exactly the shapes the recorded
+architecture implies (``verify_policy_compat`` — catches a ``policy/``
+subtree swapped in from another bundle). A loaded ``PolicyBundle`` exposes
+the same fields the ``*_oos`` pipelines read off a ``PipelineResult``
+(``backward``/``dual_mode``/``holdings_combine``/``cost_of_capital``/
+``sim_seed``), so it drops into out-of-sample evaluation and the serving
+engine interchangeably with an in-memory result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.train.backward import BackwardResult
+from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
+from orp_tpu.utils.fingerprint import (
+    policy_fingerprint,
+    verify_fingerprint,
+    verify_policy_compat,
+    write_fingerprint,
+)
+
+_FORMAT = "orp-bundle-v1"
+_META = "bundle.json"
+_POLICY_SUBDIR = "policy"
+
+# the model dtype is serialized by name; only dtypes the models actually
+# support are representable (an unknown name fails the load loudly)
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64}
+
+
+@dataclasses.dataclass
+class PolicyBundle:
+    """A deployable hedge policy: what ``*_oos`` and the serving engine need,
+    nothing path-simulation-specific."""
+
+    model: HedgeMLP
+    backward: BackwardResult      # params-only (ledger fields are None)
+    times: np.ndarray             # rebalance-knot times (n_dates+1,)
+    adjustment_factor: float      # report scale (S0 / strike / N0*premium)
+    dual_mode: str
+    holdings_combine: str
+    cost_of_capital: float
+    sim_seed: int | None          # training path seed — *_oos refuses replaying it
+    fingerprint: str
+
+    @property
+    def n_dates(self) -> int:
+        return len(self.times) - 1
+
+
+def _model_meta(model: HedgeMLP) -> dict:
+    return {
+        "n_features": model.n_features,
+        "hidden": list(model.hidden),
+        "negative_slope": model.negative_slope,
+        "constrain_self_financing": model.constrain_self_financing,
+        "init_scale": model.init_scale,
+        "dtype": jnp.dtype(model.dtype).name,
+        "n_hedge_assets": model.n_hedge_assets,
+    }
+
+
+def _model_from_meta(meta: dict) -> HedgeMLP:
+    dtype_name = meta["dtype"]
+    if dtype_name not in _DTYPES:
+        raise ValueError(
+            f"bundle records unsupported model dtype {dtype_name!r} "
+            f"(known: {sorted(_DTYPES)})"
+        )
+    return HedgeMLP(
+        n_features=int(meta["n_features"]),
+        hidden=tuple(int(h) for h in meta["hidden"]),
+        negative_slope=float(meta["negative_slope"]),
+        constrain_self_financing=bool(meta["constrain_self_financing"]),
+        init_scale=float(meta["init_scale"]),
+        dtype=_DTYPES[dtype_name],
+        n_hedge_assets=int(meta["n_hedge_assets"]),
+    )
+
+
+def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
+    """Export a trained ``PipelineResult`` as a policy bundle under
+    ``directory`` (created; must not already hold a different bundle).
+
+    ``result`` must carry its model (every pipeline sets
+    ``PipelineResult.model``) and per-date params. Returns the in-memory
+    ``PolicyBundle`` equivalent of what was written.
+    """
+    model = getattr(result, "model", None)
+    if model is None:
+        raise ValueError(
+            "result carries no model (PipelineResult.model is None) — "
+            "was it produced by a pre-serve version of the pipelines?"
+        )
+    state = result.backward.policy_state()
+    times = np.asarray(result.times, np.float64)
+    n_dates = len(times) - 1
+    verify_policy_compat("export_bundle", model, n_dates,
+                         state["params1_by_date"])
+    fp = policy_fingerprint(
+        model, n_dates, dual_mode=result.dual_mode,
+        holdings_combine=result.holdings_combine,
+        cost_of_capital=result.cost_of_capital,
+    )
+    d = pathlib.Path(directory)
+    meta_file = d / _META
+    if meta_file.exists():
+        # re-exporting the SAME policy config over itself is allowed (the
+        # params are overwritten); a different one must refuse, like a
+        # checkpoint dir would
+        verify_fingerprint(d, fp, what="bundle dir")
+    d.mkdir(parents=True, exist_ok=True)
+    meta = {
+        "format": _FORMAT,
+        "model": _model_meta(model),
+        "n_dates": n_dates,
+        "times": times.tolist(),
+        "adjustment_factor": float(result.adjustment_factor),
+        "dual_mode": result.dual_mode,
+        "holdings_combine": result.holdings_combine,
+        "cost_of_capital": float(result.cost_of_capital),
+        "sim_seed": result.sim_seed,
+    }
+    meta_file.write_text(json.dumps(meta, indent=1, sort_keys=True))
+    write_fingerprint(d, fp)
+    policy_dir = d / _POLICY_SUBDIR
+    if policy_dir.exists():
+        # re-export of the same config overwrites: orbax refuses to re-save
+        # an existing step even under force on this version, so clear first
+        import shutil
+
+        shutil.rmtree(policy_dir)
+    save_checkpoint(policy_dir, 0, state)
+    return PolicyBundle(
+        model=model, backward=BackwardResult.from_policy_state(state),
+        times=times, adjustment_factor=float(result.adjustment_factor),
+        dual_mode=result.dual_mode, holdings_combine=result.holdings_combine,
+        cost_of_capital=float(result.cost_of_capital),
+        sim_seed=result.sim_seed, fingerprint=fp,
+    )
+
+
+def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
+    """Load and VERIFY a bundle: fingerprint side file against the recorded
+    metadata, restored params against the recorded architecture."""
+    d = pathlib.Path(directory)
+    meta_file = d / _META
+    if not meta_file.exists():
+        raise ValueError(f"{d} is not a policy bundle (no {_META})")
+    meta = json.loads(meta_file.read_text())
+    if meta.get("format") != _FORMAT:
+        raise ValueError(
+            f"{d}: unsupported bundle format {meta.get('format')!r} "
+            f"(this loader reads {_FORMAT})"
+        )
+    model = _model_from_meta(meta["model"])
+    n_dates = int(meta["n_dates"])
+    fp = policy_fingerprint(
+        model, n_dates, dual_mode=meta["dual_mode"],
+        holdings_combine=meta["holdings_combine"],
+        cost_of_capital=float(meta["cost_of_capital"]),
+    )
+    verify_fingerprint(d, fp, what="bundle dir")
+    if latest_step(d / _POLICY_SUBDIR) != 0:
+        raise ValueError(f"{d}: bundle has no saved policy step under "
+                         f"{_POLICY_SUBDIR}/ — incomplete export?")
+    state = load_checkpoint(d / _POLICY_SUBDIR, 0)
+    # restore as device arrays in the model dtype ONCE here — the engine then
+    # indexes into resident params instead of re-transferring per request
+    for key in ("params1_by_date", "params2_by_date"):
+        if key in state:
+            state[key] = jax.tree.map(
+                lambda x: jnp.asarray(x, model.dtype), state[key]
+            )
+    verify_policy_compat(f"load_bundle({d})", model, n_dates,
+                         state["params1_by_date"])
+    return PolicyBundle(
+        model=model,
+        backward=BackwardResult.from_policy_state(state),
+        times=np.asarray(meta["times"], np.float64),
+        adjustment_factor=float(meta["adjustment_factor"]),
+        dual_mode=meta["dual_mode"],
+        holdings_combine=meta["holdings_combine"],
+        cost_of_capital=float(meta["cost_of_capital"]),
+        sim_seed=meta["sim_seed"],
+        fingerprint=fp,
+    )

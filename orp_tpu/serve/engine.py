@@ -1,0 +1,189 @@
+"""Batched low-latency policy evaluation with shape-bucketed executables.
+
+The serving workload is ``phi_t(state)`` for arbitrary request sizes — one
+policyholder, a branch office's 7, a book of 10^6. Naive jit recompiles per
+batch shape; here every request is padded up to the next power-of-two
+*bucket*, so the whole size spectrum hits a small fixed set of compiled
+executables (log2(max/min) + 1 of them), each compiled exactly once. The
+date index and the cost-of-capital margin are traced scalars, so serving all
+rebalance dates shares the same executables.
+
+The forward is the ONE definition the training walk and the replay use
+(``train/backward.py:_date_outputs_core`` — full-f32 matmul precision, all
+three dual-mode combines), so a served ``(phi, psi, value)`` is bit-identical
+to the corresponding ``*_oos`` ledger column on the same inputs.
+
+``trace(...)`` spans (``orp_tpu/utils/profiling.py``) wrap pad / dispatch /
+unpad so a profiler capture shows where serving time goes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orp_tpu.train.backward import _date_outputs_core, _split_holdings
+from orp_tpu.utils.profiling import trace
+
+
+@functools.partial(jax.jit, static_argnames=("model", "dual_mode", "holdings_combine"))
+def _eval_core(model, p1_all, p2_all, date_idx, feats, prices,
+               cost_of_capital, *, dual_mode, holdings_combine):
+    """One bucket-shaped executable: gather the date's params, run the
+    training walk's fused per-date outputs. ``date_idx`` is traced — one
+    compile covers every rebalance date at this bucket size."""
+    p1 = jax.tree.map(lambda x: x[date_idx], p1_all)
+    p2 = jax.tree.map(lambda x: x[date_idx], p2_all)
+    # shared-mode g_pre collapses to the stored (post-quantile) weights'
+    # value — the replay semantics (train/replay.py docstring), the only
+    # ones reconstructible from per-date snapshots
+    g_pre = (
+        model.value(p1, feats, prices)
+        if dual_mode == "shared" else jnp.zeros((), model.dtype)
+    )
+    v, comb, _ = _date_outputs_core(
+        model, p1, p2, feats, prices,
+        jnp.zeros_like(prices), jnp.zeros(feats.shape[:1], model.dtype),
+        cost_of_capital, g_pre,
+        dual_mode=dual_mode, holdings_combine=holdings_combine,
+    )
+    phi, psi = _split_holdings(comb)
+    return phi, psi, v
+
+
+def next_bucket(n: int, *, min_bucket: int = 8) -> int:
+    """Smallest power-of-two >= n, floored at ``min_bucket``."""
+    if n < 1:
+        raise ValueError(f"batch of {n} rows")
+    return max(min_bucket, 1 << (n - 1).bit_length())
+
+
+class HedgeEngine:
+    """Evaluate a hedge policy (a ``PolicyBundle`` or a ``PipelineResult``
+    carrying its model) for arbitrary request sizes.
+
+    ``evaluate(date_idx, states[, prices])`` pads the request to its bucket,
+    dispatches the bucket-shaped executable, and slices the padding back off.
+    ``hits``/``misses`` count bucket-cache hits (miss = first request landing
+    in a bucket = the one compile that bucket ever pays).
+    """
+
+    def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20):
+        model = getattr(policy, "model", None)
+        if model is None:
+            raise ValueError(
+                "policy carries no model — pass a PolicyBundle or a "
+                "PipelineResult produced by the current pipelines"
+            )
+        bw = policy.backward
+        if bw.params1_by_date is None:
+            raise ValueError("policy has no per-date params to serve")
+        self.model = model
+        self.dual_mode = policy.dual_mode
+        self.holdings_combine = policy.holdings_combine
+        self.cost_of_capital = float(policy.cost_of_capital)
+        self.min_bucket = min_bucket
+        self.max_bucket = max_bucket
+        # device-resident once; every request indexes into these
+        self._p1 = jax.tree.map(lambda x: jnp.asarray(x, model.dtype),
+                                bw.params1_by_date)
+        p2 = bw.params2_by_date
+        self._p2 = self._p1 if p2 is None else jax.tree.map(
+            lambda x: jnp.asarray(x, model.dtype), p2)
+        self.n_dates = int(jax.tree.leaves(self._p1)[0].shape[0])
+        self.hits = 0
+        self.misses = 0
+        self._buckets: set[int] = set()
+
+    # -- cache introspection -------------------------------------------------
+
+    def cache_info(self) -> dict:
+        """Bucket-cache counters: each miss is the one compile its bucket
+        ever pays; every later request of any size in that bucket is a hit."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "buckets": sorted(self._buckets),
+        }
+
+    # -- evaluation ----------------------------------------------------------
+
+    def bucket_for(self, n_rows: int) -> int:
+        b = next_bucket(n_rows, min_bucket=self.min_bucket)
+        if b > self.max_bucket:
+            raise ValueError(
+                f"batch of {n_rows} rows exceeds max_bucket={self.max_bucket}; "
+                "split the request (or raise max_bucket)"
+            )
+        return b
+
+    def evaluate(self, date_idx: int, states, prices=None):
+        """Hedge the batch: ``(phi, psi, value)`` as host numpy arrays of
+        ``len(states)`` rows.
+
+        ``states``: ``(n, n_features)`` feature rows in the TRAINING
+        normalisation (e.g. ``S_t/S0`` for the European policy).
+        ``prices``: optional ``(n, k)`` hedge-instrument prices (risky legs
+        then bond, same normalisation) — required for ``value``; without
+        them ``value`` is returned as None (phi/psi need no prices).
+        ``date_idx``: rebalance-date index ``0..n_dates-1``; negative
+        indices count from the end like numpy.
+        """
+        states = np.asarray(states)
+        if states.ndim == 1:
+            states = states[None, :]
+        n, f = states.shape
+        if f != self.model.n_features:
+            raise ValueError(
+                f"states have {f} features; this policy was trained on "
+                f"{self.model.n_features}"
+            )
+        idx = int(date_idx)
+        if not -self.n_dates <= idx < self.n_dates:
+            raise IndexError(
+                f"date_idx {date_idx} out of range for {self.n_dates} dates")
+        idx %= self.n_dates
+        has_prices = prices is not None
+        k = self.model.n_outputs if not self.model.constrain_self_financing \
+            else 2
+        if has_prices:
+            prices = np.asarray(prices)
+            if prices.ndim == 1:
+                prices = prices[None, :]
+            if prices.shape != (n, k):
+                raise ValueError(
+                    f"prices shape {prices.shape} != {(n, k)} "
+                    "(risky legs then bond, one row per state)"
+                )
+        b = self.bucket_for(n)
+        if b in self._buckets:
+            self.hits += 1
+        else:
+            self.misses += 1
+            self._buckets.add(b)
+        dt = np.dtype(jnp.dtype(self.model.dtype).name)
+        with trace("serve/pad"):
+            feats = np.zeros((b, f), dt)
+            feats[:n] = states
+            pr = np.zeros((b, k), dt)
+            if has_prices:
+                pr[:n] = prices
+        with trace("serve/dispatch"):
+            phi, psi, v = _eval_core(
+                self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
+                jnp.asarray(feats), jnp.asarray(pr),
+                jnp.asarray(self.cost_of_capital, self.model.dtype),
+                dual_mode=self.dual_mode,
+                holdings_combine=self.holdings_combine,
+            )
+            # block: a served result IS the deliverable — latency metrics on
+            # dispatch-only timing would be fiction
+            phi, psi, v = jax.block_until_ready((phi, psi, v))
+        with trace("serve/unpad"):
+            phi = np.asarray(phi)[:n]
+            psi = np.asarray(psi)[:n]
+            value = np.asarray(v)[:n] if has_prices else None
+        return phi, psi, value

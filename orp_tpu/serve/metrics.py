@@ -1,0 +1,93 @@
+"""Serving metrics: latency percentiles + throughput counters.
+
+The standard inference-serving observables — per-request latency p50/p95/p99
+and request/row throughput — kept host-side and allocation-light: cumulative
+request/row counters plus a BOUNDED latency window (a deque of the most
+recent ``window`` samples) behind one lock, so an always-on server records
+forever without growing — percentiles are over the window, counts and
+throughput over the whole lifetime. Recorded latencies must be
+DEVICE-COMPLETE times: the engine blocks on the result before the caller's
+clock stops, so these are end-to-end numbers, not dispatch times.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+
+class ServingMetrics:
+    """Thread-safe latency/throughput recorder shared by engine callers and
+    the micro-batcher worker. ``window`` bounds the retained latency samples
+    (percentiles reflect the most recent that many requests)."""
+
+    def __init__(self, *, window: int = 65536):
+        if window < 1:
+            raise ValueError(f"window={window} must be >= 1")
+        self._window = int(window)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._latencies_s: collections.deque[float] = collections.deque(
+                maxlen=self._window)
+            self._n_requests = 0
+            self._rows = 0
+            self._t_first: float | None = None
+            self._t_last: float | None = None
+
+    def record(self, latency_s: float, n_rows: int = 1) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self._latencies_s.append(float(latency_s))
+            self._n_requests += 1
+            self._rows += int(n_rows)
+            if self._t_first is None:
+                self._t_first = now - latency_s  # window opens at first submit
+            self._t_last = now
+
+    @property
+    def requests(self) -> int:
+        with self._lock:
+            return self._n_requests
+
+    def summary(self) -> dict:
+        """One flat dict: lifetime request/row counts and throughput, latency
+        percentiles (ms) over the retained window. Zero-request summaries are
+        all zeros (a bench that produced nothing should emit an honest
+        record, not crash)."""
+        with self._lock:
+            lat = np.asarray(self._latencies_s, np.float64)
+            n_requests = self._n_requests
+            rows = self._rows
+            elapsed = (
+                (self._t_last - self._t_first)
+                if self._t_first is not None else 0.0
+            )
+        if lat.size == 0:
+            return {
+                "requests": 0, "rows": 0, "elapsed_s": 0.0,
+                "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                "mean_ms": 0.0, "max_ms": 0.0,
+                "requests_per_s": 0.0, "rows_per_s": 0.0,
+            }
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        # a single instantaneous request has elapsed ~ its own latency;
+        # guard the division anyway (perf_counter can tie at its resolution)
+        denom = max(elapsed, 1e-9)
+        return {
+            "requests": int(n_requests),
+            "rows": int(rows),
+            "elapsed_s": round(elapsed, 6),
+            "p50_ms": round(p50 * 1e3, 4),
+            "p95_ms": round(p95 * 1e3, 4),
+            "p99_ms": round(p99 * 1e3, 4),
+            "mean_ms": round(float(lat.mean()) * 1e3, 4),
+            "max_ms": round(float(lat.max()) * 1e3, 4),
+            "requests_per_s": round(n_requests / denom, 2),
+            "rows_per_s": round(rows / denom, 2),
+        }

@@ -301,6 +301,52 @@ class BackwardResult:
         """t=0 portfolio value per path; mean is the price estimate."""
         return self.values[:, 0]
 
+    def policy_state(self) -> dict:
+        """The exportable policy: per-date params + the (tiny) per-date
+        training metrics, WITHOUT the per-path ledgers.
+
+        This is what ``orp_tpu/serve/bundle.py`` persists — the ledgers are
+        O(n_paths x n_dates) training-set artifacts that a served policy
+        neither needs nor should ship, while the params are O(n_params x
+        n_dates) (~6KB for the reference net over a 52-date walk). The
+        metrics ride along so a replay from a loaded bundle still reports the
+        original fit quality (``train/replay.py`` carries them through).
+        """
+        if self.params1_by_date is None:
+            raise ValueError(
+                "no per-date params (params1_by_date is None) — this result "
+                "was produced by a pre-replay version of the walk and cannot "
+                "be exported"
+            )
+        state = {
+            "params1_by_date": self.params1_by_date,
+            "train_loss": np.asarray(self.train_loss),
+            "train_mae": np.asarray(self.train_mae),
+            "train_mape": np.asarray(self.train_mape),
+            "epochs_ran": np.asarray(self.epochs_ran),
+        }
+        if self.params2_by_date is not None:
+            state["params2_by_date"] = self.params2_by_date
+        return state
+
+    @classmethod
+    def from_policy_state(cls, state: dict) -> "BackwardResult":
+        """Rebuild a params-only result from ``policy_state`` output.
+
+        The per-path ledgers are None: such a result exists to be REPLAYED
+        (``train/replay.py``) or served (``orp_tpu/serve``), both of which
+        read only the per-date params and metrics.
+        """
+        return cls(
+            values=None, phi=None, psi=None, var_residuals=None,
+            train_loss=np.asarray(state["train_loss"]),
+            train_mae=np.asarray(state["train_mae"]),
+            train_mape=np.asarray(state["train_mape"]),
+            epochs_ran=np.asarray(state["epochs_ran"]).astype(np.int64),
+            params1_by_date=state["params1_by_date"],
+            params2_by_date=state.get("params2_by_date"),
+        )
+
 
 @functools.partial(jax.jit, static_argnames=("model", "cfg"))
 def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, kas, kbs):

@@ -42,23 +42,33 @@ def _replay(model, params1_by_date, params2_by_date, features, prices_all,
     n_dates = prices_all.shape[1] - 1
     terminal = terminal.astype(model.dtype)
 
-    def per_date(p1, p2, t):
+    def per_date(_, xs):
+        p1, p2, t = xs
         g_pre = (
             model.value(p1, features[:, t], prices_all[:, t])
             if dual_mode == "shared" else jnp.zeros((), model.dtype)
         )
         # target enters only the var_resid column; the per-date target is the
-        # replayed next-date value, substituted after the vmap below
+        # replayed next-date value, substituted after the scan below
         v_t, comb, _ = _date_outputs_core(
             model, p1, p2, features[:, t], prices_all[:, t],
             prices_all[:, t + 1], terminal, cost_of_capital, g_pre,
             dual_mode=dual_mode, holdings_combine=holdings_combine,
         )
-        return v_t, comb
+        return None, (v_t, comb)
 
-    v_cols, combs = jax.vmap(per_date, in_axes=(0, 0, 0), out_axes=(1, 1))(
-        params1_by_date, params2_by_date, jnp.arange(n_dates)
+    # scan, not vmap: per-iteration plain matmuls round EXACTLY like the
+    # per-date programs of the training walk and the serving engine
+    # (vmap's batched dot_general differs by ~1 f32 ulp on CPU), so the
+    # replay-identity and served-equals-oos contracts hold bitwise. The
+    # dates are embarrassingly parallel; at ~50 of them the sequentialism
+    # is noise next to the path-sharded row work inside each body.
+    _, (v_cols, combs) = jax.lax.scan(
+        per_date, None,
+        (params1_by_date, params2_by_date, jnp.arange(n_dates)),
     )
+    v_cols = jnp.moveaxis(v_cols, 0, 1)        # (n, n_dates)
+    combs = jnp.moveaxis(combs, 0, 1)          # (n, n_dates, k)
     values = jnp.concatenate([v_cols, terminal[:, None]], axis=1)
     # residual vs the replayed next-date value (v_{t+1}; terminal at the end)
     gains = jnp.sum(combs * prices_all[:, 1:], axis=-1)  # comb_t . prices_{t+1}

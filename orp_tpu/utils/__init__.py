@@ -1,18 +1,32 @@
-"""Cross-cutting utilities: checkpointing, profiling/timing."""
+"""Cross-cutting utilities: checkpointing, fingerprints, profiling/timing."""
 
 from orp_tpu.utils.black_scholes import bs_call, bs_greeks, bs_put
 from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
 from orp_tpu.utils.crr import crr_price
+from orp_tpu.utils.fingerprint import (
+    check_fingerprint,
+    policy_fingerprint,
+    read_fingerprint,
+    verify_fingerprint,
+    verify_policy_compat,
+    write_fingerprint,
+)
 from orp_tpu.utils.profiling import timed, trace
 
 __all__ = [
     "bs_call",
     "bs_greeks",
     "bs_put",
+    "check_fingerprint",
     "crr_price",
     "latest_step",
     "load_checkpoint",
+    "policy_fingerprint",
+    "read_fingerprint",
     "save_checkpoint",
     "timed",
     "trace",
+    "verify_fingerprint",
+    "verify_policy_compat",
+    "write_fingerprint",
 ]

@@ -10,6 +10,8 @@ Built on ``orbax.checkpoint.CheckpointManager`` (the supported step-management
 API: atomic finalisation, latest-step discovery, retention). A *fingerprint*
 side-file guards resume compatibility: a directory written by a different run
 configuration refuses to resume instead of silently returning stale results.
+The fingerprint mechanics live in ``orp_tpu/utils/fingerprint.py``, shared
+with the hedge-policy bundles of ``orp_tpu/serve``.
 """
 
 from __future__ import annotations
@@ -19,7 +21,15 @@ import pathlib
 import jax
 import orbax.checkpoint as ocp
 
-_FPRINT = "run_fingerprint.txt"
+from orp_tpu.utils.fingerprint import check_fingerprint
+
+__all__ = [
+    "check_fingerprint",
+    "save_checkpoint",
+    "latest_step",
+    "load_checkpoint",
+    "load_checkpoints",
+]
 
 
 def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
@@ -31,23 +41,6 @@ def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
         pathlib.Path(directory).absolute(),
         options=ocp.CheckpointManagerOptions(max_to_keep=None),
     )
-
-
-def check_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:
-    """Write the run fingerprint on first use; refuse a mismatched directory."""
-    d = pathlib.Path(directory)
-    d.mkdir(parents=True, exist_ok=True)
-    f = d / _FPRINT
-    if f.exists():
-        saved = f.read_text()
-        if saved != fingerprint:
-            raise ValueError(
-                f"checkpoint dir {d} belongs to a different run config:\n"
-                f"  saved:   {saved}\n  current: {fingerprint}\n"
-                "use a fresh --checkpoint-dir (or delete the old one)"
-            )
-    else:
-        f.write_text(fingerprint)
 
 
 def save_checkpoint(directory: str | pathlib.Path, step: int, state) -> None:
@@ -72,7 +65,10 @@ def latest_step(directory: str | pathlib.Path) -> int | None:
 def load_checkpoint(directory: str | pathlib.Path, step: int):
     """Restore the pytree saved at ``step``."""
     with _manager(directory) as mgr:
-        return mgr.restore(step)
+        # explicit PyTreeRestore: a fresh manager (new process — exactly the
+        # resume case) cannot infer the handler from the directory alone and
+        # raises KeyError 'Item "default" ... could not be restored'
+        return mgr.restore(step, args=ocp.args.PyTreeRestore())
 
 
 def load_checkpoints(directory: str | pathlib.Path, steps):
@@ -84,4 +80,4 @@ def load_checkpoints(directory: str | pathlib.Path, steps):
     """
     with _manager(directory) as mgr:
         for step in steps:
-            yield mgr.restore(step)
+            yield mgr.restore(step, args=ocp.args.PyTreeRestore())

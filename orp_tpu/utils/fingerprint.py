@@ -1,0 +1,134 @@
+"""Run-fingerprint side files: one compatibility guard for every artifact dir.
+
+Both persistence layers — per-date training checkpoints
+(``orp_tpu/utils/checkpoint.py``) and exported hedge-policy bundles
+(``orp_tpu/serve/bundle.py``) — write directories whose contents are only
+meaningful under the exact run configuration that produced them. A
+``run_fingerprint.txt`` side file records that configuration as a string;
+re-opening the directory under a different configuration refuses loudly
+instead of silently returning stale or shape-garbled results.
+
+Split out of ``checkpoint.py`` so checkpointing and serving share ONE
+definition of write/read/verify, plus the policy-shape helpers the
+out-of-sample pipelines use to validate trained params against a fresh
+config UP FRONT (a clean ValueError naming both shapes, not a shape error
+deep inside the replayed forward).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+FINGERPRINT_FILE = "run_fingerprint.txt"
+
+
+def read_fingerprint(directory: str | pathlib.Path) -> str | None:
+    """The fingerprint recorded in ``directory``, or None if none exists."""
+    f = pathlib.Path(directory) / FINGERPRINT_FILE
+    return f.read_text() if f.exists() else None
+
+
+def write_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / FINGERPRINT_FILE).write_text(fingerprint)
+
+
+def verify_fingerprint(
+    directory: str | pathlib.Path, fingerprint: str, *, what: str = "directory"
+) -> None:
+    """Raise unless ``directory`` records exactly ``fingerprint``.
+
+    A MISSING side file also raises: a directory without provenance cannot be
+    proven compatible (bundles always write one; see ``check_fingerprint``
+    for the write-on-first-use checkpoint semantics).
+    """
+    saved = read_fingerprint(directory)
+    if saved is None:
+        raise ValueError(
+            f"{what} {pathlib.Path(directory)} has no {FINGERPRINT_FILE} — "
+            "not a directory written by this framework (or partially copied)"
+        )
+    if saved != fingerprint:
+        raise ValueError(
+            f"{what} {pathlib.Path(directory)} belongs to a different run config:\n"
+            f"  saved:   {saved}\n  current: {fingerprint}\n"
+            "use a fresh directory (or delete the old one)"
+        )
+
+
+def check_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:
+    """Write the run fingerprint on first use; refuse a mismatched directory.
+
+    The checkpoint-resume contract: an empty/new directory adopts the current
+    fingerprint, an existing one must match it exactly.
+    """
+    if read_fingerprint(directory) is None:
+        write_fingerprint(directory, fingerprint)
+    else:
+        verify_fingerprint(directory, fingerprint, what="checkpoint dir")
+
+
+# ---------------------------------------------------------------------------
+# Policy-shape fingerprints (trained per-date params vs a fresh run config)
+# ---------------------------------------------------------------------------
+
+
+def describe_params_by_date(params_by_date) -> str:
+    """Canonical shape signature of a per-date params pytree:
+    ``"b0:(52, 8), w0:(52, 1, 8), ..."`` (leaf name sorted, leading axis is
+    the date axis)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves_with_path(params_by_date)
+    parts = []
+    for path, leaf in leaves:
+        name = "".join(str(getattr(p, "key", p)) for p in path)
+        parts.append(f"{name}:{tuple(leaf.shape)}")
+    return ", ".join(sorted(parts))
+
+
+def describe_model_params(model, n_dates: int) -> str:
+    """The signature ``describe_params_by_date`` would produce for per-date
+    snapshots of ``model`` over ``n_dates`` rebalance dates — derived purely
+    from the model config, no params materialised."""
+    sizes = (model.n_features, *model.hidden, model.n_outputs)
+    parts = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        parts.append(f"w{i}:{(n_dates, fan_in, fan_out)}")
+        parts.append(f"b{i}:{(n_dates, fan_out)}")
+    return ", ".join(sorted(parts))
+
+
+def policy_fingerprint(
+    model, n_dates: int, *, dual_mode: str, holdings_combine: str,
+    cost_of_capital: float,
+) -> str:
+    """The full compatibility string for a trained hedge policy: model config,
+    date count, per-date param shapes and the value/holdings combine
+    semantics. Everything an evaluation needs to agree on; nothing
+    path-simulation-specific (the same policy legitimately serves any path
+    set)."""
+    return (
+        f"orp-policy-v1 model={model} n_dates={n_dates} "
+        f"dual_mode={dual_mode} holdings_combine={holdings_combine} "
+        f"cost_of_capital={cost_of_capital} "
+        f"params=[{describe_model_params(model, n_dates)}]"
+    )
+
+
+def verify_policy_compat(name: str, model, n_dates: int, params_by_date) -> None:
+    """Up-front guard for the *_oos pipelines and the serving engine: the
+    per-date params a trained result/bundle carries must be exactly the
+    shapes ``model`` over ``n_dates`` dates would produce. Raises a
+    ValueError naming both signatures instead of letting the replayed
+    forward fail with an opaque shape error."""
+    got = describe_params_by_date(params_by_date)
+    want = describe_model_params(model, n_dates)
+    if got != want:
+        raise ValueError(
+            f"{name}: trained policy params do not match this run config:\n"
+            f"  trained: [{got}]\n  config:  [{want}]\n"
+            "the model head/features or the rebalance-date count differ — "
+            "evaluate with the config the policy was trained under"
+        )

@@ -1,0 +1,334 @@
+"""Serving-layer oracles (orp_tpu/serve): bundle export→load round-trips
+bit-for-bit, the bucketed engine reproduces the *_oos ledgers exactly and
+compiles once per bucket (witnessed by the cache counters), the micro-batcher
+preserves per-request ordering/correctness under interleaved sizes, and the
+fingerprint guards refuse incompatible directories/configs up front."""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orp_tpu.api import (
+    EuropeanConfig,
+    HedgeRunConfig,
+    SimConfig,
+    TrainConfig,
+    european_hedge,
+    european_oos,
+    pension_hedge,
+    pension_oos,
+)
+from orp_tpu.sde import TimeGrid, bond_curve, simulate_gbm_log
+from orp_tpu.serve import (
+    HedgeEngine,
+    MicroBatcher,
+    ServingMetrics,
+    export_bundle,
+    load_bundle,
+    serve_bench,
+)
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+OOS_SIM = dataclasses.replace(SIM, seed_fund=777)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    for (path, x), (_, y) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=str(path))
+
+
+def test_bundle_roundtrip_bit_for_bit(tmp_path, trained):
+    bdir = tmp_path / "bundle"
+    exported = export_bundle(trained, bdir)
+    loaded = load_bundle(bdir)
+    _tree_equal(trained.backward.params1_by_date,
+                loaded.backward.params1_by_date)
+    assert loaded.backward.params2_by_date is None  # mse_only: one model
+    np.testing.assert_array_equal(loaded.backward.train_loss,
+                                  trained.backward.train_loss)
+    np.testing.assert_array_equal(loaded.times, np.asarray(trained.times))
+    assert loaded.model == trained.model
+    assert loaded.n_dates == 4
+    assert (loaded.dual_mode, loaded.holdings_combine, loaded.sim_seed) == (
+        "mse_only", "single", SIM.seed_fund)
+    assert loaded.adjustment_factor == trained.adjustment_factor
+    assert loaded.fingerprint == exported.fingerprint
+    # the exported policy never ships the O(paths x dates) training ledgers
+    assert loaded.backward.values is None and loaded.backward.phi is None
+
+
+def test_oos_from_bundle_equals_oos_from_memory(tmp_path, trained):
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    bundle = load_bundle(bdir)
+    from_mem = european_oos(trained, EURO, OOS_SIM, TRAIN)
+    from_disk = european_oos(bundle, EURO, OOS_SIM, TRAIN)
+    for field in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(from_mem.backward, field)),
+            np.asarray(getattr(from_disk.backward, field)), err_msg=field)
+    # the bundle remembers its training seed: in-sample replay still refused
+    with pytest.raises(ValueError, match="TRAINING seed"):
+        european_oos(bundle, EURO, SIM, TRAIN)
+
+
+def test_engine_reproduces_oos_ledgers_exactly(tmp_path, trained):
+    """Acceptance pin: export → load → evaluate equals the in-memory *_oos
+    hedge ratios (phi, psi AND value) bitwise on the same fresh paths."""
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    bundle = load_bundle(bdir)
+    oos = european_oos(trained, EURO, OOS_SIM, TRAIN)
+    engine = HedgeEngine(bundle)
+
+    grid = TimeGrid(OOS_SIM.T, OOS_SIM.n_steps)
+    idx = jnp.arange(OOS_SIM.n_paths, dtype=jnp.uint32)
+    s = simulate_gbm_log(
+        idx, grid, EURO.s0, EURO.r, EURO.sigma, OOS_SIM.seed_fund,
+        scramble=OOS_SIM.scramble, store_every=OOS_SIM.rebalance_every,
+        dtype=jnp.float32,
+    )
+    b = bond_curve(grid.reduced(OOS_SIM.rebalance_every), EURO.r, jnp.float32)
+    for t in range(bundle.n_dates):
+        states = np.asarray(s[:, t] / EURO.s0)[:, None]
+        prices = np.stack(
+            [np.asarray(s[:, t] / EURO.s0),
+             np.full(OOS_SIM.n_paths, float(b[t] / EURO.s0), np.float32)],
+            axis=1,
+        )
+        phi, psi, value = engine.evaluate(t, states, prices)
+        np.testing.assert_array_equal(phi, np.asarray(oos.backward.phi[:, t]))
+        np.testing.assert_array_equal(psi, np.asarray(oos.backward.psi[:, t]))
+        np.testing.assert_array_equal(
+            value, np.asarray(oos.backward.values[:, t]))
+
+
+def test_bucket_cache_compiles_once_per_bucket(trained):
+    """Acceptance pin: mixed sizes (1, 7, 64, 1000) land in {8, 64, 1024} —
+    one miss per bucket on first touch, hits forever after, regardless of
+    request size or date."""
+    engine = HedgeEngine(trained)  # a PipelineResult serves directly too
+    sizes = (1, 7, 64, 1000)
+    for n in sizes:
+        phi, psi, value = engine.evaluate(0, np.ones((n, 1), np.float32))
+        assert phi.shape == (n,) and psi.shape == (n,) and value is None
+    info = engine.cache_info()
+    assert info["buckets"] == [8, 64, 1024]
+    assert info["misses"] == 3 and info["hits"] == 1  # 1 and 7 share bucket 8
+    # second sweep across OTHER dates: zero new compiles
+    for i, n in enumerate(sizes):
+        engine.evaluate(i % engine.n_dates, np.ones((n, 1), np.float32))
+    info = engine.cache_info()
+    assert info["misses"] == 3 and info["hits"] == 5
+
+
+def test_engine_input_validation(trained):
+    engine = HedgeEngine(trained)
+    with pytest.raises(ValueError, match="features"):
+        engine.evaluate(0, np.ones((4, 3), np.float32))
+    with pytest.raises(IndexError):
+        engine.evaluate(99, np.ones((4, 1), np.float32))
+    with pytest.raises(ValueError, match="prices shape"):
+        engine.evaluate(0, np.ones((4, 1), np.float32),
+                        np.ones((4, 3), np.float32))
+    # negative date indices count from the end, numpy-style
+    phi_last, _, _ = engine.evaluate(-1, np.ones((4, 1), np.float32))
+    phi_3, _, _ = engine.evaluate(3, np.ones((4, 1), np.float32))
+    np.testing.assert_array_equal(phi_last, phi_3)
+
+
+def test_bundle_refuses_tampering_and_mismatch(tmp_path, trained):
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    # re-export of the SAME policy config over itself is fine
+    export_bundle(trained, bdir)
+    # a result with different combine semantics must refuse the directory
+    other = dataclasses.replace(trained, cost_of_capital=0.5)
+    with pytest.raises(ValueError, match="different run config"):
+        export_bundle(other, bdir)
+    # metadata edited after export -> recomputed fingerprint mismatches
+    meta = json.loads((bdir / "bundle.json").read_text())
+    meta["cost_of_capital"] = 0.99
+    (bdir / "bundle.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="different run config"):
+        load_bundle(bdir)
+    # not-a-bundle directory
+    with pytest.raises(ValueError, match="not a policy bundle"):
+        load_bundle(tmp_path)
+
+
+def test_oos_validates_policy_shape_up_front(trained):
+    # mismatched head (free psi vs the trained psi=1-phi constraint): a clean
+    # error naming both signatures BEFORE any path simulation, not a shape
+    # error inside the replayed forward
+    euro_free = dataclasses.replace(EURO, constrain_self_financing=False)
+    with pytest.raises(ValueError, match="trained policy params"):
+        european_oos(trained, euro_free, OOS_SIM, TRAIN)
+    # mismatched rebalance-date count
+    with pytest.raises(ValueError, match="trained policy params"):
+        european_oos(trained, EURO,
+                     dataclasses.replace(OOS_SIM, rebalance_every=4), TRAIN)
+
+
+def test_microbatcher_preserves_order_and_results(trained):
+    """Interleaved sizes and dates through the batcher: every request's rows
+    come back in submission order, bitwise-equal to a solo evaluation."""
+    engine = HedgeEngine(trained)
+    metrics = ServingMetrics()
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(40):
+        n = (1, 3, 7, 2)[i % 4]
+        feats = (1.0 + 0.05 * rng.standard_normal((n, 1))).astype(np.float32)
+        reqs.append((i % engine.n_dates, feats))
+    # force coalescing: a wide wait window and everything pre-submitted
+    with MicroBatcher(engine, max_batch=64, max_wait_us=50_000.0,
+                      metrics=metrics) as mb:
+        futures = [mb.submit(d, f) for d, f in reqs]
+        got = [f.result(timeout=30) for f in futures]
+    for (d, feats), (phi, psi, value) in zip(reqs, got):
+        solo_phi, solo_psi, _ = engine.evaluate(d, feats)
+        np.testing.assert_array_equal(phi, solo_phi)
+        np.testing.assert_array_equal(psi, solo_psi)
+        assert value is None
+    summ = metrics.summary()
+    assert summ["requests"] == 40
+    assert summ["rows"] == sum(f.shape[0] for _, f in reqs)
+
+
+def test_oos_replays_with_the_trained_model(trained):
+    """Shape-invariant architecture fields (here the leaky-ReLU slope) come
+    from the TRAINED model, not rebuilt from the evaluation config — a policy
+    trained under a different slope must replay under that slope."""
+    bent = dataclasses.replace(
+        trained, model=dataclasses.replace(trained.model, negative_slope=0.9))
+    a = european_oos(trained, EURO, OOS_SIM, TRAIN)
+    b = european_oos(bent, EURO, OOS_SIM, TRAIN)
+    assert not np.array_equal(np.asarray(a.backward.phi),
+                              np.asarray(b.backward.phi))
+
+
+def test_microbatcher_survives_lower_rank_requests(trained):
+    """A scalar state (the natural one-policyholder call) promotes to one
+    row; no request shape can kill the worker thread and strand other
+    callers' futures."""
+    engine = HedgeEngine(trained)
+    with MicroBatcher(engine, max_wait_us=50_000.0) as mb:
+        bad = mb.submit(0, np.ones((2, 2, 1), np.float32))   # rank-3
+        scalar = mb.submit(0, 0.97)                          # 1-feature policy
+        good = mb.submit(0, np.ones((2, 1), np.float32))
+        phi, _, _ = scalar.result(timeout=30)
+        assert phi.shape == (1,)
+        assert good.result(timeout=30)[0].shape == (2,)
+        with pytest.raises(ValueError):
+            bad.result(timeout=30)
+
+
+def test_microbatcher_propagates_errors_per_group(trained):
+    engine = HedgeEngine(trained)
+    with MicroBatcher(engine, max_wait_us=50_000.0) as mb:
+        bad = mb.submit(0, np.ones((2, 3), np.float32))   # wrong n_features
+        good = mb.submit(0, np.ones((2, 1), np.float32))
+        phi, _, _ = good.result(timeout=30)
+        assert phi.shape == (2,)
+        with pytest.raises(ValueError, match="features"):
+            bad.result(timeout=30)
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(0, np.ones((1, 1), np.float32))
+
+
+def test_serving_metrics_percentiles():
+    m = ServingMetrics()
+    assert m.summary()["requests"] == 0
+    for lat in (0.001, 0.002, 0.003, 0.004, 0.100):
+        m.record(lat, n_rows=10)
+    s = m.summary()
+    assert s["requests"] == 5 and s["rows"] == 50
+    assert s["p50_ms"] == pytest.approx(3.0)
+    assert s["max_ms"] == pytest.approx(100.0)
+    assert s["p99_ms"] > s["p50_ms"]
+    assert s["rows_per_s"] > 0
+    m.reset()
+    assert m.summary()["requests"] == 0
+
+
+def test_pension_bundle_roundtrip(tmp_path):
+    """The 3-feature pension policy (separate dual mode -> TWO per-date param
+    sets) exports and replays from disk identically to memory."""
+    cfg = HedgeRunConfig(
+        sim=SimConfig(n_paths=256, T=2.0, dt=0.25, rebalance_every=2),
+        train=TrainConfig(dual_mode="separate", epochs_first=10,
+                          epochs_warm=5, batch_size=256),
+    )
+    trained = pension_hedge(cfg)
+    bdir = tmp_path / "pension"
+    export_bundle(trained, bdir)
+    bundle = load_bundle(bdir)
+    assert bundle.backward.params2_by_date is not None  # dual policy
+    oos_cfg = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, seed=4321))
+    from_mem = pension_oos(trained, oos_cfg)
+    from_disk = pension_oos(bundle, oos_cfg)
+    np.testing.assert_array_equal(np.asarray(from_mem.backward.phi),
+                                  np.asarray(from_disk.backward.phi))
+    np.testing.assert_array_equal(np.asarray(from_mem.backward.values),
+                                  np.asarray(from_disk.backward.values))
+
+
+def test_cli_export_and_serve_bench_smoke(tmp_path, capsys):
+    """Tier-1 smoke for the CI satellite: `orp export` + bundle load + a tiny
+    serve-bench, all under the CPU-pinned test harness."""
+    from orp_tpu import cli
+
+    bdir = str(tmp_path / "cli_bundle")
+    cli.main([
+        "export", "--pipeline", "euro", "--paths", "256", "--steps", "4",
+        "--rebalance-every", "2", "--epochs-first", "10", "--epochs-warm",
+        "5", "--batch-size", "256", "--out", bdir, "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["n_dates"] == 2 and out["fingerprint"].startswith("orp-policy-v1")
+    assert load_bundle(bdir).n_dates == 2
+    bench_file = tmp_path / "BENCH_serve.json"
+    cli.main([
+        "serve-bench", "--bundle", bdir, "--requests", "12",
+        "--batcher-requests", "8", "--out", str(bench_file),
+    ])
+    line = json.loads(capsys.readouterr().out.strip())
+    rec = json.loads(bench_file.read_text())
+    assert rec == line
+    assert rec["metric"] == "serve_requests_per_sec" and rec["value"] > 0
+    assert rec["cache_misses_after_warmup"] == 0
+    assert {"p50_ms", "p95_ms", "p99_ms", "cache_hit_rate",
+            "batcher_dispatches"} <= set(rec)
+
+
+@pytest.mark.slow
+def test_serve_bench_throughput(trained, tmp_path):
+    """The full serve-bench schedule (throughput tier): mixed sizes across
+    all dates, warmup-compiled buckets only, batcher burst coalescing."""
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    rec = serve_bench(load_bundle(bdir), n_requests=200,
+                      batcher_requests=256)
+    assert rec["cache_misses_after_warmup"] == 0
+    assert rec["cache_hit_rate"] > 0.9
+    assert rec["value"] > 0 and rec["rows_per_s"] > 0
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+    # coalescing actually happened: far fewer dispatches than requests
+    assert rec["batcher_dispatches"] < rec["batcher_requests"]
