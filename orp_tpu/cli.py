@@ -33,6 +33,11 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 ``--export-dir`` does the same inline after a full run
 - ``serve-bench`` load a bundle and benchmark the serving path (bucketed
                 engine + micro-batcher), emitting ``BENCH_serve.json``
+- ``lint``      JAX/TPU-aware static analysis of the package itself
+                (``orp_tpu/lint``: rules ORP001-ORP007 — recompile hazards,
+                host syncs in jit code, x64 drift, PRNG key reuse, missing
+                donation, traced-value branches, unblocked timing); exits
+                non-zero on findings so it gates commits (tools/lint_all.py)
 """
 
 from __future__ import annotations
@@ -538,6 +543,17 @@ def cmd_serve_bench(args):
     print(json.dumps(record))
 
 
+def cmd_lint(args):
+    """JAX/TPU-aware static analysis: one shared contract with ``python -m
+    orp_tpu.lint`` (orp_tpu/lint/engine.py:run_cli) — findings exit 1,
+    usage errors exit 2."""
+    from orp_tpu.lint.engine import run_cli
+
+    rc = run_cli(args.paths, args.select, args.json)
+    if rc:
+        raise SystemExit(rc)
+
+
 def cmd_calibrate(args):
     from orp_tpu.calib import (
         annualized_drift, estimate_cir_params, log_returns, rolling_volatility,
@@ -812,6 +828,21 @@ def build_parser():
                      help="accepted for uniformity with the other "
                           "subcommands; the record always prints as JSON")
     psb.set_defaults(fn=cmd_serve_bench)
+
+    pl = sub.add_parser(
+        "lint",
+        help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
+             "drift, key reuse — rules ORP001-ORP007); non-zero exit on "
+             "findings",
+    )
+    pl.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: the orp_tpu "
+                         "package, resolved from any cwd)")
+    pl.add_argument("--select", default=None, metavar="ORP00X[,ORP00Y]",
+                    help="run only these rules")
+    pl.add_argument("--json", action="store_true",
+                    help="machine-readable findings document")
+    pl.set_defaults(fn=cmd_lint)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
     pc.add_argument("csv")

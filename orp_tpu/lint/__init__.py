@@ -1,0 +1,45 @@
+"""orp_tpu.lint — JAX/TPU-aware static analyzer + runtime compile auditor.
+
+Static side (``orp lint [--json] [paths]``, ``python -m orp_tpu.lint``):
+an AST rules engine (orp_tpu/lint/engine.py) with seven rules targeting
+this codebase's real hazards (orp_tpu/lint/rules.py, ORP001-ORP007) and
+per-line ``# orp: noqa[RULE] -- reason`` suppressions. The package lints
+itself clean in CI (tests/test_lint_self.py); ``tools/lint_all.py`` is the
+commit gate.
+
+Runtime side (orp_tpu/lint/trace_audit.py): ``CompileAudit`` counts XLA
+compiles per jitted callable and enforces budgets — the serve engine's
+one-compile-per-bucket and the backward walk's constant-compile-count
+invariants run as tier-1 regression tests.
+"""
+
+from orp_tpu.lint.engine import (
+    Finding,
+    RULES,
+    format_findings,
+    format_json,
+    lint_paths,
+    lint_source,
+)
+from orp_tpu.lint import rules as _rules  # noqa: F401  (registers ORP001-007)
+from orp_tpu.lint.trace_audit import (
+    CompileAudit,
+    CompileBudgetExceeded,
+    compile_count,
+    watch_backward_walk,
+    watch_serve_engine,
+)
+
+__all__ = [
+    "CompileAudit",
+    "CompileBudgetExceeded",
+    "Finding",
+    "RULES",
+    "compile_count",
+    "format_findings",
+    "format_json",
+    "lint_paths",
+    "lint_source",
+    "watch_backward_walk",
+    "watch_serve_engine",
+]

@@ -1,0 +1,26 @@
+"""``python -m orp_tpu.lint [--json] [--select RULES] [paths...]``."""
+
+import argparse
+import sys
+
+from orp_tpu.lint import RULES
+from orp_tpu.lint.engine import run_cli
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m orp_tpu.lint",
+        description="JAX/TPU-aware static analyzer (rules ORP001-ORP007)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories (default: the orp_tpu package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings document")
+    p.add_argument("--select", default=None, metavar="ORP00X[,ORP00Y]",
+                   help=f"run only these rules (known: {', '.join(sorted(RULES))})")
+    args = p.parse_args(argv)
+    return run_cli(args.paths, args.select, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

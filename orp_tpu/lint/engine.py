@@ -1,0 +1,435 @@
+"""AST rules engine for the JAX/TPU-aware static analyzer (``orp lint``).
+
+The classic JAX failure modes — silent recompiles, host-device syncs inside
+jit code, x64 dtype drift, PRNG key reuse — are invisible to tier-1 tests
+and benchmarks until a TPU run is mysteriously 10x slow or numerically off.
+This engine turns each of them into a per-commit static check:
+
+- a **jit index** (pass 1) maps every function in a module to its jit wrap
+  sites — decorator form (``@jax.jit``, ``@functools.partial(jax.jit, ...)``)
+  and assignment form (``fit = jax.jit(fit_core, ...)``, the
+  ``partial(jax.jit, ...)(fn)`` idiom) — with the resolved static/donated
+  argument names, so rules can reason about "jit-reachable" code and
+  static-vs-traced parameters;
+- **rules** (orp_tpu/lint/rules.py) walk the tree with that index and yield
+  findings;
+- per-line ``# orp: noqa[RULE]`` comments suppress intentional sites (bare
+  ``# orp: noqa`` suppresses every rule on the line); a suppression should
+  carry a reason, e.g. ``# orp: noqa[ORP001] -- serialization table``;
+- output is human ``path:line:col CODE message`` lines or a versioned
+  ``--json`` document (``format_json``) for CI tooling.
+
+The analyzer is intra-module by design: wrap sites whose target function is
+imported from elsewhere still get wrap-site rules (ORP003/ORP005), while
+body rules (ORP002/ORP006) apply where the def is visible. That covers this
+codebase's real layout (jit wrappers live next to their defs) without a
+whole-program call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Callable, Iterable, Iterator
+
+JSON_SCHEMA_VERSION = 1
+
+NOQA_RE = re.compile(r"#\s*orp:\s*noqa(?:\[(?P<codes>[A-Z0-9,\s]+)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.rule} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    summary: str
+    check: Callable[["FileContext"], Iterator[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(code: str, summary: str):
+    """Register a rule. ``check(ctx)`` yields ``Finding``s for one file."""
+
+    def deco(fn):
+        RULES[code] = Rule(code, summary, fn)
+        return fn
+
+    return deco
+
+
+def walk_scope(root: ast.AST):
+    """``ast.walk`` that stays in ``root``'s own scope: yields ``root`` and
+    its descendants but does not descend into nested function/lambda bodies
+    (those run in their own scope, usually at another time entirely)."""
+    yield root
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute/name chain as a string, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _const_str_tuple(node: ast.AST) -> set[str]:
+    """Names from ``"a"`` / ``("a", "b")`` / ``["a", "b"]`` literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _int_literal(node: ast.AST) -> int | None:
+    """``3`` or ``-3`` (a USub UnaryOp, not a Constant) as an int."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+def _const_int_tuple(node: ast.AST) -> set[int]:
+    if (v := _int_literal(node)) is not None:
+        return {v}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {v for e in node.elts if (v := _int_literal(e)) is not None}
+    return set()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One place a callable is wrapped in ``jax.jit``."""
+
+    node: ast.AST                 # the node to anchor wrap-site findings on
+    target_name: str              # wrapped function's name (or "<lambda>")
+    bound_name: str               # name the jitted callable is bound to
+    func_def: ast.FunctionDef | None  # the wrapped def, if in this module
+    static_names: set[str]
+    static_nums: set[int]
+    donate_names: set[str]
+    donate_nums: set[int]
+    in_function_body: bool        # wrap executed per call, not once per import
+    link_target: bool = True      # False: target was an attribute chain
+    # (obj.method) — the terminal name must NOT link to an unrelated local
+    # def that happens to share it
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_names or self.donate_nums)
+
+    def param_names(self) -> list[str]:
+        if self.func_def is None:
+            return []
+        a = self.func_def.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+    def static_params(self) -> set[str]:
+        """Static parameter NAMES (argnums resolved through the signature;
+        negative argnums index from the end, as jax accepts)."""
+        names = set(self.static_names)
+        pos = self.param_names()
+        for i in self.static_nums:
+            if -len(pos) <= i < len(pos):
+                names.add(pos[i])
+        return names
+
+
+def _parse_jit_kwargs(call: ast.Call, site: JitSite) -> None:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            site.static_names |= _const_str_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            site.static_nums |= _const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            site.donate_names |= _const_str_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            site.donate_nums |= _const_int_tuple(kw.value)
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    return (
+        dotted(call.func) in _PARTIAL_NAMES
+        and bool(call.args)
+        and dotted(call.args[0]) in _JIT_NAMES
+    )
+
+
+class JitIndex:
+    """Pass 1 over a module: every jit wrap site, resolved to local defs."""
+
+    def __init__(self, tree: ast.Module):
+        self.sites: list[JitSite] = []
+        self._defs: dict[str, ast.FunctionDef] = {}
+        self._jitted_defs: dict[ast.FunctionDef, JitSite] = {}
+        self._func_stack: list[ast.FunctionDef] = []
+        self._collect(tree, in_function=False)
+        for site in self.sites:
+            if (site.func_def is None and site.link_target
+                    and site.target_name in self._defs):
+                site.func_def = self._defs[site.target_name]
+            if site.func_def is not None:
+                self._jitted_defs.setdefault(site.func_def, site)
+
+    # -- collection ----------------------------------------------------------
+
+    def _collect(self, node: ast.AST, in_function: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(child.name, child)
+                self._decorator_sites(child, in_function)
+                self._collect(child, in_function=True)
+            elif isinstance(child, (ast.Assign, ast.AnnAssign)):
+                self._assignment_site(child, in_function)
+                self._collect(child, in_function)
+            else:
+                self._collect(child, in_function)
+
+    def _decorator_sites(self, fdef: ast.FunctionDef, in_function: bool) -> None:
+        for dec in fdef.decorator_list:
+            site = None
+            if dotted(dec) in _JIT_NAMES:
+                site = JitSite(dec, fdef.name, fdef.name, fdef,
+                               set(), set(), set(), set(), in_function)
+            elif isinstance(dec, ast.Call):
+                if _is_partial_of_jit(dec):
+                    site = JitSite(dec, fdef.name, fdef.name, fdef,
+                                   set(), set(), set(), set(), in_function)
+                    _parse_jit_kwargs(dec, site)
+                elif dotted(dec.func) in _JIT_NAMES:
+                    site = JitSite(dec, fdef.name, fdef.name, fdef,
+                                   set(), set(), set(), set(), in_function)
+                    _parse_jit_kwargs(dec, site)
+            if site is not None:
+                self.sites.append(site)
+
+    def _assignment_site(self, assign: ast.AST, in_function: bool) -> None:
+        value = assign.value
+        if value is None or not isinstance(value, ast.Call):
+            return
+        targets = (
+            assign.targets if isinstance(assign, ast.Assign) else [assign.target]
+        )
+        bound = next(
+            (t.id for t in targets if isinstance(t, ast.Name)), "<expr>"
+        )
+        site = None
+        func_d = dotted(value.func)
+        if func_d in _JIT_NAMES and value.args:
+            # name = jax.jit(fn, static_argnames=...)
+            target = dotted(value.args[0]) or "<lambda>"
+            site = JitSite(value, target.split(".")[-1], bound, None,
+                           set(), set(), set(), set(), in_function,
+                           link_target=isinstance(value.args[0], ast.Name))
+            _parse_jit_kwargs(value, site)
+        elif (
+            isinstance(value.func, ast.Call)
+            and _is_partial_of_jit(value.func)
+            and value.args
+        ):
+            # name = functools.partial(jax.jit, static_argnames=...)(fn)
+            target = dotted(value.args[0]) or "<lambda>"
+            site = JitSite(value, target.split(".")[-1], bound, None,
+                           set(), set(), set(), set(), in_function,
+                           link_target=isinstance(value.args[0], ast.Name))
+            _parse_jit_kwargs(value.func, site)
+        if site is not None:
+            self.sites.append(site)
+
+    # -- queries -------------------------------------------------------------
+
+    def jitted_defs(self) -> dict[ast.FunctionDef, JitSite]:
+        """Defs in this module that some site wraps in jit."""
+        return self._jitted_defs
+
+    def jit_reachable_defs(self) -> dict[ast.FunctionDef, JitSite]:
+        """Jitted defs plus every def nested inside one (traced with it)."""
+        out = dict(self._jitted_defs)
+        for fdef, site in self._jitted_defs.items():
+            for sub in ast.walk(fdef):
+                if (
+                    isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub is not fdef
+                ):
+                    out.setdefault(sub, site)
+        return out
+
+    def jitted_callable_names(self) -> set[str]:
+        """Every name a jitted callable is known by in this module."""
+        names = set()
+        for site in self.sites:
+            names.add(site.bound_name)
+            names.add(site.target_name)
+        return names
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list[str]
+    jit: JitIndex
+
+    def finding(self, node: ast.AST, code: str, message: str) -> Finding:
+        return Finding(
+            self.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), code, message,
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        if not 1 <= f.line <= len(self.lines):
+            return False
+        m = NOQA_RE.search(self.lines[f.line - 1])
+        if m is None:
+            return False
+        codes = m.group("codes")
+        if codes is None:
+            return True  # bare noqa: every rule
+        return f.rule in {c.strip() for c in codes.split(",")}
+
+
+def lint_source(
+    source: str, path: str = "<source>", select: Iterable[str] | None = None
+) -> list[Finding]:
+    """Lint one module's source text; returns unsuppressed findings sorted by
+    (line, col, rule). ``select`` limits to the given rule codes."""
+    # validate the selection BEFORE parsing: a typo'd rule code must fail
+    # loudly even when the first linted file has a syntax error
+    codes = set(select) if select is not None else set(RULES)
+    unknown = codes - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; known: {sorted(RULES)}"
+        )
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "ORP000",
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(path, source, tree, source.splitlines(), JitIndex(tree))
+    findings: dict[tuple, Finding] = {}
+    for code in sorted(codes):
+        for f in RULES[code].check(ctx):
+            # one finding per (line, rule): two float64 tokens on one line
+            # are one fix, and one noqa should cover them
+            if not ctx.suppressed(f):
+                findings.setdefault((f.line, f.rule), f)
+    return sorted(findings.values(), key=lambda f: (f.line, f.col, f.rule))
+
+
+def iter_python_files(paths: Iterable[str | pathlib.Path]) -> Iterator[pathlib.Path]:
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            # hidden-dir filter applies BELOW the scanned root only: a repo
+            # checked out under ~/.local/... must still lint (a filter on
+            # absolute parts would silently turn the gate into a no-op)
+            yield from sorted(
+                f for f in p.rglob("*.py")
+                if not any(part.startswith(".")
+                           for part in f.relative_to(p).parts)
+            )
+        elif p.suffix == ".py":
+            yield p
+        else:
+            raise FileNotFoundError(f"{p}: not a .py file or directory")
+
+
+def lint_paths(
+    paths: Iterable[str | pathlib.Path], select: Iterable[str] | None = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(
+            lint_source(f.read_text(), path=str(f), select=select)
+        )
+    return findings
+
+
+def format_findings(findings: list[Finding]) -> str:
+    if not findings:
+        return "orp lint: clean"
+    lines = [f.render() for f in findings]
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    by_rule = ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+    lines.append(f"orp lint: {len(findings)} finding(s) ({by_rule})")
+    return "\n".join(lines)
+
+
+# the no-args default: the installed orp_tpu package itself, resolved from
+# this file so `orp lint` works from ANY cwd, not just the repo root
+DEFAULT_LINT_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_cli(paths, select: str | None, as_json: bool) -> int:
+    """The ONE lint CLI contract, shared by ``orp lint`` and ``python -m
+    orp_tpu.lint``: prints findings, returns 1 on findings, 2 on usage
+    errors (unknown rule / bad path — distinct so CI can tell a typo from
+    a finding), 0 on clean."""
+    import sys
+
+    try:
+        findings = lint_paths(
+            paths or [DEFAULT_LINT_ROOT],
+            select=select.split(",") if select else None,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(format_json(findings) if as_json else format_findings(findings))
+    return 1 if findings else 0
+
+
+def format_json(findings: list[Finding]) -> str:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({
+        "version": JSON_SCHEMA_VERSION,
+        "findings": [f.as_dict() for f in findings],
+        "counts": dict(sorted(counts.items())),
+        "rules": {code: r.summary for code, r in sorted(RULES.items())},
+    })

@@ -1,0 +1,508 @@
+"""The ORP rule set: this codebase's real JAX/TPU hazards, as AST checks.
+
+Each rule is a documented heuristic — precise enough that the package lints
+clean without blanket suppressions, honest enough that intentional sites
+carry a ``# orp: noqa[RULE] -- reason`` instead of silently passing. The
+failure each rule guards against:
+
+ORP001  x64 dtype drift: a stray float64 constant or x64 config flip turns
+        whole TPU programs into 2x-slot f64 emulation (and churns every jit
+        cache key). All dtype policy lives in ``utils/precision.py``.
+ORP002  host syncs inside jit-reachable code: ``.item()`` / ``float()`` /
+        ``np.asarray`` on a traced value either fails at trace time or,
+        worse, silently forces a device->host round trip per call.
+ORP003  recompilation hazards: jit objects created per call (a fresh cache
+        each time) and ``static_argnums``/``static_argnames`` that don't
+        match the wrapped signature (the classic silent-recompile typo).
+ORP004  PRNG key reuse: the same key consumed twice yields correlated
+        "random" streams — a numerics bug no test tolerance reliably traps.
+ORP005  train-step jits without buffer donation: at 10^6 paths the walk's
+        input buffers are GBs; forgetting ``donate_argnums`` doubles peak
+        HBM. Sites that *cannot* donate (inputs re-read by the caller)
+        document why with a noqa.
+ORP006  Python branching on traced values: ``if x > 0`` on a tracer raises
+        ``TracerBoolConversionError`` at trace time — or, with an
+        accidentally-static argument, recompiles per value.
+ORP007  timing around async dispatch: JAX calls return before the device
+        finishes; a ``perf_counter`` delta without ``block_until_ready``
+        measures dispatch, not compute (the reference's own benchmark bug).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from orp_tpu.lint.engine import Finding, FileContext, dotted, rule, walk_scope
+
+# -- ORP001 ------------------------------------------------------------------
+
+_X64_ALLOWED_SUFFIXES = ("utils/precision.py",)
+_F64_ATTRS = {"jnp.float64", "jax.numpy.float64"}
+
+
+def _is_jax_call(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d is not None and (d.startswith("jnp.") or d.startswith("jax."))
+
+
+@rule("ORP001", "float64/x64 dtype coercion outside utils/precision.py")
+def check_x64_drift(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.path.replace("\\", "/").endswith(_X64_ALLOWED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and dotted(node) in _F64_ATTRS:
+            yield ctx.finding(
+                node, "ORP001",
+                "jnp.float64 outside utils/precision.py — TPU code is "
+                "f32/bf16; x64 doubles register pressure and churns jit keys",
+            )
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in ("jax.config.update", "config.update") and node.args:
+                a0 = node.args[0]
+                if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                        and "x64" in a0.value):
+                    yield ctx.finding(
+                        node, "ORP001",
+                        f"{a0.value!r} toggled outside utils/precision.py — "
+                        "x64 policy is process-global and belongs in one place",
+                    )
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "astype"
+                  and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and node.args[0].value == "float64"):
+                yield ctx.finding(
+                    node, "ORP001",
+                    "astype('float64') — promote via utils/precision.py "
+                    "policy, not ad-hoc string dtypes",
+                )
+            elif _is_jax_call(node):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and (
+                        (isinstance(kw.value, ast.Constant)
+                         and kw.value.value == "float64")
+                        or dotted(kw.value) in (_F64_ATTRS | {"np.float64",
+                                                              "numpy.float64"})
+                    ):
+                        yield ctx.finding(
+                            kw.value, "ORP001",
+                            "float64 dtype= on a jax/jnp call outside "
+                            "utils/precision.py",
+                        )
+
+
+# -- ORP002 ------------------------------------------------------------------
+
+_SYNC_CALLS = {"jax.device_get"}
+_NP_HOST_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "np.copy", "numpy.copy"}
+
+
+@rule("ORP002", "host-device sync inside jit-reachable code")
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    for fdef, site in ctx.jit.jit_reachable_defs().items():
+        statics = site.static_params()
+        traced = set(site.param_names()) - statics
+        # scope-pruned walk: nested defs are jit-reachable too, but they get
+        # their OWN entry in jit_reachable_defs (walking them here would
+        # double-report every site)
+        for node in walk_scope(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                yield ctx.finding(
+                    node, "ORP002",
+                    f".item() inside jitted {fdef.name!r} — device sync per "
+                    "call (fails on tracers, stalls the pipeline on eager)",
+                )
+            elif d in _SYNC_CALLS:
+                yield ctx.finding(
+                    node, "ORP002",
+                    f"{d} inside jitted {fdef.name!r} forces a host round trip",
+                )
+            elif d in _NP_HOST_CALLS:
+                yield ctx.finding(
+                    node, "ORP002",
+                    f"{d} inside jitted {fdef.name!r} — NumPy pulls traced "
+                    "values to host; use jnp",
+                )
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("float", "int", "bool")
+                  and node.args
+                  # shape/ndim/dtype reads are trace-time statics —
+                  # float(x.shape[0]) is legal jit code (same exemption
+                  # set as ORP006's branch check)
+                  and _traced_name_in_condition(node.args[0], traced)
+                  is not None):
+                yield ctx.finding(
+                    node, "ORP002",
+                    f"{node.func.id}() on traced value inside jitted "
+                    f"{fdef.name!r} — concretization error or silent sync",
+                )
+
+
+# -- ORP003 ------------------------------------------------------------------
+
+
+@rule("ORP003", "recompilation hazard: per-call jit or static-arg mismatch")
+def check_recompile_hazards(ctx: FileContext) -> Iterator[Finding]:
+    for site in ctx.jit.sites:
+        if site.in_function_body:
+            yield ctx.finding(
+                site.node, "ORP003",
+                f"jax.jit({site.target_name}) created inside a function "
+                "body — a fresh executable cache per call; hoist to module "
+                "scope",
+            )
+        if site.func_def is not None:
+            params = set(site.param_names())
+            for name in sorted(site.static_names | site.donate_names):
+                if name not in params:
+                    yield ctx.finding(
+                        site.node, "ORP003",
+                        f"static/donate argname {name!r} is not a parameter "
+                        f"of {site.target_name!r} — typo'd statics silently "
+                        "recompile per call",
+                    )
+            n_pos = len(site.param_names())
+            for i in sorted(site.static_nums | site.donate_nums):
+                # negative argnums index from the end, as jax accepts
+                if not -n_pos <= i < n_pos:
+                    yield ctx.finding(
+                        site.node, "ORP003",
+                        f"static/donate argnum {i} out of range for "
+                        f"{site.target_name!r} ({n_pos} parameters)",
+                    )
+
+
+# -- ORP004 ------------------------------------------------------------------
+
+_KEY_MAKERS = {"key", "PRNGKey", "split", "fold_in", "clone", "wrap_key_data"}
+_KEY_NONCONSUMING = {"fold_in", "key", "PRNGKey", "wrap_key_data", "key_data",
+                     "clone"}
+_KEY_PARAM_RE = re.compile(r"^(key|rng|rng_key|prng_key|.+_key)$")
+
+
+def _random_fn(call: ast.Call) -> str | None:
+    """The ``X`` of a ``jax.random.X`` / ``random.X`` / ``jr.X`` call."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if len(parts) >= 2 and parts[-2] in ("random", "jr"):
+        return parts[-1]
+    return None
+
+
+def _key_targets(stmt_value: ast.expr, targets: list[ast.expr]) -> set[str]:
+    """Names (re)bound to fresh key material by this assignment."""
+    if not (isinstance(stmt_value, ast.Call)
+            and _random_fn(stmt_value) in _KEY_MAKERS):
+        return set()
+    out = set()
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out |= {e.id for e in t.elts if isinstance(e, ast.Name)}
+    return out
+
+
+class _KeyFlow:
+    """Per-function linear abstract interpretation of key freshness.
+
+    State: key var -> first-consuming-use node (None = fresh). A second
+    consumption without rebinding is a finding. ``if``/``try`` branches are
+    walked from a copy and max-merged (disjoint branches may each consume
+    once); loop bodies are walked twice so a consume-without-rebind trips on
+    the simulated second iteration."""
+
+    def __init__(self, ctx: FileContext, fdef: ast.FunctionDef):
+        self.ctx = ctx
+        self.fdef = fdef
+        self.state: dict[str, ast.AST | None] = {}
+        self.findings: list[Finding] = []
+        for p in (*fdef.args.posonlyargs, *fdef.args.args, *fdef.args.kwonlyargs):
+            if _KEY_PARAM_RE.match(p.arg):
+                self.state[p.arg] = None
+
+    def run(self) -> list[Finding]:
+        self._walk_body(self.fdef.body)
+        return self.findings
+
+    def _walk_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate scope, analyzed on its own
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._consume_uses(value)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            if value is not None:
+                fresh = _key_targets(value, targets)
+                for name in fresh:
+                    self.state[name] = None
+                # any other rebind of a tracked name unlinks it
+                for t in targets:
+                    for n in ast.walk(t):
+                        if (isinstance(n, ast.Name) and n.id in self.state
+                                and n.id not in fresh):
+                            del self.state[n.id]
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._consume_uses(stmt.test)
+            self._branch([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, ast.Try):
+            self._branch([stmt.body + stmt.finalbody]
+                         + [h.body for h in stmt.handlers])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._consume_uses(stmt.iter)
+            for _ in range(2):  # simulated second iteration catches reuse
+                self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._consume_uses(stmt.test)
+                self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            for item in stmt.items:
+                self._consume_uses(item.context_expr)
+            self._walk_body(stmt.body)
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._consume_uses(node)
+
+    def _branch(self, bodies: list[list[ast.stmt]]) -> None:
+        pre = dict(self.state)
+        merged: dict[str, ast.AST | None] = {}
+        any_fallthrough = False
+        for body in bodies:
+            self.state = dict(pre)
+            self._walk_body(body)
+            if body and isinstance(body[-1], (ast.Return, ast.Raise,
+                                              ast.Break, ast.Continue)):
+                continue  # terminated: its consumption can't flow past here
+            any_fallthrough = True
+            for k, v in self.state.items():
+                if k in merged:
+                    merged[k] = merged[k] if merged[k] is not None else v
+                else:
+                    merged[k] = v
+        if not any_fallthrough:
+            merged = pre
+        # branch-local keys stay tracked in their merged state: a key created
+        # AND consumed inside one branch is still reuse when consumed again
+        # after the branch (on that path it really was used already)
+        self.state = merged
+
+    def _consume_uses(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            rf = _random_fn(node)
+            if rf in _KEY_NONCONSUMING:
+                continue  # fold_in-style derivation: sanctioned multi-use
+            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                if isinstance(arg, ast.Name) and arg.id in self.state:
+                    prior = self.state[arg.id]
+                    if prior is not None:
+                        self.findings.append(self.ctx.finding(
+                            node, "ORP004",
+                            f"PRNG key {arg.id!r} consumed again without "
+                            "jax.random.split (first used at line "
+                            f"{prior.lineno}) — correlated random streams",
+                        ))
+                    self.state[arg.id] = node
+
+
+@rule("ORP004", "PRNG key reuse without jax.random.split")
+def check_key_reuse(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            yield from _KeyFlow(ctx, node).run()
+
+
+# -- ORP005 ------------------------------------------------------------------
+
+_TRAIN_STEP_RE = re.compile(r"(^|_)(fit|train|step|update|walk)", re.IGNORECASE)
+
+
+@rule("ORP005", "train-step jit without buffer donation")
+def check_missing_donation(ctx: FileContext) -> Iterator[Finding]:
+    for site in ctx.jit.sites:
+        looks_like_step = (
+            _TRAIN_STEP_RE.search(site.target_name)
+            or _TRAIN_STEP_RE.search(site.bound_name)
+        )
+        if looks_like_step and not site.donates:
+            yield ctx.finding(
+                site.node, "ORP005",
+                f"jitted train-step {site.bound_name!r} donates no buffers — "
+                "at 1M paths the inputs are GBs of HBM held across the "
+                "update; donate what the caller never re-reads (or noqa "
+                "with the reason it must be re-read)",
+            )
+
+
+# -- ORP006 ------------------------------------------------------------------
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+
+def _traced_name_in_condition(
+    test: ast.expr, traced: set[str]
+) -> ast.Name | None:
+    """A traced-parameter Name used by VALUE in ``test`` (not via a
+    trace-time attribute like ``.shape``, not ``is None``, not isinstance)."""
+    allowed_parents: set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            for sub in ast.walk(node.value):
+                allowed_parents.add(id(sub))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Name)
+              and node.func.id in ("isinstance", "len", "callable", "hasattr",
+                                   "getattr", "type")):
+            for sub in ast.walk(node):
+                allowed_parents.add(id(sub))
+        elif isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for sub in ast.walk(node):
+                allowed_parents.add(id(sub))
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Name) and node.id in traced
+                and id(node) not in allowed_parents):
+            return node
+    return None
+
+
+@rule("ORP006", "Python branch on a traced value")
+def check_traced_branch(ctx: FileContext) -> Iterator[Finding]:
+    for fdef, site in ctx.jit.jitted_defs().items():
+        traced = set(site.param_names()) - site.static_params()
+        # scope-pruned: nested defs see closures, not fdef's params — checking
+        # their branches against fdef's traced set would misfire on shadowing
+        for node in walk_scope(fdef):
+            tests = []
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                tests.append(node.test)
+            elif isinstance(node, ast.Assert):
+                tests.append(node.test)
+            for test in tests:
+                name = _traced_name_in_condition(test, traced)
+                if name is not None:
+                    yield ctx.finding(
+                        test, "ORP006",
+                        f"Python branch on traced parameter {name.id!r} in "
+                        f"jitted {fdef.name!r} — TracerBoolConversionError "
+                        "at best, per-value recompile at worst; use "
+                        "jnp.where/lax.cond or mark it static",
+                    )
+
+
+# -- ORP007 ------------------------------------------------------------------
+
+_TIMER_CALLS = {"time.perf_counter", "time.time", "perf_counter",
+                "time.monotonic", "monotonic", "_t.perf_counter"}
+_BLOCKING_HINTS = ("block_until_ready", "device_get")
+_DISPATCH_EXEMPT_PREFIXES = (
+    "jax.block_until_ready", "jax.device_get", "jax.profiler", "jax.debug",
+    "jax.config", "jax.random.key", "jax.random.PRNGKey", "jax.devices",
+    "jax.tree", "jax.monitoring", "jax.jit",  # a jit WRAP is not a dispatch
+)
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _is_sync_call(node: ast.AST) -> bool:
+    """A call that forces device completion (or reads results to host)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if d is None:
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_HINTS)
+    return any(h in d for h in _BLOCKING_HINTS) or d in (
+        "timed", "profiling.timed", "np.asarray", "np.array",
+        "jax.device_get",
+    )
+
+
+def _local_sync_fns(scope: ast.AST) -> set[str]:
+    """Names of nested defs that sync before returning (a timed call to
+    ``run()`` where ``run`` ends in ``block_until_ready`` IS blocked), plus
+    one level of ``alias = run`` rebinding."""
+    names = {
+        sub.name
+        for sub in ast.walk(scope)
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and sub is not scope
+        and any(_is_sync_call(n) for n in ast.walk(sub))
+    }
+    for sub in walk_scope(scope):
+        if (isinstance(sub, ast.Assign)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in names):
+            names |= {t.id for t in sub.targets if isinstance(t, ast.Name)}
+    return names
+
+
+@rule("ORP007", "wall timing around async dispatch without block_until_ready")
+def check_unblocked_timing(ctx: FileContext) -> Iterator[Finding]:
+    jitted_names = ctx.jit.jitted_callable_names()
+    for scope in _scopes(ctx.tree):
+        timers: list[ast.Call] = []
+        dispatches: list[str] = []
+        synced = False
+        sync_fns = _local_sync_fns(scope)
+        # scope-pruned walk: a timer in one function must not pair with a
+        # dispatch in another, and a nested helper's block_until_ready only
+        # vouches for this scope if the scope actually CALLS the helper
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in _TIMER_CALLS:
+                timers.append(node)
+            elif _is_sync_call(node):
+                synced = True
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in sync_fns):
+                synced = True
+            elif d is None:
+                continue
+            elif d.startswith(("jnp.", "jax.")) and not d.startswith(
+                _DISPATCH_EXEMPT_PREFIXES
+            ):
+                dispatches.append(d)
+            elif d.split(".")[-1] in jitted_names:
+                dispatches.append(d)
+        if len(timers) >= 2 and dispatches and not synced:
+            yield ctx.finding(
+                timers[1], "ORP007",
+                f"perf_counter delta around async dispatch ({dispatches[0]} "
+                "…) without block_until_ready — this times dispatch, not "
+                "device compute",
+            )

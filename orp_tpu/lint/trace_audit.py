@@ -1,0 +1,154 @@
+"""Runtime compile auditor: count XLA compiles per jitted callable.
+
+The static rules (orp_tpu/lint/rules.py) catch recompile *hazards*; this is
+the runtime companion that catches recompile *facts*. A ``CompileAudit``
+context manager snapshots the executable-cache size of registered jitted
+callables on entry and enforces per-callable compile budgets on exit:
+
+    audit = CompileAudit()
+    audit.watch("fit", fit, budget=2)       # first-date + warm configs
+    with audit:
+        backward_induction(...)
+    audit.deltas()  # {"fit": 2} — or CompileBudgetExceeded on exit
+
+The counter is the jitted callable's executable-cache size (``_cache_size``),
+so a "compile" here is exactly what costs wall time on a TPU: a new
+(shapes, dtypes, statics) cache entry. Two invariants ride on this in CI
+(tests/test_lint_self.py):
+
+- the serve engine compiles exactly once per shape bucket
+  (``HedgeEngine.cache_info()["xla_compiles"]`` is this module's counter
+  wired into orp_tpu/serve/engine.py);
+- the backward walk compiles a constant number of programs regardless of
+  date count (first-date + warm-date fit configs only — a walk whose
+  compile count grows with dates has broken shape-stability).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+class CompileBudgetExceeded(RuntimeError):
+    """A watched jitted callable compiled more programs than its budget."""
+
+
+def compile_count(fn: Callable) -> int:
+    """Number of compiled executables in ``fn``'s jit cache.
+
+    ``fn`` must be a ``jax.jit``-wrapped callable; raises TypeError for
+    plain functions so a mis-wired audit fails loudly, not at zero forever.
+    """
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        raise TypeError(
+            f"{fn!r} has no executable cache — pass the jax.jit-wrapped "
+            "callable, not the underlying function"
+        )
+    return cache_size()
+
+
+@dataclasses.dataclass
+class _Watch:
+    name: str
+    fn: Callable
+    budget: int | None
+    before: int
+
+
+class CompileAudit:
+    """Context manager enforcing compile budgets over a code region.
+
+    ``watch(name, fn, budget=None)`` registers a jitted callable; a budget
+    is a ceiling on NEW compiles inside the ``with`` block (None = count
+    only). Budgets are checked on clean exit; an exception already in
+    flight propagates untouched. Re-entrant use re-snapshots, so one audit
+    can gate several regions sequentially.
+    """
+
+    def __init__(self) -> None:
+        self._watches: dict[str, _Watch] = {}
+        self._active = False
+
+    def watch(self, name: str, fn: Callable, budget: int | None = None) -> None:
+        if name in self._watches:
+            w = self._watches[name]
+            if w.fn is not fn:
+                raise ValueError(f"watch {name!r} already registered for {w.fn!r}")
+            if budget is not None:
+                w.budget = budget if w.budget is None else min(w.budget, budget)
+            return
+        self._watches[name] = _Watch(
+            name, fn, budget,
+            before=compile_count(fn) if self._active else 0,
+        )
+
+    def __enter__(self) -> "CompileAudit":
+        self._active = True
+        for w in self._watches.values():
+            w.before = compile_count(w.fn)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._active = False
+        if exc_type is not None:
+            return
+        over = [
+            f"{w.name}: {d} compiles > budget {w.budget}"
+            for w in self._watches.values()
+            if w.budget is not None and (d := self.delta(w.name)) > w.budget
+        ]
+        if over:
+            raise CompileBudgetExceeded(
+                "compile budget exceeded — a shape/static leak is forcing "
+                "recompiles: " + "; ".join(over)
+            )
+
+    def delta(self, name: str) -> int:
+        w = self._watches[name]
+        return compile_count(w.fn) - w.before
+
+    def deltas(self) -> dict[str, int]:
+        return {name: self.delta(name) for name in self._watches}
+
+    def report(self) -> dict[str, Any]:
+        """JSON-able audit record (for bench/CI artifacts)."""
+        return {
+            "compiles": self.deltas(),
+            "budgets": {n: w.budget for n, w in self._watches.items()},
+        }
+
+
+def watch_backward_walk(audit: CompileAudit, *, fit_budget: int | None = 2,
+                        outputs_budget: int | None = 1) -> CompileAudit:
+    """Register the backward walk's jitted pieces on ``audit``.
+
+    Budgets encode the walk's shape-stability contract: the Adam fit
+    compiles once per fit config (first-date epochs + warm epochs = 2),
+    the fused per-date outputs program once — all regardless of date
+    count. GN walks compile their own two fit programs.
+    """
+    from orp_tpu.train import backward as bw
+    from orp_tpu.train.fit import fit
+
+    audit.watch("fit", fit, budget=fit_budget)
+    audit.watch("fit_gn", bw.fit_gn_jit, budget=fit_budget)
+    audit.watch("fit_gn_pinball", bw.fit_gn_pinball_jit, budget=fit_budget)
+    audit.watch("date_outputs", bw._date_outputs, budget=outputs_budget)
+    audit.watch("value", bw._value, budget=outputs_budget)
+    audit.watch("fused_walk", bw._fused_walk)  # count-only: one per walk shape
+    return audit
+
+
+def watch_serve_engine(audit: CompileAudit, *, budget: int | None = None
+                       ) -> CompileAudit:
+    """Register the serve engine's one bucket-shaped executable family.
+
+    ``budget`` should be the number of DISTINCT shape buckets the audited
+    region is allowed to touch (one compile per bucket, ever).
+    """
+    from orp_tpu.serve import engine as serve_engine
+
+    audit.watch("serve_eval", serve_engine._eval_core, budget=budget)
+    return audit

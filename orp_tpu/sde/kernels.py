@@ -266,7 +266,7 @@ def _binomial_step(key, t, indices, n_prev, p, z, mode, neg_log_p=None):
         # it f64 there; with x64 off keep the inputs as-is (an f64 request
         # would only downgrade to f32 with a per-trace UserWarning)
         if jax.config.jax_enable_x64:
-            nb, pb = n_prev.astype(jnp.float64), p.astype(jnp.float64)
+            nb, pb = n_prev.astype(jnp.float64), p.astype(jnp.float64)  # orp: noqa[ORP001] -- jax 0.4.x binomial clamp workaround, x64-gated
         else:
             nb, pb = n_prev, p
         draw = jax.vmap(jax.random.binomial)(pkeys, nb, pb)

@@ -47,7 +47,7 @@ _POLICY_SUBDIR = "policy"
 
 # the model dtype is serialized by name; only dtypes the models actually
 # support are representable (an unknown name fails the load loudly)
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64}
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64}  # orp: noqa[ORP001] -- serialization table must name every loadable dtype
 
 
 @dataclasses.dataclass

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orp_tpu.lint.trace_audit import compile_count
 from orp_tpu.train.backward import _date_outputs_core, _split_holdings
 from orp_tpu.utils.profiling import trace
 
@@ -97,16 +98,43 @@ class HedgeEngine:
         self.hits = 0
         self.misses = 0
         self._buckets: set[int] = set()
+        # XLA-compile baseline for THIS engine: `_eval_core`'s executable
+        # cache is process-wide, so per-engine counts are deltas from here.
+        # The counter rides a private jax attribute (_cache_size) — if a jax
+        # upgrade drops it, serving must keep working and only the optional
+        # introspection degrades (xla_compiles -> None)
+        self._compiles0 = self._eval_core_compiles()
+
+    @staticmethod
+    def _eval_core_compiles() -> int | None:
+        try:
+            return compile_count(_eval_core)
+        except TypeError:
+            return None
 
     # -- cache introspection -------------------------------------------------
 
     def cache_info(self) -> dict:
         """Bucket-cache counters: each miss is the one compile its bucket
-        ever pays; every later request of any size in that bucket is a hit."""
+        ever pays; every later request of any size in that bucket is a hit.
+
+        ``xla_compiles`` is the jit executable cache's growth since this
+        engine was built (orp_tpu/lint/trace_audit.py). The cache is
+        process-wide, so with a SINGLE live engine this is exactly its
+        compile bill (at most one per bucket; less when an earlier engine
+        with the same policy statics already paid one) — interleaved traffic
+        on other engines inflates it. For a strict per-region audit, wrap
+        the traffic in ``CompileAudit`` + ``watch_serve_engine``. None when
+        the running jax exposes no executable-cache counter."""
+        now = self._eval_core_compiles()
         return {
             "hits": self.hits,
             "misses": self.misses,
             "buckets": sorted(self._buckets),
+            "xla_compiles": (
+                now - self._compiles0
+                if now is not None and self._compiles0 is not None else None
+            ),
         }
 
     # -- evaluation ----------------------------------------------------------

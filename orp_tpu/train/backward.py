@@ -56,10 +56,14 @@ from orp_tpu.train.fit import FitConfig, fit, fit_core
 from orp_tpu.train.fit import validate_shuffle as _validate_shuffle
 from orp_tpu.train.gn import GNConfig, GNPinballConfig, fit_gn, fit_gn_pinball
 
-fit_gn_jit = functools.partial(
+# no donation on the per-date fits: the big buffers (features/prices/target)
+# are re-read on the SAME date by the quantile fit and the outputs program,
+# and the only donatable arg — params — is ~10^2 floats that profiling and
+# tests legitimately pass twice (donation would delete their buffer)
+fit_gn_jit = functools.partial(  # orp: noqa[ORP005] -- data re-read per date; params ~100 floats
     jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
 )(fit_gn)
-fit_gn_pinball_jit = functools.partial(
+fit_gn_pinball_jit = functools.partial(  # orp: noqa[ORP005] -- data re-read per date; params ~100 floats
     jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
 )(fit_gn_pinball)
 
@@ -348,7 +352,12 @@ class BackwardResult:
         )
 
 
-@functools.partial(jax.jit, static_argnames=("model", "cfg"))
+# prices_all (argnum 5) is donated: it is built inside backward_induction
+# (never caller-visible) and read only by this walk — at 1M paths x 520 knots
+# that returns ~4GB of HBM to the working set. features/terminal stay
+# undonated (caller-owned; pipelines re-read them), params1/params2 too
+# (aliased in shared mode — donating both would double-donate one buffer)
+@functools.partial(jax.jit, static_argnames=("model", "cfg"), donate_argnums=(5,))
 def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, kas, kbs):
     """The whole backward walk as ONE XLA program: the first (latest-time)
     date's fit, then ``lax.scan`` over the remaining dates.
@@ -496,9 +505,19 @@ def backward_induction(
     cfg: BackwardConfig,
     *,
     bias_init: tuple[float, ...] | None = None,
+    compile_audit=None,
 ) -> BackwardResult:
     """Run the backward hedge-training walk. All arrays may be device-sharded over
-    the path axis; parameters stay replicated."""
+    the path axis; parameters stay replicated.
+
+    ``compile_audit``: optional ``orp_tpu.lint.CompileAudit`` — registers the
+    walk's jitted pieces so the caller's audit region can enforce the walk's
+    shape-stability contract (compile count independent of date count;
+    first-date + warm fit configs only). See orp_tpu/lint/trace_audit.py."""
+    if compile_audit is not None:
+        from orp_tpu.lint.trace_audit import watch_backward_walk
+
+        watch_backward_walk(compile_audit)
     n_paths, n_knots = y_prices.shape[:2]
     n_dates = n_knots - 1
     dtype = model.dtype

@@ -237,6 +237,10 @@ def fit_core(
     return best_params, aux
 
 
-fit = functools.partial(
+# no donation: features/prices/targets are re-read on the same date by the
+# quantile fit and the outputs program (orp_tpu/train/backward.py:_date_body),
+# and params — the only arg nobody re-reads in the walk — are ~10^2 floats
+# that profiling and tests deliberately pass to two fits for identical runs
+fit = functools.partial(  # orp: noqa[ORP005] -- data re-read per date; params ~100 floats
     jax.jit, static_argnames=("value_fn", "loss_fn", "metric_fns", "cfg", "solve_fn")
 )(fit_core)

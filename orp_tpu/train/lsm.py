@@ -56,7 +56,7 @@ def _monomial_exponents(n_features: int, degree: int) -> tuple[tuple[int, ...], 
     return tuple(exps)
 
 
-@functools.partial(jax.jit, static_argnames=("degree",))
+@functools.partial(jax.jit, static_argnames=("degree",))  # orp: noqa[ORP005] -- payoffs re-read by the caller's European leg
 def _lsm_walk(feats, payoffs, disc, degree):
     """Backward LSM scan. ``feats``: (n, m, F) regression features and
     ``payoffs``: (n, m) at exercise dates t_1..t_m; ``disc``: per-interval

@@ -19,7 +19,7 @@ import jax  # noqa: E402
 # The ambient TPU plugin ("axon") registers itself regardless of JAX_PLATFORMS;
 # the config update (unlike the env var) reliably pins the platform to CPU.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_x64", True)  # orp: noqa[ORP001] -- test harness runs x64 CPU oracles by design
 # Persistent XLA compile cache: the suite's wall is dominated by per-test
 # compiles of the same fused-walk/fit programs (~8-16s each, re-done every
 # run). Separate dir from the benchmark cache (.jax_cache): the test env

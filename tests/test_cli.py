@@ -173,3 +173,31 @@ def test_lookback_json(capsys):
     ])
     out_f = json.loads(capsys.readouterr().out.strip())
     assert abs(out_f["price"] - out_f["oracle"]) < 6 * out_f["se"] + 0.05
+
+
+def test_lint_clean_tree_and_json_contract(tmp_path, capsys, monkeypatch):
+    # no-args default resolves to the installed package from ANY cwd
+    monkeypatch.chdir(tmp_path)
+    cli.main(["lint"])
+    assert "clean" in capsys.readouterr().out
+    # a seeded violation: non-zero exit + JSON findings document
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\nX = jnp.zeros(3, dtype=jnp.float64)\n"
+    )
+    with pytest.raises(SystemExit) as e:
+        cli.main(["lint", "--json", str(bad)])
+    assert e.value.code == 1
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["counts"] == {"ORP001": 1}
+    assert doc["findings"][0]["line"] == 2
+    # --select limits the rule set: the same file is clean under ORP002 only
+    cli.main(["lint", "--select", "ORP002", str(bad)])
+    assert "clean" in capsys.readouterr().out
+    # usage errors (unknown rule, bad path) exit 2 — distinct from the
+    # findings exit 1, so CI can tell a typo from a real finding
+    for argv in (["lint", "--select", "ORP999", str(bad)],
+                 ["lint", str(tmp_path / "missing.py")]):
+        with pytest.raises(SystemExit) as e:
+            cli.main(argv)
+        assert e.value.code == 2

@@ -1,0 +1,549 @@
+"""Golden fixtures for the ORP rule set (orp_tpu/lint).
+
+One true-positive snippet and one clean negative per rule, plus the
+suppression-comment contract and the JSON output schema. These are the
+rules' specs: a rule change that stops flagging its positive (or starts
+flagging its negative) fails here, not in a mystery-slow TPU run later.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from orp_tpu.lint import RULES, format_findings, format_json, lint_source
+from orp_tpu.lint.engine import JSON_SCHEMA_VERSION
+
+
+def lint(src, **kw):
+    return lint_source(textwrap.dedent(src), path="fixture.py", **kw)
+
+
+def codes(src, **kw):
+    return [f.rule for f in lint(src, **kw)]
+
+
+def test_rule_registry_complete():
+    assert set(RULES) == {f"ORP00{i}" for i in range(1, 8)}
+
+
+# -- ORP001: x64 drift -------------------------------------------------------
+
+ORP001_POS = """
+    import jax
+    import jax.numpy as jnp
+
+    def widen(x):
+        y = jnp.zeros(3, dtype=jnp.float64)
+        return y + x.astype("float64")
+
+    jax.config.update("jax_enable_x64", True)
+"""
+
+ORP001_NEG = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def host_side(prices):
+        # host NumPy float64 is fine — the rule targets JAX dtype policy
+        return np.asarray(prices, np.float64).mean()
+
+    def device_side(x):
+        return jnp.zeros(3, dtype=jnp.float32) + x
+"""
+
+
+def test_orp001_flags_x64_coercions():
+    got = codes(ORP001_POS)
+    assert got.count("ORP001") == 3  # jnp.float64, astype str, config toggle
+
+
+def test_orp001_clean_negative():
+    assert codes(ORP001_NEG) == []
+
+
+def test_orp001_allowlists_precision_module():
+    src = textwrap.dedent(ORP001_POS)
+    assert lint_source(src, path="orp_tpu/utils/precision.py") == []
+
+
+# -- ORP002: host sync inside jit -------------------------------------------
+
+ORP002_POS = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def forward(x):
+        lr = float(x)            # concretizes a tracer
+        host = np.asarray(x)     # numpy pulls to host
+        return x.sum().item() * lr + host.shape[0]
+"""
+
+ORP002_NEG = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def forward(x):
+        return jnp.asarray(x).sum() * float(1e-3)
+
+    def eager(x):
+        return float(x)  # outside jit: a legitimate host read
+"""
+
+
+def test_orp002_flags_host_syncs():
+    got = codes(ORP002_POS)
+    assert got.count("ORP002") == 3  # float(), np.asarray, .item()
+
+
+def test_orp002_clean_negative():
+    assert codes(ORP002_NEG) == []
+
+
+def test_orp002_exempts_shape_attribute_reads():
+    # .shape/.ndim/.dtype are trace-time statics: float(x.shape[0]) is
+    # legal jit code (same exemption set as ORP006)
+    src = """
+        import jax
+
+        @jax.jit
+        def forward(x):
+            return x * (1.0 / float(x.shape[0]))
+    """
+    assert codes(src) == []
+
+
+def test_orp002_sees_through_assignment_wrapping():
+    src = """
+        import jax
+
+        def _core(x):
+            return float(x)
+
+        core = jax.jit(_core)
+    """
+    assert codes(src) == ["ORP002"]
+
+
+# -- ORP003: recompile hazards ----------------------------------------------
+
+ORP003_POS_PERCALL = """
+    import jax
+
+    def hot_path(x):
+        f = jax.jit(lambda y: y + 1)  # fresh executable cache every call
+        return f(x)
+"""
+
+ORP003_POS_MISMATCH = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("n_steps",))
+    def roll_prices(x, num_steps):
+        return x * num_steps
+"""
+
+ORP003_NEG = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0,))
+    def walk_prices(x, n_steps):
+        return x * n_steps
+"""
+
+
+def test_orp003_flags_per_call_jit():
+    assert "ORP003" in codes(ORP003_POS_PERCALL)
+
+
+def test_orp003_flags_static_name_mismatch():
+    found = lint(ORP003_POS_MISMATCH)
+    assert [f.rule for f in found] == ["ORP003"]
+    assert "n_steps" in found[0].message
+
+
+def test_orp003_clean_negative():
+    assert codes(ORP003_NEG) == []
+
+
+def test_orp003_flags_static_num_out_of_range():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(5,))
+        def f(x, y):
+            return x + y
+    """
+    assert codes(src) == ["ORP003"]
+
+
+def test_orp003_negative_argnums_index_from_the_end():
+    # jax accepts negative argnums; -2 resolves, -3 is out of range
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(-2,))
+        def f(x, y):
+            return x + y
+
+        @functools.partial(jax.jit, static_argnums=(-3,))
+        def g(x, y):
+            return x + y
+    """
+    found = lint(src, select=["ORP003"])
+    assert [f.rule for f in found] == ["ORP003"]
+    assert "'g'" in found[0].message
+
+
+def test_orp003_method_wrap_does_not_link_to_unrelated_def():
+    # jax.jit(obj.method): the terminal name must not resolve against an
+    # unrelated module-level def that happens to share it
+    src = """
+        import jax
+
+        def value(a, b):
+            return a + b
+
+        class M:
+            pass
+
+        m = M()
+        g = jax.jit(m.value, static_argnames=("model",))
+    """
+    assert codes(src, select=["ORP003"]) == []
+
+
+# -- ORP004: PRNG key reuse --------------------------------------------------
+
+ORP004_POS = """
+    import jax
+
+    def sample(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))  # same key: correlated streams
+        return a + b
+"""
+
+ORP004_POS_LOOP = """
+    import jax
+
+    def sample(key):
+        outs = []
+        for _ in range(3):
+            outs.append(jax.random.normal(key, (3,)))  # reused every iter
+        return outs
+"""
+
+ORP004_NEG = """
+    import jax
+
+    def sample(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (3,))
+        b = jax.random.uniform(k2, (3,))
+        return a + b
+
+    def per_step(key, n):
+        # fold_in derivation is the sanctioned multi-use of one base key
+        return [jax.random.normal(jax.random.fold_in(key, i), (2,))
+                for i in range(n)]
+
+    def loop_split(key):
+        outs = []
+        for _ in range(3):
+            key, sub = jax.random.split(key)
+            outs.append(jax.random.normal(sub, (3,)))
+        return outs
+
+    def branches(key, flag):
+        # disjoint branches may each consume the key once
+        if flag:
+            return jax.random.normal(key, (2,))
+        return jax.random.uniform(key, (2,))
+"""
+
+
+def test_orp004_flags_key_reuse():
+    found = lint(ORP004_POS)
+    assert [f.rule for f in found] == ["ORP004"]
+    assert "'key'" in found[0].message
+
+
+def test_orp004_flags_loop_reuse():
+    assert codes(ORP004_POS_LOOP) == ["ORP004"]
+
+
+def test_orp004_clean_negative():
+    assert codes(ORP004_NEG) == []
+
+
+def test_orp004_branch_local_key_still_tracked_after_branch():
+    # a key created and consumed inside an `if` body is reuse when consumed
+    # again after the branch — the merge must not drop branch-local state
+    src = """
+        import jax
+
+        def sample(cond):
+            if cond:
+                k = jax.random.key(0)
+                a = jax.random.normal(k, (2,))
+            return jax.random.normal(k, (2,))
+    """
+    assert codes(src) == ["ORP004"]
+
+
+# -- ORP005: missing donation ------------------------------------------------
+
+ORP005_POS = """
+    import jax
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        return params, opt_state
+"""
+
+ORP005_NEG = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        return params, opt_state
+
+    @jax.jit
+    def evaluate(params, batch):  # not a train step: no donation expected
+        return params
+"""
+
+
+def test_orp005_flags_undonated_train_step():
+    assert codes(ORP005_POS) == ["ORP005"]
+
+
+def test_orp005_clean_negative():
+    assert codes(ORP005_NEG) == []
+
+
+def test_orp005_negative_donate_argnums_count_as_donation():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(-2,))
+        def fit_step(params, batch):
+            return params
+    """
+    assert codes(src) == []
+
+
+# -- ORP006: branch on traced value -----------------------------------------
+
+ORP006_POS = """
+    import jax
+
+    @jax.jit
+    def relu(x):
+        if x > 0:          # TracerBoolConversionError at trace time
+            return x
+        return 0.0 * x
+"""
+
+ORP006_NEG = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def combine(x, prices, mode):
+        if mode == "shared":          # static: legitimate trace-time branch
+            return x
+        if x.ndim == 2:               # shape attribute: trace-time constant
+            x = x[:, 0]
+        if prices is None:            # is-None: trace-time structure check
+            return x
+        return jnp.where(x > 0, x, 0.0)
+"""
+
+
+def test_orp006_flags_traced_branch():
+    found = lint(ORP006_POS)
+    assert [f.rule for f in found] == ["ORP006"]
+    assert "'x'" in found[0].message
+
+
+def test_orp006_clean_negative():
+    assert codes(ORP006_NEG) == []
+
+
+def test_orp006_nested_def_shadowing_is_not_flagged():
+    # the nested helper's own parameter shadows the jitted function's traced
+    # one; its branches run in the helper's scope, not the jitted trace
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            def describe(x):
+                if x > 0:      # plain python on the HELPER's argument
+                    return "pos"
+                return "neg"
+            return x * 2.0
+    """
+    assert codes(src) == []
+
+
+# -- ORP007: unblocked timing ------------------------------------------------
+
+ORP007_POS = """
+    import time
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        return time.perf_counter() - t0, y   # times DISPATCH, not compute
+"""
+
+ORP007_NEG = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jax.block_until_ready(jnp.dot(x, x))
+        return time.perf_counter() - t0, y
+
+    def bench_host(xs):
+        t0 = time.perf_counter()
+        total = sum(xs)                      # no device dispatch: fine
+        return time.perf_counter() - t0, total
+"""
+
+
+def test_orp007_flags_unblocked_timing():
+    assert codes(ORP007_POS) == ["ORP007"]
+
+
+def test_orp007_clean_negative():
+    assert codes(ORP007_NEG) == []
+
+
+def test_orp007_scopes_do_not_bleed():
+    # a timer-only function and a dispatch-only function must not combine
+    # into a module-scope finding (each scope is judged on its own)
+    src = """
+        import time
+        import jax.numpy as jnp
+
+        def host_timing(xs):
+            t0 = time.perf_counter()
+            total = sum(xs)
+            return time.perf_counter() - t0, total
+
+        def device_math(x):
+            return jnp.dot(x, x)
+    """
+    assert codes(src) == []
+
+
+def test_orp007_nested_sync_does_not_vouch_for_outer_timing():
+    # the block_until_ready lives in a nested helper that the timed region
+    # never calls — the outer function's timing is still unblocked
+    src = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def bench(x):
+            def _unused_sync(y):
+                return jax.block_until_ready(y)
+
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            return time.perf_counter() - t0, y
+    """
+    assert codes(src) == ["ORP007"]
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_noqa_suppresses_named_rule():
+    src = """
+        import jax.numpy as jnp
+        X = jnp.zeros(3, dtype=jnp.float64)  # orp: noqa[ORP001] -- table
+    """
+    assert codes(src) == []
+
+
+def test_noqa_wrong_code_does_not_suppress():
+    src = """
+        import jax.numpy as jnp
+        X = jnp.zeros(3, dtype=jnp.float64)  # orp: noqa[ORP002]
+    """
+    assert codes(src) == ["ORP001"]
+
+
+def test_bare_noqa_suppresses_all_rules():
+    src = """
+        import jax.numpy as jnp
+        X = jnp.zeros(3, dtype=jnp.float64)  # orp: noqa
+    """
+    assert codes(src) == []
+
+
+def test_noqa_only_covers_its_own_line():
+    src = """
+        import jax.numpy as jnp
+        A = jnp.zeros(3, dtype=jnp.float64)  # orp: noqa[ORP001]
+        B = jnp.ones(3, dtype=jnp.float64)
+    """
+    found = lint(src)
+    assert [f.rule for f in found] == ["ORP001"]
+    assert found[0].line == 4
+
+
+# -- engine / output contract ------------------------------------------------
+
+
+def test_select_restricts_rules():
+    src = ORP001_POS + ORP005_POS
+    assert set(codes(src, select=["ORP005"])) == {"ORP005"}
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint(src, select=["ORP999"])
+
+
+def test_syntax_error_reports_orp000():
+    found = lint_source("def broken(:\n", path="bad.py")
+    assert [f.rule for f in found] == ["ORP000"]
+    # a typo'd --select still fails loudly even on an unparsable file
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_source("def broken(:\n", path="bad.py", select=["ORP999"])
+
+
+def test_json_output_schema():
+    findings = lint(ORP001_POS + ORP004_POS)
+    doc = json.loads(format_json(findings))
+    assert doc["version"] == JSON_SCHEMA_VERSION
+    assert set(doc) == {"version", "findings", "counts", "rules"}
+    assert doc["counts"]["ORP001"] == 3
+    for f in doc["findings"]:
+        assert set(f) == {"path", "line", "col", "rule", "message"}
+        assert f["path"] == "fixture.py" and f["line"] >= 1
+    assert set(doc["rules"]) == set(RULES)
+    # human renderer: one clickable path:line:col line per finding + summary
+    human = format_findings(findings)
+    assert human.count("fixture.py:") == len(findings)
+    assert "finding(s)" in human
+
+
+def test_clean_run_renders_clean():
+    assert format_findings([]) == "orp lint: clean"
+    assert json.loads(format_json([]))["findings"] == []

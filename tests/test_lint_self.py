@@ -1,0 +1,146 @@
+"""Tier-1 CI gates for the lint layer: the package lints itself clean, and
+the runtime compile auditor (orp_tpu/lint/trace_audit.py) pins the two
+compile-stability invariants the static rules cannot prove:
+
+- the serve engine compiles exactly once per shape bucket;
+- the backward walk compiles a constant number of programs regardless of
+  date count (first-date + warm fit configs only).
+"""
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orp_tpu.lint import (
+    CompileAudit,
+    CompileBudgetExceeded,
+    compile_count,
+    format_findings,
+    lint_paths,
+    watch_serve_engine,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_package_lints_clean():
+    """The acceptance gate: `orp lint orp_tpu` exits 0 on this tree. Every
+    intentional hazard site carries a reasoned `# orp: noqa[RULE]`."""
+    findings = lint_paths([REPO / "orp_tpu"])
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_repo_scripts_lint_clean():
+    """tools/lint_all.py's wider surface (tools, examples, benchmarks)."""
+    findings = lint_paths([
+        REPO / "tools", REPO / "examples", REPO / "benchmarks",
+        REPO / "bench.py", REPO / "tests" / "conftest.py",
+    ])
+    assert findings == [], "\n" + format_findings(findings)
+
+
+# -- compile auditor ---------------------------------------------------------
+
+
+def test_compile_count_requires_jitted_callable():
+    with pytest.raises(TypeError, match="executable cache"):
+        compile_count(lambda x: x)
+
+
+def test_compile_audit_counts_and_enforces():
+    f = jax.jit(lambda x: x + 1)
+    audit = CompileAudit()
+    audit.watch("f", f, budget=1)
+    with audit:
+        f(jnp.ones(3))
+        f(jnp.ones(3))  # cache hit: not a compile
+    assert audit.deltas() == {"f": 1}
+    # budget is a ceiling on NEW compiles per audited region: a second
+    # region re-snapshots, and a fresh shape inside it blows a 0 budget
+    audit2 = CompileAudit()
+    audit2.watch("f", f, budget=0)
+    with pytest.raises(CompileBudgetExceeded, match="f: 1 compiles"):
+        with audit2:
+            f(jnp.ones(7))
+    # an exception in flight propagates untouched (no budget masking)
+    audit3 = CompileAudit()
+    audit3.watch("f", f, budget=0)
+    with pytest.raises(ZeroDivisionError):
+        with audit3:
+            f(jnp.ones(11))
+            1 / 0
+
+
+def _tiny_policy():
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    return european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=256, T=1.0, dt=1 / 4, rebalance_every=2),  # 2 dates
+        TrainConfig(dual_mode="mse_only", epochs_first=10, epochs_warm=5,
+                    batch_size=256),
+    )
+
+
+def test_serve_engine_compiles_once_per_bucket():
+    """Audited ground truth for PR 1's one-compile-per-bucket contract: the
+    jit executable cache grows once per DISTINCT bucket, never per request,
+    batch size, or date — and a repeat sweep compiles nothing."""
+    from orp_tpu.serve import HedgeEngine
+
+    policy = _tiny_policy()
+    engine = HedgeEngine(policy)
+    audit = watch_serve_engine(CompileAudit(), budget=2)
+    with audit:
+        for date in range(engine.n_dates):
+            for n in (1, 5, 8, 100, 128):   # buckets {8, 128} only
+                engine.evaluate(date, np.ones((n, 1), np.float32))
+    assert audit.deltas()["serve_eval"] == 2
+    assert engine.cache_info()["xla_compiles"] == 2
+    assert engine.cache_info()["buckets"] == [8, 128]
+    # warm path: a second full sweep may not compile a single new program
+    with watch_serve_engine(CompileAudit(), budget=0):
+        for n in (1, 5, 8, 100, 128):
+            engine.evaluate(0, np.ones((n, 1), np.float32))
+    assert engine.cache_info()["xla_compiles"] == 2
+
+
+def _walk(n_dates, audit=None):
+    from orp_tpu.models.mlp import HedgeMLP
+    from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
+    from orp_tpu.train.backward import BackwardConfig, backward_induction
+
+    S0 = 100.0
+    grid = TimeGrid(1.0, n_dates)
+    idx = jnp.arange(128, dtype=jnp.uint32)
+    S = simulate_gbm_log(idx, grid, S0, 0.08, 0.15, seed=1234)
+    B = bond_curve(grid, 0.08)
+    payoff = payoffs.call(S[:, -1], 100.0)
+    cfg = BackwardConfig(epochs_first=5, epochs_warm=3, dual_mode="mse_only",
+                         batch_size=128, lr=1e-3)
+    return backward_induction(
+        HedgeMLP(n_features=1), (S / S0)[:, :, None], S / S0, B / S0,
+        payoff / S0, cfg, compile_audit=audit,
+    )
+
+
+def test_backward_walk_compile_count_constant_in_dates():
+    """The walk's shape-stability contract: date t's programs are the same
+    executables for every t, so a 3-date and a 6-date walk compile the SAME
+    set — the 6-date walk adds zero. (A leaked per-date shape or static
+    would fail the second audit, exactly the 10x-slow-TPU-walk bug.)"""
+    audit1 = CompileAudit()
+    with audit1:
+        _walk(3, audit=audit1)
+    d1 = audit1.deltas()
+    # at most one compile per fit config (first-date epochs + warm epochs)
+    assert d1["fit"] <= 2
+    assert d1["date_outputs"] <= 1
+    # doubling the date count compiles NOTHING new anywhere in the walk
+    audit2 = CompileAudit()
+    with audit2:
+        _walk(6, audit=audit2)
+    assert sum(audit2.deltas().values()) == 0, audit2.deltas()

@@ -149,8 +149,13 @@ def _euro_setup(n_paths=2048, n_steps=4):
 def test_backward_induction_prices_european_call():
     S0, K, r, sigma, T, S, B, payoff = _euro_setup()
     model = HedgeMLP(n_features=1, constrain_self_financing=True)
+    # Gauss-Newton + exact readout: deterministic full-batch training, so the
+    # pin tests the WALK's converged price, not Adam's minibatch noise (which
+    # left this just over tolerance, +15.2% — PR 3 triage; GN/final_solve and
+    # Adam+final_solve all converge to the same +13.9% at this 4-date size)
     cfg = BackwardConfig(
         epochs_first=300, epochs_warm=100, dual_mode="mse_only", batch_size=512, lr=1e-3,
+        optimizer="gauss_newton", final_solve=True,
     )
     res = backward_induction(
         model, (S / S0)[:, :, None], S / S0, B / S0, payoff / S0, cfg,
@@ -367,9 +372,11 @@ def test_final_solve_walk_guarantees_at_first_fit():
 
 
 def test_gn_fit_matches_adam_quality_in_few_iters():
-    # the 97-param MSE regression: ~16 LM-damped GN iterations from a COLD
-    # init reach (or beat) hundreds of Adam minibatch steps; at 20 the fit is
-    # near-exact (warm-started walk dates need far fewer — SCALING.md §3c)
+    # the 97-param MSE regression: ~24 LM-damped GN iterations from a COLD
+    # init beat hundreds of Adam minibatch steps; at 32 the fit is near-exact
+    # (warm-started walk dates need far fewer — SCALING.md §3c). The knee
+    # moved from ~16 to ~24 with r3's gentler default LM damping (PR 3
+    # triage: 16→2.4e-3, 20→2.3e-3, 24→1.8e-4, 32→2e-8 vs Adam 1.3e-3)
     from orp_tpu.train.gn import GNConfig, fit_gn
 
     m = HedgeMLP(n_features=1)
@@ -385,11 +392,11 @@ def test_gn_fit_matches_adam_quality_in_few_iters():
     )
     p_gn, aux_gn = fit_gn(
         p0, s[:, None], prices, target, jax.random.key(3),
-        value_fn=m.value, loss_fn=losses.mse, cfg=GNConfig(n_iters=16),
+        value_fn=m.value, loss_fn=losses.mse, cfg=GNConfig(n_iters=24),
     )
     assert float(aux_gn["final_loss"]) <= float(aux_adam["final_loss"]) * 1.05
     hist = np.asarray(aux_gn["loss_history"])
-    assert int(aux_gn["n_epochs_ran"]) <= 16
+    assert int(aux_gn["n_epochs_ran"]) <= 24
     assert np.isfinite(hist).any()
 
 

@@ -34,8 +34,8 @@ def main():
     bits = 23
     n = 1 << bits
 
-    f32 = jax.jit(lambda u: jax.scipy.special.ndtri(u))
-    expf = jax.jit(lambda z: jnp.exp(a * z))
+    f32 = jax.jit(lambda u: jax.scipy.special.ndtri(u))  # orp: noqa[ORP003] -- probe jit, built once per run
+    expf = jax.jit(lambda z: jnp.exp(a * z))  # orp: noqa[ORP003] -- probe jit, built once per run
 
     # f64 accumulators over the full grid
     sums = dict(z=0.0, z2=0.0, e=0.0, z64=0.0, z642=0.0, e64=0.0)

@@ -105,7 +105,7 @@ def main(n_log2=20):
     jax.block_until_ready(p1_first)
     stamps["fit_first_cold"] = time.perf_counter() - t0
     t0 = time.perf_counter()
-    p1_warmrun, _ = fit(
+    p1_warmrun, _ = fit(  # orp: noqa[ORP004] -- same key on purpose: times the IDENTICAL program warm vs cold
         params1, features[:, t], prices_all[:, t + 1], terminal, ka,
         value_fn=model.value, loss_fn=mse, cfg=fit_cfg_first,
         metric_fns=metric_fns,
