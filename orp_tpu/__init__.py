@@ -18,6 +18,10 @@ Subpackages (layer map mirrors SURVEY.md §1):
 - ``calib``    side  CIR parameter calibration (OLS closed form)
 - ``parallel``     mesh / sharding / distributed-quantile utilities
 - ``api``      L7  config-driven entry points (``replicating_portfolio`` etc.)
+- ``serve``    L8  exportable policy bundles + batched low-latency serving
+- ``lint``     JAX/TPU-aware static analyzer + runtime compile auditor
+- ``obs``      telemetry spine: metrics registry, device-complete spans,
+               JSONL/Prometheus sinks, run manifests (zero-cost when off)
 - ``utils``    oracles (Black-Scholes greeks, Heston CF, CRR tree),
                checkpointing, profiling, matmul-precision policy
 """

@@ -38,6 +38,8 @@ from orp_tpu.api.config import (
     StochVolConfig,
     TrainConfig,
 )
+from orp_tpu.obs import bind_manifest, config_fingerprint
+from orp_tpu.obs import span as obs_span
 from orp_tpu.qmc.pallas_mf import (heston_log_pallas, heston_qe_pallas,
                                    pension_pallas)
 from orp_tpu.qmc.pallas_sobol import gbm_log_pallas
@@ -221,6 +223,19 @@ def _check_policy_compat(name, trained, model, n_dates):
     return model if trained_model is None else trained_model
 
 
+def _bind_run_manifest(pipeline: str, *configs) -> None:
+    """Bind this run's identity to the active telemetry session (no-op when
+    telemetry is off): the manifest a ``--telemetry DIR`` run writes records
+    the CONFIG FINGERPRINT of the pipeline that actually executed, so the
+    artifact can be string-verified against a reconstructed config
+    (acceptance contract pinned in tests/test_obs.py). ``configs`` must
+    include EVERY run-shaping argument — the config objects plus the bare
+    keyword knobs (``quantile_method``, the basket ``instruments`` mode) —
+    or two materially different runs would fingerprint identically."""
+    bind_manifest(pipeline=pipeline,
+                  run_fingerprint=config_fingerprint(*configs))
+
+
 def _maybe_export(result: "PipelineResult", export_dir) -> "PipelineResult":
     """Shared ``export_dir`` hook: persist the trained policy as a serve
     bundle right after training (orp_tpu/serve/bundle.py)."""
@@ -314,9 +329,13 @@ def european_hedge(
     [::7] slice of 366 knots silently drops day 365; see module docstring).
     """
     _check_quantile_method(quantile_method)
+    _bind_run_manifest("european_hedge", euro, sim, train,
+                       f"quantile_method={quantile_method}")
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    s = _simulate_euro_paths(euro, sim, mesh, grid, "european_hedge")
+    with obs_span("pipeline/simulate") as sp:
+        s = sp.set_result(
+            _simulate_euro_paths(euro, sim, mesh, grid, "european_hedge"))
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, euro.r, dtype)
     payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
@@ -340,17 +359,18 @@ def european_hedge(
         bias_init=bias,
     )
     times = np.asarray(coarse.times())
-    report = build_report(
-        res,
-        terminal_payoff=payoff / s0,
-        r=euro.r,
-        times=times,
-        adjustment_factor=s0,
-        holdings_adjustment=1.0,
-        quantile_method=quantile_method,
-    )
-    _attach_cv_price(report, res, s, payoff, euro.r, times,
-                     strike_over_s0=euro.strike / euro.s0)
+    with obs_span("pipeline/report"):
+        report = build_report(
+            res,
+            terminal_payoff=payoff / s0,
+            r=euro.r,
+            times=times,
+            adjustment_factor=s0,
+            holdings_adjustment=1.0,
+            quantile_method=quantile_method,
+        )
+        _attach_cv_price(report, res, s, payoff, euro.r, times,
+                         strike_over_s0=euro.strike / euro.s0)
     return _maybe_export(
         PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                        sim_seed=sim.seed_fund,
@@ -455,9 +475,13 @@ def heston_hedge(
     unbiased CV price (discounted S is still a Q-martingale under Heston)."""
     _check_quantile_method(quantile_method)
     h = heston or HestonConfig()
+    _bind_run_manifest("heston_hedge", h, sim, train,
+                       f"quantile_method={quantile_method}")
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
-    traj = _simulate_heston_paths(h, sim, mesh, grid, "heston_hedge")
+    with obs_span("pipeline/simulate") as sp:
+        traj = sp.set_result(
+            _simulate_heston_paths(h, sim, mesh, grid, "heston_hedge"))
     s, v = traj["S"], traj["v"]
     coarse = grid.reduced(sim.rebalance_every)
     b = bond_curve(coarse, h.r, dtype)
@@ -473,13 +497,14 @@ def heston_hedge(
         bias_init=(e_payoff_n, 0.0),
     )
     times = np.asarray(coarse.times())
-    report = build_report(
-        res, terminal_payoff=payoff / s0, r=h.r, times=times,
-        adjustment_factor=s0, holdings_adjustment=1.0,
-        quantile_method=quantile_method,
-    )
-    _attach_cv_price(report, res, s, payoff, h.r, times,
-                     strike_over_s0=h.strike / h.s0)
+    with obs_span("pipeline/report"):
+        report = build_report(
+            res, terminal_payoff=payoff / s0, r=h.r, times=times,
+            adjustment_factor=s0, holdings_adjustment=1.0,
+            quantile_method=quantile_method,
+        )
+        _attach_cv_price(report, res, s, payoff, h.r, times,
+                         strike_over_s0=h.strike / h.s0)
     return _maybe_export(
         PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
                        sim_seed=sim.seed_fund,
@@ -641,8 +666,14 @@ def basket_hedge(
     ``oracle_mm``. Scan engine only (the Pallas kernels cover the
     single-asset systems)."""
     _check_quantile_method(quantile_method)
-    (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
-     hedge_prices) = _basket_setup(basket, sim, mesh, instruments, "basket_hedge")
+    _bind_run_manifest("basket_hedge", basket, sim, train,
+                       f"instruments={instruments}",
+                       f"quantile_method={quantile_method}")
+    with obs_span("pipeline/simulate") as sp:
+        (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
+         hedge_prices) = _basket_setup(basket, sim, mesh, instruments,
+                                       "basket_hedge")
+        sp.set_result(s)
     e_payoff_n = float(jnp.mean(payoff)) / norm
     if vector:
         # normalised prices are ~s0_i/norm at t=0: spread the expected payoff
@@ -661,10 +692,11 @@ def basket_hedge(
         _backward_cfg(train),
         bias_init=bias,
     )
-    report, times = _basket_report(
-        basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
-        quantile_method,
-    )
+    with obs_span("pipeline/report"):
+        report, times = _basket_report(
+            basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
+            quantile_method,
+        )
     return _maybe_export(
         PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
                        sim_seed=sim.seed_fund,
@@ -774,10 +806,14 @@ def pension_hedge(
     """
     _check_quantile_method(quantile_method)
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
+    _bind_run_manifest("pension_hedge", cfg,
+                       f"quantile_method={quantile_method}")
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
 
-    traj = _simulate_pension_paths(cfg, mesh, grid, "pension_hedge")
+    with obs_span("pipeline/simulate") as sp:
+        traj = sp.set_result(
+            _simulate_pension_paths(cfg, mesh, grid, "pension_hedge"))
     y, lam, pop = traj["Y"], traj["lam"], traj["N"]
     coarse = grid.reduced(s.rebalance_every)
     b = bond_curve(coarse, m.r, dtype)
@@ -796,14 +832,15 @@ def pension_hedge(
     )
     adjustment = a.n0 * a.premium
     times = np.asarray(coarse.times())
-    report = build_report(
-        res,
-        terminal_payoff=terminal,
-        r=m.r,
-        times=times,
-        adjustment_factor=adjustment,
-        quantile_method=quantile_method,
-    )
+    with obs_span("pipeline/report"):
+        report = build_report(
+            res,
+            terminal_payoff=terminal,
+            r=m.r,
+            times=times,
+            adjustment_factor=adjustment,
+            quantile_method=quantile_method,
+        )
     return _maybe_export(
         PipelineResult(
             report=report, backward=res, times=times, adjustment_factor=adjustment,

@@ -38,6 +38,13 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing); exits
                 non-zero on findings so it gates commits (tools/lint_all.py)
+
+Every training command (and ``serve-bench``) accepts ``--telemetry DIR``: the
+run executes under an ``orp_tpu.obs`` session and drops a telemetry bundle —
+``events.jsonl`` (schema-versioned span/counter events), ``metrics.prom``
+(Prometheus text exposition) and ``manifest.json`` (config fingerprint,
+jax/jaxlib versions, platform, git rev) — in DIR. Without the flag the
+instrumentation is the obs no-op path and costs nothing.
 """
 
 from __future__ import annotations
@@ -105,6 +112,14 @@ def _add_train_flags(p):
                         "products over row blocks of this size (O(block*P) "
                         "fit memory; 1.5x faster walk on CPU)")
     p.add_argument("--json", action="store_true", help="emit a JSON result line")
+    _add_telemetry_flag(p)
+
+
+def _add_telemetry_flag(p):
+    p.add_argument("--telemetry", default=None, metavar="DIR",
+                   help="run under an orp_tpu.obs telemetry session and drop "
+                        "events.jsonl + metrics.prom + manifest.json in DIR "
+                        "(spans, counters, run provenance; off = zero-cost)")
 
 
 def _add_export_flag(p):
@@ -827,6 +842,7 @@ def build_parser():
     psb.add_argument("--json", action="store_true",
                      help="accepted for uniformity with the other "
                           "subcommands; the record always prints as JSON")
+    _add_telemetry_flag(psb)
     psb.set_defaults(fn=cmd_serve_bench)
 
     pl = sub.add_parser(
@@ -857,6 +873,15 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    tdir = getattr(args, "telemetry", None)
+    if tdir:
+        # one session around the whole command: the pipeline binds its config
+        # fingerprint from inside (pipelines._bind_run_manifest), the session
+        # drops events.jsonl + metrics.prom + manifest.json in DIR at exit
+        from orp_tpu import obs
+
+        with obs.telemetry(tdir, manifest_extra={"cli_command": args.command}):
+            return args.fn(args)
     args.fn(args)
 
 

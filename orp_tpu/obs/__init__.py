@@ -1,0 +1,92 @@
+"""orp_tpu.obs — unified telemetry spine: spans, metrics, manifests, sinks.
+
+Observability used to be fragmented per subsystem — ``utils/profiling.trace``
+spans only in serving, ``serve/metrics.ServingMetrics`` with a one-off
+latency window, ``bench.py`` hand-rolling JSON artifacts, the lint compile
+auditor counting with no export path. This package is the shared layer they
+all route through (the Dapper discipline: low-overhead, always-available
+instrumentation with one export spine; see PAPERS.md):
+
+- ``registry``  — process-wide thread-safe counters / gauges / bounded
+                  histograms with label support (``obs.REGISTRY`` default);
+- ``spans``     — nested device-complete span timers (TraceAnnotation +
+                  wall time blocked on the result tree) with a ZERO-COST
+                  disabled mode: off by default, `span()` then returns one
+                  shared no-op — no allocation, no lock, no clock;
+- ``sink``      — schema-versioned JSONL event log (``orp-obs-v1``) +
+                  Prometheus text exposition of the registry;
+- ``manifest``  — run manifests binding artifacts to the config
+                  fingerprint, jax/jaxlib versions, platform and git rev.
+
+The one-call entry point is the session::
+
+    with obs.telemetry("runs/tonight"):
+        european_hedge(...)           # pipelines bind their fingerprint +
+                                      # emit sim/train/report spans
+    # -> runs/tonight/{events.jsonl, metrics.prom, manifest.json}
+
+which is exactly what the CLI's ``--telemetry DIR`` flag does. Instrumented
+call sites (``train/backward``, ``serve/engine``, ``serve/batcher``,
+``api/pipelines``) pay nothing until a session is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+
+from orp_tpu.obs.manifest import (MANIFEST_SCHEMA, build_manifest,
+                                  config_fingerprint, read_manifest,
+                                  write_manifest)
+from orp_tpu.obs.registry import Counter, Gauge, Histogram, Registry
+from orp_tpu.obs.sink import (SCHEMA, JsonlSink, ListSink, prometheus_text,
+                              read_events, validate_event, write_prometheus)
+from orp_tpu.obs.spans import (NOOP_SPAN, ObsState, Span, active,
+                               bind_manifest, count, disable, emit_record,
+                               enable, enabled, set_gauge, span, spanned,
+                               state, timed)
+
+#: a process-wide scratch registry for ad-hoc, session-independent
+#: instruments. NOTE: ``telemetry()`` exports its OWN per-session registry
+#: (fresh by default — bundles describe one run); to publish a façade's
+#: series into the bundle, pass ``obs.state().registry`` (or hand
+#: ``telemetry(registry=...)`` this one explicitly)
+REGISTRY = Registry()
+
+EVENTS_FILE = "events.jsonl"
+METRICS_FILE = "metrics.prom"
+
+
+@contextlib.contextmanager
+def telemetry(directory: str | pathlib.Path | None = None, *,
+              registry: Registry | None = None,
+              run_fingerprint: str | None = None,
+              manifest_extra: dict | None = None):
+    """One telemetry session: enable the spine, export a bundle at exit.
+
+    With ``directory`` set, drops ``events.jsonl`` (streamed live),
+    ``metrics.prom`` and ``manifest.json`` there; with ``directory=None``
+    events go to an in-memory ``ListSink`` (introspection without files).
+    The manifest's ``run_fingerprint`` can be passed here or bound from
+    inside the session by the pipeline (``obs.bind_manifest``) — the
+    pipeline's binding wins, since it knows the actual run config.
+    """
+    reg = registry if registry is not None else Registry()
+    sink = (JsonlSink(pathlib.Path(directory) / EVENTS_FILE)
+            if directory is not None else ListSink())
+    st = enable(reg, sink)
+    if run_fingerprint is not None:
+        st.manifest_extra.setdefault("run_fingerprint", run_fingerprint)
+    if manifest_extra:
+        st.manifest_extra.update(manifest_extra)
+    try:
+        yield st
+    finally:
+        disable()
+        if directory is not None:
+            d = pathlib.Path(directory)
+            extra = dict(st.manifest_extra)
+            fp = extra.pop("run_fingerprint", None)
+            write_prometheus(d / METRICS_FILE, reg)
+            write_manifest(d, run_fingerprint=fp, extra=extra)
+        sink.close()

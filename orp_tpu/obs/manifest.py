@@ -1,0 +1,104 @@
+"""Run manifests: bind every telemetry artifact to its provenance.
+
+A ``manifest.json`` answers "what exactly produced these numbers?" — the
+question every ``BENCH_r*.json`` re-read eventually asks. It records:
+
+- the run-config fingerprint (``config_fingerprint`` — the same repr-based
+  discipline as ``utils/fingerprint``'s side files, so a manifest can be
+  string-compared against a reconstructed config);
+- the numerics environment: jax/jaxlib versions, device platform and count
+  (the bf16-matmul and f32-log defects of SCALING.md §6 were PLATFORM
+  bugs — a recorded number without its platform is unreviewable);
+- the code identity: git revision + dirty flag (best-effort — a deployed
+  wheel has no .git and the manifest must still write).
+
+``write_manifest`` is what the ``--telemetry DIR`` session drops next to
+``events.jsonl`` and ``metrics.prom``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+MANIFEST_SCHEMA = "orp-obs-manifest-v1"
+MANIFEST_FILE = "manifest.json"
+
+
+def config_fingerprint(*configs) -> str:
+    """Canonical fingerprint of a run configuration: the joined reprs of its
+    (frozen-dataclass) config objects. Same property the checkpoint/bundle
+    fingerprints lean on — reprs are total over fields, so ANY config change
+    changes the string; equal configs always agree."""
+    return " | ".join(repr(c) for c in configs)
+
+
+def git_revision(cwd: str | pathlib.Path | None = None) -> dict:
+    """``{"rev": str | None, "dirty": bool | None}`` — best-effort (no git,
+    no repo, or a timeout all degrade to None rather than failing the run)."""
+    base = pathlib.Path(cwd) if cwd else pathlib.Path(__file__).resolve().parent
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=base, capture_output=True,
+            text=True, timeout=10,
+        )
+        if rev.returncode != 0:
+            return {"rev": None, "dirty": None}
+        status = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=base, capture_output=True,
+            text=True, timeout=10,
+        )
+        return {
+            "rev": rev.stdout.strip(),
+            "dirty": bool(status.stdout.strip()) if status.returncode == 0 else None,
+        }
+    except (OSError, subprocess.SubprocessError):
+        return {"rev": None, "dirty": None}
+
+
+def build_manifest(*, run_fingerprint: str | None = None,
+                   extra: dict | None = None) -> dict:
+    """Assemble the manifest dict. Imports jax lazily so manifest writing
+    works even in half-broken environments where the run itself failed."""
+    m: dict = {
+        "schema": MANIFEST_SCHEMA,
+        "created_unix": time.time(),
+        "run_fingerprint": run_fingerprint,
+        "python": sys.version.split()[0],
+        "argv": list(sys.argv),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        m["jax_version"] = jax.__version__
+        m["jaxlib_version"] = jaxlib.__version__
+        devs = jax.devices()
+        m["platform"] = devs[0].platform
+        m["device_count"] = len(devs)
+    except Exception as e:  # noqa: BLE001 — provenance must not kill the run
+        m["jax_error"] = f"{type(e).__name__}: {e}"
+    m["git"] = git_revision()
+    if extra:
+        m.update(extra)
+    return m
+
+
+def write_manifest(directory: str | pathlib.Path, *,
+                   run_fingerprint: str | None = None,
+                   extra: dict | None = None) -> pathlib.Path:
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / MANIFEST_FILE
+    path.write_text(json.dumps(
+        build_manifest(run_fingerprint=run_fingerprint, extra=extra),
+        indent=1, sort_keys=False) + "\n")
+    return path
+
+
+def read_manifest(directory: str | pathlib.Path) -> dict:
+    return json.loads(
+        (pathlib.Path(directory) / MANIFEST_FILE).read_text())

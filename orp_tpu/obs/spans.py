@@ -1,0 +1,259 @@
+"""Nested, device-complete span timers with a zero-cost disabled mode.
+
+A span is the framework's unit of "where did the time go": it wraps
+``jax.profiler.TraceAnnotation`` (so enabled runs still show up as named
+regions in an XProf/TensorBoard capture, like ``utils/profiling.trace``
+always did) AND records a wall-clock duration that is DEVICE-COMPLETE —
+hand the span the result tree via ``set_result`` and the clock stops only
+after ``jax.block_until_ready``, so recorded durations are device time,
+not dispatch time (the reference's own benchmark bug, lint rule ORP007).
+
+Completed spans are double-routed: an event to the active sink
+(``obs/sink.py`` JSONL) and a ``span_seconds{name=...}`` histogram +
+``spans_total{name=...}`` counter in the active registry. Nesting is
+tracked per thread; each event carries its parent span's name.
+
+**Disabled mode is the default and costs nothing.** Until ``enable()`` is
+called, ``span(...)`` returns one process-wide no-op singleton — no
+allocation, no lock, no TraceAnnotation, no clock read — and ``count``/
+``set_gauge`` return before touching any instrument. The north-star warm
+walk with telemetry off must be indistinguishable from a build without
+this module (pinned by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from orp_tpu.obs.registry import Registry
+
+_tls = threading.local()
+
+
+class ObsState:
+    """The active telemetry wiring: one registry + optionally one sink."""
+
+    def __init__(self, registry: Registry | None = None, sink=None):
+        self.registry = registry if registry is not None else Registry()
+        self.sink = sink
+        self.manifest_extra: dict = {}
+
+
+_STATE: ObsState | None = None
+
+
+def enable(registry: Registry | None = None, sink=None) -> ObsState:
+    """Switch telemetry on process-wide; returns the active state."""
+    global _STATE
+    _STATE = ObsState(registry, sink)
+    return _STATE
+
+
+def disable() -> None:
+    global _STATE
+    _STATE = None
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def state() -> ObsState | None:
+    return _STATE
+
+
+@contextlib.contextmanager
+def active(registry: Registry | None = None, sink=None):
+    """``enable``/``disable`` as a scope (the ``obs.telemetry`` session
+    builds on this)."""
+    st = enable(registry, sink)
+    try:
+        yield st
+    finally:
+        disable()
+
+
+class _NoopSpan:
+    """The disabled-mode span: one shared instance, every method a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_result(self, result):
+        return result
+
+    def annotate(self, **attrs):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def _span_stack() -> list:
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+class Span:
+    """One live span. Use via ``with span("phase") as sp: ... sp.set_result(out)``."""
+
+    __slots__ = ("name", "attrs", "_state", "_annotation", "_t0", "_result",
+                 "parent")
+
+    def __init__(self, state: ObsState, name: str, attrs: dict | None):
+        self.name = name
+        self.attrs = dict(attrs) if attrs else {}
+        self._state = state
+        self._result = None
+        self.parent = None
+        import jax
+
+        self._annotation = jax.profiler.TraceAnnotation(name)
+
+    def set_result(self, result):
+        """Register the device result tree the span must block on before its
+        clock stops. Returns ``result`` unchanged (so call sites can wrap a
+        producing expression)."""
+        self._result = result
+        return result
+
+    def annotate(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        stack = _span_stack()
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        self._annotation.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        ok = exc_type is None
+        try:
+            if self._result is not None and ok:
+                import jax
+
+                jax.block_until_ready(self._result)
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            # cleanup + recording run even when block_until_ready raises
+            # (async device failure surfacing here): a span left on the
+            # thread-local stack would corrupt parent attribution for every
+            # later span on this thread, and an unexited TraceAnnotation
+            # would leak its profiler region open
+            dur = time.perf_counter() - self._t0
+            self._annotation.__exit__(exc_type, exc, tb)
+            stack = _span_stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            st = self._state
+            st.registry.histogram(
+                "span_seconds", {"name": self.name}).observe(dur)
+            st.registry.counter("spans_total", {"name": self.name}).inc()
+            if st.sink is not None:
+                event = {
+                    "type": "span", "name": self.name, "dur_s": round(dur, 9),
+                    "parent": self.parent, "ok": ok,
+                }
+                if self.attrs:
+                    event["attrs"] = self.attrs
+                st.sink.emit(event)
+        return False
+
+
+def span(name: str, attrs: dict | None = None):
+    """A span context manager — or the shared no-op when telemetry is off.
+
+    The disabled path is a single global load + ``is None`` test returning a
+    pre-built singleton: nothing is allocated, no lock is taken, the name
+    string is not even read."""
+    st = _STATE
+    if st is None:
+        return NOOP_SPAN
+    return Span(st, name, attrs)
+
+
+def spanned(name: str, fn):
+    """Wrap ``fn`` so each call runs inside a device-complete span. With
+    telemetry off, returns ``fn`` itself — zero per-call overhead."""
+    if _STATE is None:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        with span(name) as sp:
+            return sp.set_result(fn(*args, **kwargs))
+
+    return wrapped
+
+
+def timed(name: str, fn, *args, **kwargs):
+    """Run ``fn`` under a span and return ``(result, seconds)``, blocking on
+    the result tree either way — the ``utils/profiling.timed`` contract with
+    the measurement recorded when telemetry is on."""
+    import jax
+
+    t0 = time.perf_counter()
+    with span(name) as sp:
+        out = sp.set_result(fn(*args, **kwargs))
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def count(name: str, n: int = 1, *, sink_event: bool = True, **labels) -> None:
+    """Increment ``name`` in the active registry; mirrored to the sink as a
+    counter event unless ``sink_event=False`` (hot paths — e.g. the serve
+    engine's per-request counters — stay registry-only so the event log and
+    its write lock aren't hit once per request; the totals still export via
+    the registry/``metrics.prom``). No-op (no instrument lookup, no lock)
+    when telemetry is off."""
+    st = _STATE
+    if st is None:
+        return
+    st.registry.counter(name, labels or None).inc(n)
+    if sink_event and st.sink is not None:
+        st.sink.emit({"type": "counter", "name": name, "inc": n,
+                      "labels": labels or {}})
+
+
+def emit_record(name: str, payload: dict) -> None:
+    """Emit a tool's result record as one schema-stamped ``record`` event on
+    the active sink (the bench/profile artifact path). No-op when telemetry
+    is off or the session has no sink."""
+    st = _STATE
+    if st is None or st.sink is None:
+        return
+    st.sink.emit({"type": "record", "name": name, **payload})
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    """Set ``name`` in the active registry; mirrored to the sink. No-op when
+    telemetry is off."""
+    st = _STATE
+    if st is None:
+        return
+    st.registry.gauge(name, labels or None).set(value)
+    if st.sink is not None:
+        st.sink.emit({"type": "gauge", "name": name, "value": float(value),
+                      "labels": labels or {}})
+
+
+def bind_manifest(**fields) -> None:
+    """Attach run-identity fields (e.g. the pipeline's config fingerprint)
+    to the active session; ``obs.telemetry`` folds them into the manifest it
+    writes at exit. No-op when telemetry is off."""
+    st = _STATE
+    if st is None:
+        return
+    st.manifest_extra.update(fields)

@@ -26,6 +26,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import span
 from orp_tpu.serve.metrics import ServingMetrics
 
 _STOP = object()
@@ -149,7 +151,13 @@ class MicroBatcher:
                 feats = np.concatenate([r.features for r in reqs], axis=0)
                 pr = (np.concatenate([r.prices for r in reqs], axis=0)
                       if has_prices else None)
-                phi, psi, value = self.engine.evaluate(date_idx, feats, pr)
+                obs_count("serve/batcher_dispatches")
+                obs_count("serve/batcher_coalesced_requests", len(reqs))
+                with span("serve/batch", attrs={"requests": len(reqs),
+                                                "rows": int(feats.shape[0])}):
+                    # no set_result: evaluate() blocks device-side internally,
+                    # so the span is already device-complete
+                    phi, psi, value = self.engine.evaluate(date_idx, feats, pr)
             except Exception as e:  # noqa: BLE001 — delivered per-future
                 for r in reqs:
                     if not r.future.set_running_or_notify_cancel():

@@ -25,11 +25,31 @@ import time
 
 import numpy as np
 
+from orp_tpu import obs
 from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.engine import HedgeEngine
 from orp_tpu.serve.metrics import ServingMetrics
 
 DEFAULT_BATCH_SIZES = (1, 7, 64, 1000)
+
+
+def _phase_metrics(phase: str) -> ServingMetrics:
+    """A recorder for one bench phase. Under an active telemetry session the
+    instruments intern into the session registry (label ``phase=...`` keeps
+    the two phases' series apart), so ``metrics.prom`` carries the serving
+    percentiles; otherwise each phase gets its own private registry exactly
+    as before."""
+    st = obs.state()
+    m = ServingMetrics(
+        registry=st.registry if st is not None else None,
+        labels={"phase": phase} if st is not None else None,
+    )
+    # explicit per-run wipe: a second serve_bench in the SAME session
+    # re-interns these series, and this record's percentiles/throughput must
+    # describe this run only (construction itself never resets, so façades
+    # that WANT cross-run accumulation simply don't call reset)
+    m.reset()
+    return m
 
 
 def _request_stream(rng, n_requests, batch_sizes, n_dates, n_features):
@@ -69,7 +89,7 @@ def serve_bench(
         b *= 2
     warm_misses = engine.misses
 
-    metrics = ServingMetrics()
+    metrics = _phase_metrics("engine")
     for date_idx, feats in _request_stream(
             rng, n_requests, batch_sizes, engine.n_dates, n_features):
         t0 = time.perf_counter()
@@ -80,7 +100,7 @@ def serve_bench(
     served = cache["hits"] + cache["misses"]
 
     # batcher phase: a burst of single-row requests, coalesced
-    bmetrics = ServingMetrics()
+    bmetrics = _phase_metrics("batcher")
     with MicroBatcher(engine, max_batch=max(batch_sizes),
                       max_wait_us=max_wait_us, metrics=bmetrics) as mb:
         futures = [
@@ -115,6 +135,7 @@ def serve_bench(
     import jax
 
     record["platform"] = jax.devices()[0].platform
+    obs.emit_record("serve_bench", record)
     return record
 
 

@@ -13,8 +13,14 @@ The forward is the ONE definition the training walk and the replay use
 three dual-mode combines), so a served ``(phi, psi, value)`` is bit-identical
 to the corresponding ``*_oos`` ledger column on the same inputs.
 
-``trace(...)`` spans (``orp_tpu/utils/profiling.py``) wrap pad / dispatch /
-unpad so a profiler capture shows where serving time goes.
+Spans wrap pad / dispatch / unpad: under an active telemetry session
+(``orp_tpu/obs``) they land in the shared registry
+(``span_seconds{name="serve/..."}``) and event sink and annotate profiler
+captures; with telemetry off they fall back to the bare
+``utils/profiling.trace`` TraceAnnotation — exactly the pre-obs behavior,
+so an XProf capture of an untelemetered server still shows the serving
+phases (the annotation cost is what serving always paid; only the
+recording layer is new and session-gated).
 """
 
 from __future__ import annotations
@@ -26,8 +32,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from orp_tpu.lint.trace_audit import compile_count
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import enabled as obs_enabled
+from orp_tpu.obs import span as obs_span
 from orp_tpu.train.backward import _date_outputs_core, _split_holdings
 from orp_tpu.utils.profiling import trace
+
+
+def span(name, attrs=None):
+    """Telemetry span when a session is active, plain TraceAnnotation
+    otherwise (see module docstring)."""
+    return obs_span(name, attrs) if obs_enabled() else trace(name)
 
 
 @functools.partial(jax.jit, static_argnames=("model", "dual_mode", "holdings_combine"))
@@ -189,17 +204,24 @@ class HedgeEngine:
         b = self.bucket_for(n)
         if b in self._buckets:
             self.hits += 1
+            # per-request counters are registry-only (sink_event=False): a
+            # JSONL write per request would put sink-lock I/O inside the
+            # latency every caller is timing. Totals still export via
+            # metrics.prom; the RARE miss (once per bucket) keeps its event.
+            obs_count("serve/bucket_hits", sink_event=False)
         else:
             self.misses += 1
             self._buckets.add(b)
+            obs_count("serve/bucket_misses", bucket=str(b))
+        obs_count("serve/rows", n, sink_event=False)
         dt = np.dtype(jnp.dtype(self.model.dtype).name)
-        with trace("serve/pad"):
+        with span("serve/pad"):
             feats = np.zeros((b, f), dt)
             feats[:n] = states
             pr = np.zeros((b, k), dt)
             if has_prices:
                 pr[:n] = prices
-        with trace("serve/dispatch"):
+        with span("serve/dispatch", attrs={"bucket": b}):
             phi, psi, v = _eval_core(
                 self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
                 jnp.asarray(feats), jnp.asarray(pr),
@@ -210,7 +232,7 @@ class HedgeEngine:
             # block: a served result IS the deliverable — latency metrics on
             # dispatch-only timing would be fiction
             phi, psi, v = jax.block_until_ready((phi, psi, v))
-        with trace("serve/unpad"):
+        with span("serve/unpad"):
             phi = np.asarray(phi)[:n]
             psi = np.asarray(psi)[:n]
             value = np.asarray(v)[:n] if has_prices else None

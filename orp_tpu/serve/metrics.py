@@ -1,22 +1,35 @@
 """Serving metrics: latency percentiles + throughput counters.
 
-The standard inference-serving observables — per-request latency p50/p95/p99
-and request/row throughput — kept host-side and allocation-light: cumulative
-request/row counters plus a BOUNDED latency window (a deque of the most
-recent ``window`` samples) behind one lock, so an always-on server records
-forever without growing — percentiles are over the window, counts and
-throughput over the whole lifetime. Recorded latencies must be
-DEVICE-COMPLETE times: the engine blocks on the result before the caller's
-clock stops, so these are end-to-end numbers, not dispatch times.
+Since the obs spine landed this is a thin FAÇADE over ``orp_tpu.obs``
+registry instruments — a bounded ``Histogram`` for the latency window and
+two ``Counter``s for lifetime request/row counts — so serving observables
+live in the same exportable registry as every other framework metric
+(Prometheus text / JSONL via ``obs/sink.py``). The external contract is
+unchanged key-for-key: ``record(latency_s, n_rows)`` with DEVICE-COMPLETE
+latencies (the engine blocks on the result before the caller's clock
+stops), and ``summary()`` returning the same dict, same keys, same
+rounding as it always has.
+
+By default each instance owns a private registry (two concurrently
+benched phases must not pollute each other's series); to publish into a
+telemetry bundle instead, pass the ACTIVE SESSION's registry —
+``registry=obs.state().registry`` — plus distinguishing ``labels``
+(that registry is what ``obs.telemetry`` exports as ``metrics.prom``;
+``serve/bench._phase_metrics`` is the worked example).
 """
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
 
 import numpy as np
+
+from orp_tpu.obs.registry import Registry
+
+LATENCY_HISTOGRAM = "serve_request_latency_seconds"
+REQUESTS_COUNTER = "serve_requests_total"
+ROWS_COUNTER = "serve_rows_total"
 
 
 class ServingMetrics:
@@ -24,28 +37,44 @@ class ServingMetrics:
     the micro-batcher worker. ``window`` bounds the retained latency samples
     (percentiles reflect the most recent that many requests)."""
 
-    def __init__(self, *, window: int = 65536):
+    def __init__(self, *, window: int = 65536,
+                 registry: Registry | None = None,
+                 labels: dict[str, str] | None = None):
         if window < 1:
             raise ValueError(f"window={window} must be >= 1")
         self._window = int(window)
+        self.registry = registry if registry is not None else Registry()
+        self._hist = self.registry.histogram(
+            LATENCY_HISTOGRAM, labels, window=self._window)
+        self._requests = self.registry.counter(REQUESTS_COUNTER, labels)
+        self._rows = self.registry.counter(ROWS_COUNTER, labels)
+        # façade lock: record()/summary() take it around ALL their instrument
+        # touches, preserving the original one-lock atomicity (a concurrent
+        # summary never sees requests=N+1 with N window samples). The
+        # instruments' own locks nest inside — ordering is always façade ->
+        # instrument, so no deadlock.
         self._lock = threading.Lock()
-        self.reset()
+        # fresh instruments start at zero, so construction does NOT reset:
+        # a second façade over the same shared-registry series ACCUMULATES
+        # into it (the counter-natural semantics) instead of silently wiping
+        # what the first one recorded. reset() stays for explicit wipes.
+        self._t_first: float | None = None
+        self._t_last: float | None = None
 
     def reset(self) -> None:
         with self._lock:
-            self._latencies_s: collections.deque[float] = collections.deque(
-                maxlen=self._window)
-            self._n_requests = 0
-            self._rows = 0
-            self._t_first: float | None = None
-            self._t_last: float | None = None
+            self._hist.reset()
+            self._requests.reset()
+            self._rows.reset()
+            self._t_first = None
+            self._t_last = None
 
     def record(self, latency_s: float, n_rows: int = 1) -> None:
         now = time.perf_counter()
         with self._lock:
-            self._latencies_s.append(float(latency_s))
-            self._n_requests += 1
-            self._rows += int(n_rows)
+            self._hist.observe(float(latency_s))
+            self._requests.inc()
+            self._rows.inc(int(n_rows))
             if self._t_first is None:
                 self._t_first = now - latency_s  # window opens at first submit
             self._t_last = now
@@ -53,7 +82,7 @@ class ServingMetrics:
     @property
     def requests(self) -> int:
         with self._lock:
-            return self._n_requests
+            return self._requests.value
 
     def summary(self) -> dict:
         """One flat dict: lifetime request/row counts and throughput, latency
@@ -61,9 +90,9 @@ class ServingMetrics:
         all zeros (a bench that produced nothing should emit an honest
         record, not crash)."""
         with self._lock:
-            lat = np.asarray(self._latencies_s, np.float64)
-            n_requests = self._n_requests
-            rows = self._rows
+            lat = self._hist.snapshot()
+            n_requests = self._requests.value
+            rows = self._rows.value
             elapsed = (
                 (self._t_last - self._t_first)
                 if self._t_first is not None else 0.0

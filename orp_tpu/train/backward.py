@@ -50,6 +50,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from orp_tpu.models.mlp import HedgeMLP
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import enabled as obs_enabled
+from orp_tpu.obs import span as obs_span
+from orp_tpu.obs import spanned as obs_spanned
 from orp_tpu.utils.precision import highest_matmul_precision
 from orp_tpu.train import losses as L
 from orp_tpu.train.fit import FitConfig, fit, fit_core
@@ -513,11 +517,50 @@ def backward_induction(
     ``compile_audit``: optional ``orp_tpu.lint.CompileAudit`` — registers the
     walk's jitted pieces so the caller's audit region can enforce the walk's
     shape-stability contract (compile count independent of date count;
-    first-date + warm fit configs only). See orp_tpu/lint/trace_audit.py."""
+    first-date + warm fit configs only). See orp_tpu/lint/trace_audit.py.
+
+    Under an active telemetry session (``orp_tpu.obs``) the walk emits a
+    device-complete ``train/walk`` span, per-date ``train/fit`` /
+    ``train/fit_quantile`` / ``train/outputs`` spans on the host-loop path,
+    and per-callable ``train/xla_compiles`` counters from a count-only
+    ``CompileAudit`` region. With telemetry off (the default) none of this
+    runs — the walk is byte-for-byte the uninstrumented code path."""
     if compile_audit is not None:
         from orp_tpu.lint.trace_audit import watch_backward_walk
 
         watch_backward_walk(compile_audit)
+    args = (model, features, y_prices, b_prices, terminal_values, cfg)
+    if not obs_enabled():
+        return _walk_impl(*args, bias_init=bias_init)
+    from orp_tpu.lint.trace_audit import CompileAudit, watch_backward_walk
+
+    # count-only audit (no budgets): telemetry OBSERVES compiles, the
+    # budget-enforcing path stays the caller's explicit compile_audit
+    audit = watch_backward_walk(
+        CompileAudit(), fit_budget=None, outputs_budget=None)
+    with obs_span("train/walk", attrs={
+        "n_paths": int(y_prices.shape[0]),
+        "n_dates": int(y_prices.shape[1]) - 1,
+        "fused": cfg.fused, "optimizer": cfg.optimizer,
+        "dual_mode": cfg.dual_mode,
+    }) as sp, audit:
+        res = _walk_impl(*args, bias_init=bias_init)
+        sp.set_result(res.values)
+    for name, delta in audit.deltas().items():
+        obs_count("train/xla_compiles", delta, fn=name)
+    return res
+
+
+def _walk_impl(
+    model: HedgeMLP,
+    features: jax.Array,
+    y_prices: jax.Array,
+    b_prices: jax.Array,
+    terminal_values: jax.Array,
+    cfg: BackwardConfig,
+    *,
+    bias_init: tuple[float, ...] | None = None,
+) -> BackwardResult:
     n_paths, n_knots = y_prices.shape[:2]
     n_dates = n_knots - 1
     dtype = model.dtype
@@ -629,6 +672,18 @@ def backward_induction(
                 params2 = params1
             start_step = last + 1
 
+    # per-date telemetry spans ride wrapper closures built ONCE here:
+    # obs_spanned returns the callable itself when telemetry is off, so the
+    # disabled-mode loop passes the exact same objects it always did
+    walk_gn = cfg.optimizer == "gauss_newton"
+    fit_fn_sp = obs_spanned("train/fit", fit_gn_jit if walk_gn else fit)
+    outputs_fn_sp = obs_spanned("train/outputs", _date_outputs)
+    q_fit_fn_sp = (
+        obs_spanned("train/fit_quantile",
+                    fit_gn_pinball_jit if cfg.gn_quantile else fit)
+        if walk_gn else None
+    )
+
     for step_i, t in enumerate(range(n_dates - 1, -1, -1)):
         kfit, ka, kb = jax.random.split(kfit, 3)
         if step_i < start_step:
@@ -659,9 +714,9 @@ def backward_induction(
             model, cfg, params1, params2,
             features[:, t], prices_all[:, t], prices_all[:, t + 1],
             values[:, t + 1], ka, kb, fit_cfg, mse, q_loss, metric_fns,
-            fit_fn=fit_gn_jit if gn else fit, value_fn=_value,
-            outputs_fn=_date_outputs,
-            q_fit_fn=(fit_gn_pinball_jit if gn_q else fit) if gn else None,
+            fit_fn=fit_fn_sp, value_fn=_value,
+            outputs_fn=outputs_fn_sp,
+            q_fit_fn=q_fit_fn_sp if gn else None,
             q_fit_cfg=q_cfg if gn else None,
         )
         values = values.at[:, t].set(v_t)

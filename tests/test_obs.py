@@ -1,0 +1,364 @@
+"""orp_tpu.obs — telemetry spine tests: registry concurrency, histogram/
+ServingMetrics percentile agreement, JSONL + Prometheus schema pins,
+manifest fingerprint round-trip, the zero-cost disabled mode, and the
+end-to-end emission contract of an instrumented mini walk (the tier-1
+overhead-budget gate: enabled emits the expected span/counter set, disabled
+emits NOTHING)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from orp_tpu import obs
+from orp_tpu.obs.registry import Registry
+from orp_tpu.obs.sink import JsonlSink, ListSink
+from orp_tpu.serve.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    """Every test starts AND ends with telemetry disabled — the process-wide
+    state must never leak across tests (or into the rest of the suite)."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_interning_and_labels():
+    reg = Registry()
+    c1 = reg.counter("requests", {"phase": "engine"})
+    c2 = reg.counter("requests", {"phase": "engine"})
+    c3 = reg.counter("requests", {"phase": "batcher"})
+    assert c1 is c2 and c1 is not c3
+    c1.inc(3)
+    assert c2.value == 3 and c3.value == 0
+    # kinds are namespaced: a gauge named like a counter is a new instrument
+    g = reg.gauge("requests", {"phase": "engine"})
+    g.set(7.5)
+    assert c1.value == 3 and g.value == 7.5
+    with pytest.raises(ValueError, match="inc"):
+        c1.inc(-1)
+
+
+def test_registry_counter_concurrency():
+    # two threads hammering ONE counter: the total must be exact (the lock
+    # is real, not advisory)
+    reg = Registry()
+    c = reg.counter("hammered")
+    n = 20_000
+
+    def work():
+        for _ in range(n):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 2 * n
+
+
+def test_histogram_window_bounds_and_lifetime():
+    reg = Registry()
+    h = reg.histogram("lat", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        h.observe(v)
+    assert h.count == 6                    # lifetime
+    assert h.sum == pytest.approx(21.0)
+    assert list(h.snapshot()) == [3.0, 4.0, 5.0, 6.0]  # window
+    # re-interning with a different window is a config conflict, not silent
+    with pytest.raises(ValueError, match="window"):
+        reg.histogram("lat", window=8)
+    h.reset()
+    assert h.count == 0 and h.snapshot().size == 0
+
+
+def test_histogram_percentiles_agree_with_serving_metrics_summary():
+    # ServingMetrics is a façade over a registry histogram: its summary()
+    # percentiles must equal the histogram's own, to summary()'s rounding
+    m = ServingMetrics()
+    rng = np.random.default_rng(3)
+    lats = rng.uniform(1e-4, 0.2, size=257)
+    for lat in lats:
+        m.record(float(lat), n_rows=2)
+    s = m.summary()
+    h = m.registry.histogram("serve_request_latency_seconds")
+    p50, p95, p99 = h.percentiles((50, 95, 99))
+    assert s["p50_ms"] == round(p50 * 1e3, 4)
+    assert s["p95_ms"] == round(p95 * 1e3, 4)
+    assert s["p99_ms"] == round(p99 * 1e3, 4)
+    assert s["requests"] == h.count == 257
+    assert s["rows"] == 2 * 257
+    # and against the straight numpy definition the old implementation used
+    assert s["p50_ms"] == round(float(np.percentile(lats, 50)) * 1e3, 4)
+
+
+# -- sinks -------------------------------------------------------------------
+
+
+def test_jsonl_sink_schema_pin(tmp_path):
+    # the line shape IS a contract: schema tag, monotonic seq, ts, type
+    path = tmp_path / "events.jsonl"
+    with JsonlSink(path) as sink:
+        sink.emit({"type": "span", "name": "a", "dur_s": 0.5, "parent": None,
+                   "ok": True})
+        sink.emit({"type": "counter", "name": "c", "inc": 2, "labels": {}})
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [x["seq"] for x in lines] == [0, 1]
+    for line in lines:
+        assert line["schema"] == "orp-obs-v1"      # literal: bump = versioned
+        assert obs.validate_event(line) == []
+    # validator actually rejects malformed lines
+    assert obs.validate_event({"type": "span"})    # missing keys
+    assert obs.validate_event({**lines[0], "type": "mystery"})
+    assert obs.validate_event({**lines[1], "schema": "orp-obs-v0"})
+    # re-opening the same path TRUNCATES: one session per file, seq unique,
+    # so a reused --telemetry DIR stays consistent with its manifest
+    with JsonlSink(path) as sink:
+        sink.emit({"type": "gauge", "name": "g", "value": 1.0, "labels": {}})
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [x["seq"] for x in lines] == [0] and lines[0]["type"] == "gauge"
+
+
+def test_prometheus_exposition_pin():
+    reg = Registry()
+    reg.counter("serve_rows_total", {"phase": "engine"}).inc(5)
+    reg.gauge("depth").set(2.0)
+    h = reg.histogram("span_seconds", {"name": "serve/pad"})
+    for v in (0.001, 0.002, 0.003):
+        h.observe(v)
+    text = obs.prometheus_text(reg)
+    assert '# TYPE serve_rows_total counter' in text
+    assert 'serve_rows_total{phase="engine"} 5' in text
+    assert '# TYPE depth gauge' in text
+    assert '# TYPE span_seconds summary' in text
+    # metric/label names sanitised for Prometheus, values labelled by quantile
+    assert 'span_seconds{name="serve/pad",quantile="0.5"} 0.002' in text
+    assert 'span_seconds_count{name="serve/pad"} 3' in text
+    assert text.endswith("\n")
+    # label VALUES are escaped per the text format (quotes/backslashes/\n)
+    reg.counter("weird", {"cfg": 'a"b\\c\nd'}).inc()
+    assert 'weird{cfg="a\\"b\\\\c\\nd"} 1' in obs.prometheus_text(reg)
+    # a name legally shared across KINDS exposes per-kind groups instead of
+    # crashing (or mislabeling) the whole exposition
+    reg.counter("depth").inc(2)
+    reg.histogram("depth", {"k": "h"}).observe(1.0)
+    mixed = obs.prometheus_text(reg)
+    assert "# TYPE depth counter" in mixed and "# TYPE depth gauge" in mixed
+    assert "# TYPE depth summary" in mixed and 'depth_count{k="h"} 1' in mixed
+
+
+# -- manifest ----------------------------------------------------------------
+
+
+def test_manifest_fingerprint_roundtrip(tmp_path):
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+
+    cfgs = (EuropeanConfig(), SimConfig(n_paths=64, T=0.5, dt=0.25),
+            TrainConfig(dual_mode="mse_only"))
+    fp = obs.config_fingerprint(*cfgs)
+    obs.write_manifest(tmp_path, run_fingerprint=fp, extra={"pipeline": "euro"})
+    man = obs.read_manifest(tmp_path)
+    assert man["schema"] == "orp-obs-manifest-v1"
+    # round-trip: reconstructing the same configs reproduces the fingerprint
+    assert man["run_fingerprint"] == obs.config_fingerprint(
+        EuropeanConfig(), SimConfig(n_paths=64, T=0.5, dt=0.25),
+        TrainConfig(dual_mode="mse_only"))
+    # ...and a different config does NOT
+    assert man["run_fingerprint"] != obs.config_fingerprint(
+        EuropeanConfig(strike=110.0), *cfgs[1:])
+    assert man["platform"] == "cpu" and man["device_count"] >= 1
+    assert man["jax_version"] and "git" in man
+
+
+# -- disabled mode: zero-cost contract ---------------------------------------
+
+
+class _ExplodingRegistry(Registry):
+    """A registry whose every instrument lookup (and hence lock acquisition)
+    raises — proof the disabled path never touches one."""
+
+    def _intern(self, *a, **k):
+        raise AssertionError("disabled-path code touched the registry")
+
+
+def test_disabled_span_is_shared_noop():
+    # one process-wide singleton: no per-call allocation, nothing entered
+    s1, s2 = obs.span("a"), obs.span("b", attrs={"x": 1})
+    assert s1 is s2 is obs.NOOP_SPAN
+    with s1 as sp:
+        assert sp.set_result(123) == 123   # passthrough, no blocking
+        sp.annotate(ignored=True)
+    # spanned() returns the function OBJECT itself — zero wrapper overhead
+    fn = lambda x: x + 1
+    assert obs.spanned("a", fn) is fn
+
+
+def test_disabled_counters_touch_no_lock_or_registry(monkeypatch):
+    # plant an exploding registry as the active-state registry type: since
+    # telemetry is OFF there is no state at all, and count/set_gauge/
+    # bind_manifest must return before any instrument (or its lock) exists
+    assert not obs.enabled()
+    obs.count("x", 5, phase="hot")
+    obs.set_gauge("y", 1.0)
+    obs.bind_manifest(run_fingerprint="z")
+    # enabled against the exploding registry DOES explode — the no-op above
+    # was the disabled path, not a silently-broken recorder
+    with obs.active(registry=_ExplodingRegistry()):
+        with pytest.raises(AssertionError, match="touched the registry"):
+            obs.count("x")
+
+
+def test_span_stack_survives_exceptions():
+    # a failing span (including an async device error surfacing at the
+    # block_until_ready in __exit__) must still pop the thread-local stack
+    # and record itself — otherwise every later span on the thread inherits
+    # a phantom parent
+    sink = ListSink()
+    with obs.active(sink=sink):
+        with pytest.raises(RuntimeError, match="boom"):
+            with obs.span("outer"):
+                with obs.span("inner"):
+                    raise RuntimeError("boom")
+        with obs.span("after"):
+            pass
+    by_name = {e["name"]: e for e in sink.events if e["type"] == "span"}
+    assert by_name["inner"]["ok"] is False
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["outer"]["ok"] is False
+    assert by_name["after"]["parent"] is None  # stack fully unwound
+
+
+# -- end-to-end emission contract (the tier-1 overhead-budget gate) ----------
+
+
+def _mini_walk():
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+
+    return european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=128, T=0.5, dt=0.125, rebalance_every=1),
+        TrainConfig(dual_mode="mse_only", epochs_first=4, epochs_warm=2,
+                    batch_size=128),
+    )
+
+
+def test_enabled_mini_walk_emits_expected_span_and_counter_set(tmp_path):
+    with obs.telemetry(tmp_path) as st:
+        res = _mini_walk()
+    events = obs.read_events(tmp_path / "events.jsonl")
+    assert all(obs.validate_event(e) == [] for e in events)
+    spans = [e for e in events if e["type"] == "span"]
+    names = {e["name"] for e in spans}
+    # the instrumented surface: pipeline phases + the walk + per-date fits
+    assert {"pipeline/simulate", "pipeline/report", "train/walk",
+            "train/fit", "train/outputs"} <= names
+    n_dates = 4
+    assert sum(e["name"] == "train/fit" for e in spans) == n_dates
+    assert sum(e["name"] == "train/outputs" for e in spans) == n_dates
+    # nesting recorded: per-date spans carry the walk as parent
+    assert all(e["parent"] == "train/walk"
+               for e in spans if e["name"] == "train/fit")
+    # walk-level compile counters rode the CompileAudit
+    compile_events = [e for e in events if e["type"] == "counter"
+                      and e["name"] == "train/xla_compiles"]
+    assert {e["labels"]["fn"] for e in compile_events} >= {"fit", "date_outputs"}
+    # registry mirrored the spans (this is what metrics.prom exports)
+    hist = st.registry.histogram("span_seconds", {"name": "train/fit"})
+    assert hist.count == n_dates
+    # the bundle is complete on exit
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert 'span_seconds{name="train/fit",quantile="0.5"}' in prom
+    man = obs.read_manifest(tmp_path)
+    assert man["pipeline"] == "european_hedge"
+    assert "EuropeanConfig" in man["run_fingerprint"]
+    assert res.v0 == pytest.approx(res.v0)  # walk actually ran
+
+
+def test_disabled_mini_walk_emits_zero_events(tmp_path):
+    # the other half of the overhead budget: telemetry off -> NOTHING is
+    # recorded anywhere, and the walk result is bit-identical to an
+    # instrumented run. "Nothing" is proven by planting a live-looking
+    # state whose sink/registry would record (the in-memory session), then
+    # checking the DISABLED walk against it: after disable(), the planted
+    # sink must never grow, and the default REGISTRY stays untouched too.
+    planted = ListSink()
+    obs.enable(sink=planted)
+    obs.disable()
+    before = len(obs.REGISTRY.instruments())
+    res = _mini_walk()
+    assert planted.events == []
+    assert len(obs.REGISTRY.instruments()) == before
+    with obs.telemetry(tmp_path):
+        res_t = _mini_walk()
+    assert float(res.v0) == float(res_t.v0)  # instrumentation never re-maths
+
+
+def test_cli_telemetry_flag_drops_bundle(tmp_path, capsys):
+    from orp_tpu.cli import main as cli_main
+
+    tdir = tmp_path / "t"
+    cli_main([
+        "euro", "--paths", "128", "--steps", "4", "--rebalance-every", "1",
+        "--T", "0.5", "--epochs-first", "4", "--epochs-warm", "2",
+        "--batch-size", "128", "--json", "--telemetry", str(tdir),
+    ])
+    out = capsys.readouterr().out.strip().splitlines()
+    json.loads(out[-1])  # the result line is still clean JSON
+    for name in ("events.jsonl", "metrics.prom", "manifest.json"):
+        assert (tdir / name).exists(), name
+    events = obs.read_events(tdir / "events.jsonl")
+    assert all(obs.validate_event(e) == [] for e in events)
+    man = obs.read_manifest(tdir)
+    assert man["cli_command"] == "euro"
+    # the manifest fingerprint is the executed pipeline's config fingerprint
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+
+    fp = obs.config_fingerprint(
+        EuropeanConfig(),
+        SimConfig(n_paths=128, T=0.5, dt=0.125, rebalance_every=1),
+        TrainConfig(dual_mode="mse_only", epochs_first=4, epochs_warm=2,
+                    batch_size=128),
+        "quantile_method=sort",   # every run-shaping knob fingerprints
+    )
+    assert man["run_fingerprint"] == fp
+    # telemetry state did not leak out of the CLI session
+    assert not obs.enabled()
+
+
+def test_serve_spans_and_metrics_route_through_session_registry(tmp_path):
+    # serving instrumentation end to end: engine evaluations inside a session
+    # land serve/* spans in the sink and the ServingMetrics façade publishes
+    # into the session registry (labelled per phase)
+    from orp_tpu.serve import HedgeEngine
+
+    res = _mini_walk()
+    with obs.telemetry(tmp_path) as st:
+        engine = HedgeEngine(res)
+        m = ServingMetrics(registry=st.registry, labels={"phase": "engine"})
+        feats = np.ones((3, 1), np.float32)
+        import time
+
+        for _ in range(4):
+            t0 = time.perf_counter()
+            engine.evaluate(0, feats)
+            m.record(time.perf_counter() - t0, 3)
+        summ = m.summary()
+    events = obs.read_events(tmp_path / "events.jsonl")
+    span_names = {e["name"] for e in events if e["type"] == "span"}
+    assert {"serve/pad", "serve/dispatch", "serve/unpad"} <= span_names
+    counters = {e["name"] for e in events if e["type"] == "counter"}
+    # the rare per-bucket miss is an event; the per-request counters are
+    # registry-only (sink_event=False — no sink I/O in the request path)
+    assert "serve/bucket_misses" in counters
+    assert "serve/rows" not in counters
+    assert st.registry.counter("serve/rows").value == 12  # 4 requests x 3 rows
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert 'serve_request_latency_seconds{phase="engine",quantile="0.99"}' in prom
+    assert f'serve_requests_total{{phase="engine"}} {summ["requests"]}' in prom

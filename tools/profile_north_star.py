@@ -9,10 +9,18 @@ Profiles BOTH walk variants:
   - the fused single-XLA-program walk with "blocks" shuffle — the path
     benchmarks/north_star.py actually runs now — cold (compile+run) and warm.
 
-Usage: python tools/profile_north_star.py [n_paths_log2=20]
+Usage: python tools/profile_north_star.py [n_paths_log2=20] [telemetry_dir]
+
+With ``telemetry_dir`` (or ``ORP_PROFILE_TELEMETRY_DIR``) set, the profile
+runs under an ``orp_tpu.obs`` session: every stage wall lands in the shared
+registry (``profile_stage_seconds{stage=...}`` gauges -> ``metrics.prom``),
+the stamps record is emitted to ``events.jsonl`` through the schema-versioned
+sink, and ``manifest.json`` binds the numbers to jax/platform/git — the
+per-run bundle instead of a hand-rolled one-off JSON shape.
 """
 
 import json
+import os
 import pathlib
 import sys
 import time
@@ -221,8 +229,28 @@ def main(n_log2=20):
     }
     stamps["n_paths"] = n_paths
     stamps["platform"] = jax.devices()[0].platform
+
+    # telemetry: per-stage gauges into the registry + the full record as one
+    # sink event (obs/sink.py stamps schema/seq/ts), so an enabled run drops
+    # the standard bundle instead of this tool owning a private format
+    from orp_tpu import obs
+
+    for k, v in stamps.items():
+        if isinstance(v, float):  # the stage walls; not counts/strings/dicts
+            obs.set_gauge("profile_stage_seconds", v, stage=k)
+    obs.emit_record("profile_north_star", stamps)
     print(json.dumps(stamps))
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 20)
+    _n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    _tdir = (sys.argv[2] if len(sys.argv) > 2
+             else os.environ.get("ORP_PROFILE_TELEMETRY_DIR"))
+    if _tdir:
+        from orp_tpu import obs
+
+        with obs.telemetry(_tdir,
+                           manifest_extra={"tool": "profile_north_star"}):
+            main(_n)
+    else:
+        main(_n)
