@@ -122,7 +122,15 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from orp_tpu.aot import CompileTimeMonitor
     from orp_tpu.sde import TimeGrid, simulate_gbm_log
+
+    # meter every XLA compile second in the run: the record then carries a
+    # first-class compile-vs-execute wall split (compile_wall_s /
+    # execute_wall_s) instead of the cold/warm split being inferable only
+    # from two separate bench invocations (ISSUE 5 satellite)
+    t_run = time.perf_counter()
+    compile_mon = CompileTimeMonitor().__enter__()
 
     # CPU fallback (dead tunnel): shrink 8x so the artifact lands in minutes,
     # clearly labelled — its purpose is "the code runs and here is the
@@ -275,6 +283,8 @@ def main():
         record.update(rqmc_error=f"{type(e).__name__}: {e}"[:200])
 
     record["platform"] = jax.devices()[0].platform
+    compile_mon.__exit__(None, None, None)
+    record.update(compile_mon.split(time.perf_counter() - t_run))
 
     # telemetry bundle (ORP_BENCH_TELEMETRY_DIR): the round record goes
     # through the obs sink — a schema-versioned ``record`` event alongside

@@ -16,7 +16,6 @@ means do not depend on hedge quality, only their variance does.
 """
 
 import json
-import os
 import pathlib
 import sys
 import time
@@ -32,16 +31,13 @@ from orp_tpu.utils import bs_call
 def main(n_paths=1 << 20, epochs_first=120, epochs_warm=30, batch_div=64,
          final_solve=False, lr=1e-3, optimizer="gauss_newton",
          gn_iters=(150, 75), gn_block_rows=1 << 14, quiet=False):
-    import jax
+    from orp_tpu.aot import enable_persistent_cache
 
-    if not os.environ.get("ORP_TESTS_NO_COMPILE_CACHE"):
-        # same kill-switch as tests/conftest.py: when the suite runs with
-        # the persistent cache disabled (XLA's executable.serialize()
-        # segfaults on the big fused-walk program deep into a single
-        # process), an in-suite call of this entry must not re-enable it
-        # globally for the rest of the run
-        jax.config.update("jax_compilation_cache_dir", str(
-            pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"))
+    # the helper honours the ORP_TESTS_NO_COMPILE_CACHE kill-switch
+    # (tests/conftest.py documents the XLA serialize fault it debugs), so an
+    # in-suite call of this entry cannot re-enable the cache for the rest of
+    # the run; default dir is the repo .jax_cache, env-overridable
+    enable_persistent_cache()
     t0 = time.perf_counter()
     res = european_hedge(
         EuropeanConfig(constrain_self_financing=False),

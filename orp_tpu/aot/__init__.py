@@ -1,0 +1,49 @@
+"""orp_tpu.aot — compilation as an explicit, cached, exportable artifact.
+
+The framework's remaining order-of-magnitude latency line is one-time XLA
+compilation: 52.2s cold vs 10.9s warm on the last real-TPU north-star
+battery, and one compile per shape bucket on a cold serve process. This
+package owns all three answers:
+
+- ``cache``        — the ONE persistent-compile-cache entry point
+  (``enable_persistent_cache``; config + env ``ORP_JAX_CACHE_DIR``),
+  replacing the per-script ``jax.config.update`` boilerplate and enforced
+  by lint rule ORP008;
+- ``compile``      — ahead-of-time ``lower()/compile()`` of the hot
+  programs with walls + ``cost_analysis`` captured into obs, the
+  ``CompileTimeMonitor`` compile-vs-execute wall splitter, and
+  ``warm_fused_walk`` (the ``orp warm`` CLI: compile the training walk
+  from avals, no paths materialised);
+- ``bundle_exec``  — serialized executables inside policy bundles
+  (``orp export --aot``), keyed by device/topology/jaxlib fingerprint,
+  deserialized by ``HedgeEngine`` at construction for zero-compile cold
+  serving, with a warn-once jit fallback on any mismatch.
+
+Artifact lifecycle: lower → compile → serialize → bundle → deserialize →
+execute (ARCHITECTURE.md "AOT" section).
+"""
+
+from orp_tpu.aot.bundle_exec import (AOT_FORMAT, AotExecutable, export_aot,
+                                     load_aot)
+from orp_tpu.aot.cache import (DEFAULT_CACHE_DIR, enable_from_env,
+                               enable_persistent_cache, resolve_cache_dir)
+from orp_tpu.aot.compile import (AotUnsupported, CompileTimeMonitor,
+                                 aot_compile, cost_summary,
+                                 device_fingerprint, warm_fused_walk)
+
+__all__ = [
+    "AOT_FORMAT",
+    "AotExecutable",
+    "AotUnsupported",
+    "CompileTimeMonitor",
+    "DEFAULT_CACHE_DIR",
+    "aot_compile",
+    "cost_summary",
+    "device_fingerprint",
+    "enable_from_env",
+    "enable_persistent_cache",
+    "export_aot",
+    "load_aot",
+    "resolve_cache_dir",
+    "warm_fused_walk",
+]

@@ -1,0 +1,187 @@
+"""Serialized serving executables inside policy bundles: zero cold compiles.
+
+A policy bundle (``orp_tpu/serve/bundle.py``) ships params + metadata; the
+first serve process to load it still paid one XLA compile per shape bucket
+(the ``serve/engine.py`` bucket-miss design). This module adds the missing
+artifact — the compiled executables themselves::
+
+    <bundle>/aot/aot.json          manifest: device fingerprint + per-bucket
+                                   kept-input indices, compile walls, FLOPs
+    <bundle>/aot/bucket_<b>.exec   PJRT-serialized ``_eval_core`` executable
+                                   for bucket size <b>
+
+``export_aot`` compiles ``serve/engine.py::_eval_core`` per requested
+bucket FROM AVALS (no requests evaluated) and serializes each executable;
+``load_aot`` verifies the device fingerprint (platform, device kind,
+topology, jax/jaxlib versions) and the policy fingerprint, then
+deserializes every bucket — a ``HedgeEngine`` constructed from such a
+bundle serves every bucket with zero XLA compiles.
+
+Fallback contract: ANY mismatch or deserialization failure logs one
+warning (``warnings.warn`` + an ``aot/fingerprint_mismatch`` obs counter
+event) and returns ``{}``, so the engine silently keeps its always-correct
+jit path. Executables are an optimisation artifact; they must never be
+able to take serving down.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import warnings
+
+from orp_tpu.aot.compile import (AotUnsupported, aot_compile,
+                                 deserialize_executable, device_fingerprint,
+                                 serialize_compiled)
+from orp_tpu.obs import count as obs_count
+
+AOT_SUBDIR = "aot"
+AOT_META = "aot.json"
+AOT_FORMAT = "orp-aot-v1"
+
+# every power-of-two bucket up to the serve-bench schedule's 1000-row max:
+# the batcher coalesces timing-dependent intermediate sizes, so shipping
+# only the headline buckets would leave cold compiles inside a burst
+DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class AotExecutable:
+    """One deserialized bucket executable plus its calling convention: the
+    sorted flat-input indices XLA kept (pruned inputs must be dropped from
+    the flattened argument list before ``execute``)."""
+
+    __slots__ = ("executable", "kept", "bucket")
+
+    def __init__(self, executable, kept, bucket: int):
+        self.executable = executable
+        self.kept = tuple(kept)
+        self.bucket = int(bucket)
+
+    def call_flat(self, flat_args) -> list:
+        """Run on pre-flattened arguments (engine order); returns the flat
+        output list (``phi, psi, value`` for ``_eval_core``)."""
+        return self.executable.execute([flat_args[i] for i in self.kept])
+
+
+def _bucket_file(bucket: int) -> str:
+    return f"bucket_{bucket}.exec"
+
+
+def export_aot(directory: str | pathlib.Path, policy, *,
+               buckets=DEFAULT_BUCKETS) -> dict:
+    """Compile + serialize the serving executables for ``policy`` into
+    ``<directory>/aot/``; returns the written manifest.
+
+    ``directory`` is the policy's bundle dir (``export_bundle`` output —
+    the executables are only meaningful next to the params they close
+    over). ``buckets`` are request sizes; each is rounded up to its
+    power-of-two bucket exactly like a live request would be.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from orp_tpu.serve.engine import HedgeEngine, _eval_core
+
+    # the engine IS the calling convention: device-resident param trees,
+    # resolved statics and the bucket rounding all come from the same code
+    # that will evaluate requests, so export and serve cannot drift.
+    # use_aot=False: only shapes/statics are needed here — a RE-export into
+    # a dir holding a previous --aot artifact must not load (or warn about)
+    # the very executables it is about to overwrite
+    engine = HedgeEngine(policy, use_aot=False)
+    d = pathlib.Path(directory)
+    adir = d / AOT_SUBDIR
+    adir.mkdir(parents=True, exist_ok=True)
+    sds = jax.ShapeDtypeStruct
+    aval = lambda x: sds(x.shape, x.dtype)
+    dt = jnp.dtype(engine.model.dtype)
+    entries = {}
+    for b in sorted({engine.bucket_for(int(n)) for n in buckets}):
+        compiled, meta = aot_compile(
+            _eval_core,
+            engine.model,
+            jax.tree.map(aval, engine._p1),
+            jax.tree.map(aval, engine._p2),
+            sds((), jnp.int32),                       # date_idx (traced)
+            sds((b, engine.model.n_features), dt),    # padded features
+            sds((b, engine.n_instruments), dt),       # padded prices
+            sds((), dt),                              # cost_of_capital
+            label=f"eval_core/{b}",
+            dual_mode=engine.dual_mode,
+            holdings_combine=engine.holdings_combine,
+        )
+        blob, kept = serialize_compiled(compiled)  # AotUnsupported propagates:
+        # an export that cannot ship executables should fail loudly, not
+        # write a bundle that silently lacks its advertised artifact
+        (adir / _bucket_file(b)).write_bytes(blob)
+        entries[str(b)] = {
+            "file": _bucket_file(b),
+            "kept": kept,
+            "serialized_bytes": len(blob),
+            **{k: v for k, v in meta.items() if k != "fn"},
+        }
+    manifest = {
+        "format": AOT_FORMAT,
+        "fingerprint": device_fingerprint(),
+        "policy_fingerprint": getattr(policy, "fingerprint", None),
+        "buckets": entries,
+    }
+    (adir / AOT_META).write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def _fallback(directory, reason: str) -> dict:
+    """The one warning a broken/foreign AOT artifact produces before the
+    engine quietly keeps its jit path."""
+    warnings.warn(
+        f"AOT executables under {directory} are unusable ({reason}); "
+        "falling back to jit compilation (correct, but cold starts pay "
+        "one compile per bucket again)",
+        stacklevel=3,
+    )
+    obs_count("aot/fingerprint_mismatch", reason=reason[:160])
+    return {}
+
+
+def load_aot(directory: str | pathlib.Path, *,
+             policy_fingerprint: str | None = None
+             ) -> dict[int, AotExecutable] | None:
+    """Deserialize the bucket executables under ``<directory>/aot/``.
+
+    Returns None when the bundle ships no AOT artifacts at all (nothing to
+    say), ``{}`` after emitting ONE warning when they exist but cannot be
+    used here (wrong device/topology/jaxlib, tampered manifest, undeserializable
+    blob), else ``{bucket: AotExecutable}``.
+    """
+    adir = pathlib.Path(directory) / AOT_SUBDIR
+    meta_f = adir / AOT_META
+    if not meta_f.exists():
+        return None
+    try:
+        manifest = json.loads(meta_f.read_text())
+    except json.JSONDecodeError as e:
+        return _fallback(directory, f"unreadable {AOT_META}: {e}")
+    if manifest.get("format") != AOT_FORMAT:
+        return _fallback(
+            directory,
+            f"format {manifest.get('format')!r} != {AOT_FORMAT}")
+    saved = manifest.get("fingerprint") or {}
+    here = device_fingerprint()
+    diffs = [f"{k}: bundle={saved.get(k)!r} here={v!r}"
+             for k, v in here.items() if saved.get(k) != v]
+    if diffs:
+        return _fallback(directory, "device/runtime fingerprint mismatch — "
+                         + "; ".join(diffs))
+    if (policy_fingerprint is not None
+            and manifest.get("policy_fingerprint") != policy_fingerprint):
+        return _fallback(directory, "policy fingerprint mismatch (executables "
+                         "were exported for a different policy)")
+    out: dict[int, AotExecutable] = {}
+    try:
+        for b_str, entry in manifest.get("buckets", {}).items():
+            blob = (adir / entry["file"]).read_bytes()
+            out[int(b_str)] = AotExecutable(
+                deserialize_executable(blob), entry["kept"], int(b_str))
+    except Exception as e:  # any failure mode here has the same answer: jit
+        return _fallback(directory, f"deserialization failed: {e}")
+    return out

@@ -1,0 +1,96 @@
+"""The ONE persistent-compile-cache entry point (lint rule ORP008).
+
+Seven ``tools/*.py`` scripts, ``benchmarks/north_star.py`` and the test
+harness each used to carry their own ``jax.config.update(
+"jax_compilation_cache_dir", ...)`` block — the same three lines, with the
+same repo-root ``.jax_cache`` default, minus whichever of them forgot the
+``ORP_TESTS_NO_COMPILE_CACHE`` kill-switch. Cache policy is process-global
+state exactly like x64 policy (``utils/precision.py``), so it gets the same
+treatment: one library call owns it, and rule ORP008 flags any direct
+``jax.config.update`` on a cache key outside this package.
+
+Resolution order for the directory:
+
+1. the explicit ``directory`` argument (callers with a private cache, e.g.
+   the test harness's x64 ``.jax_cache_tests``);
+2. env ``ORP_JAX_CACHE_DIR`` (operators relocating the cache — a fast local
+   disk, a shared NFS cache for a pod);
+3. the repo-root ``.jax_cache`` every perf tool always used.
+
+``ORP_TESTS_NO_COMPILE_CACHE=1`` turns every call into a no-op (the debug
+kill-switch tests/conftest.py documents: XLA's cache serialization has a
+known process-lifetime fault on very large programs), so a suite running
+with the cache off cannot have it silently re-enabled by an in-suite call
+of ``benchmarks/north_star.py`` or a tool's ``main``.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+ENV_CACHE_DIR = "ORP_JAX_CACHE_DIR"
+ENV_DISABLE = "ORP_TESTS_NO_COMPILE_CACHE"
+
+# the repo-root cache dir the seven tools/* scripts each hard-coded
+DEFAULT_CACHE_DIR = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
+
+
+def resolve_cache_dir(directory: str | pathlib.Path | None = None
+                      ) -> pathlib.Path | None:
+    """The directory ``enable_persistent_cache`` would use — or None when
+    the ``ORP_TESTS_NO_COMPILE_CACHE`` kill-switch is set."""
+    if os.environ.get(ENV_DISABLE):
+        return None
+    if directory is not None:
+        return pathlib.Path(directory)
+    env = os.environ.get(ENV_CACHE_DIR)
+    return pathlib.Path(env) if env else DEFAULT_CACHE_DIR
+
+
+def enable_persistent_cache(
+    directory: str | pathlib.Path | None = None,
+    *,
+    min_compile_secs: float | None = None,
+) -> pathlib.Path | None:
+    """Point XLA's persistent compilation cache at ``directory`` (resolution
+    rules in the module docstring). Returns the directory in effect, or
+    None when the kill-switch disabled the call.
+
+    ``min_compile_secs`` optionally lowers the persistence threshold
+    (jax's default only persists programs that took >= 1s to compile —
+    the test harness and ``orp warm`` want small programs cached too).
+    """
+    d = resolve_cache_dir(directory)
+    if d is None:
+        return None
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(d))
+    if min_compile_secs is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    if prev not in (None, str(d)):
+        # jax memoizes the cache handle at first use: redirecting the dir
+        # mid-process is SILENTLY ignored unless the old handle is dropped
+        # (`orp warm --cache-dir` after any compile would warm the wrong
+        # cache). Private API, so a jax that removes it degrades to the old
+        # first-use-wins behavior rather than breaking.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    return d
+
+
+def enable_from_env() -> pathlib.Path | None:
+    """CLI hook: enable the cache ONLY when ``ORP_JAX_CACHE_DIR`` asks for
+    it. The CLI serves interactive runs from arbitrary environments, so it
+    must not adopt the repo-root default uninvited (the perf tools, whose
+    whole point is repeatable walls, do)."""
+    if not os.environ.get(ENV_CACHE_DIR):
+        return None
+    return enable_persistent_cache()

@@ -1,0 +1,246 @@
+"""Ahead-of-time compilation of the hot programs, with the bill itemised.
+
+The north-star walk's wall is dominated by one-time XLA compilation
+(52.2s cold vs 10.9s warm on the last real-TPU battery), and the serving
+engine's bucket-miss design pays a compile on the first request of every
+bucket. This module makes that cost an explicit, measured artifact:
+
+- ``aot_compile(jit_fn, *args, label=..., **statics)`` — ``lower()`` +
+  ``compile()`` with the lower/compile walls, the backend-compile seconds
+  (from jax's monitoring events) and ``cost_analysis()`` FLOPs/bytes
+  captured into obs spans (``aot/lower``, ``aot/compile``) and registry
+  counters/gauges, returned as a JSON-able ``meta`` dict;
+- ``CompileTimeMonitor`` — a context manager accumulating every XLA
+  backend-compile second inside its region, so ONE run can report
+  ``compile_wall_s`` vs ``execute_wall_s`` first-class (bench.py,
+  tools/profile_north_star.py) instead of inferring the split from a
+  cold/warm run pair;
+- ``serialize_compiled``/``deserialize_executable`` — the raw-executable
+  round trip (PJRT ``serialize_executable``) that ``aot/bundle_exec.py``
+  ships inside policy bundles, plus the kept-input index the pruned
+  executable must be called with;
+- ``warm_fused_walk`` — compile (without running) the whole-walk training
+  program for given shapes, populating the persistent compile cache so a
+  fresh trainer process pays a cache read instead of a 60-90s compile
+  (the ``orp warm`` CLI);
+- ``device_fingerprint`` — the (platform, device kind, topology, jaxlib)
+  tuple a serialized executable is only valid under.
+
+Private-API honesty: the kept-input index (``_kept_var_idx``) and the
+monitoring listener registration are jax internals. Every use degrades
+gracefully — ``AotUnsupported`` for serialization (callers fall back to
+jit), ``supported=False`` for the monitor (fields report None) — so a jax
+upgrade can cost the optimisation, never correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import set_gauge as obs_set_gauge
+from orp_tpu.obs import span as obs_span
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+
+
+class AotUnsupported(RuntimeError):
+    """This jax/backend cannot ship a callable serialized executable; the
+    caller must keep the jit path (which is always correct, only colder)."""
+
+
+class CompileTimeMonitor:
+    """Accumulate XLA compile seconds inside a ``with`` region.
+
+    Rides jax's monitoring duration events (``/jax/core/compile/*``:
+    jaxpr trace, MLIR lowering, backend compile), so one run of any
+    workload yields an honest compile-vs-execute wall split without a
+    second warm run. ``seconds`` is the accumulated compile wall;
+    ``supported`` is False when the running jax exposes no event listener
+    API (the split then reports None rather than a fake zero).
+    """
+
+    def __init__(self) -> None:
+        self.seconds = 0.0
+        self.events = 0
+        self.supported = True
+        self._monitoring = None
+
+    def _listener(self, key: str, seconds: float, **_kw) -> None:
+        if key.startswith(_COMPILE_EVENT_PREFIX):
+            self.seconds += seconds
+            self.events += 1
+
+    def __enter__(self) -> "CompileTimeMonitor":
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_duration_secs_listener(self._listener)
+            self._monitoring = monitoring
+        except Exception:
+            self.supported = False
+            self._monitoring = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._monitoring is not None:
+            try:
+                self._monitoring._unregister_event_duration_listener_by_callback(
+                    self._listener)
+            except Exception:
+                # worst case the listener outlives the region and keeps
+                # adding to this monitor's counters — never breaks the run
+                pass
+        self._monitoring = None
+
+    def split(self, total_wall_s: float) -> dict:
+        """``{"compile_wall_s", "execute_wall_s"}`` for a region that took
+        ``total_wall_s`` overall; None fields when unsupported."""
+        if not self.supported:
+            return {"compile_wall_s": None, "execute_wall_s": None}
+        return {
+            "compile_wall_s": round(self.seconds, 3),
+            "execute_wall_s": round(max(total_wall_s - self.seconds, 0.0), 3),
+        }
+
+
+def cost_summary(compiled) -> dict:
+    """FLOPs / bytes-accessed from ``compiled.cost_analysis()`` as flat
+    JSON-able floats (this jax wraps the dict in a one-element list)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    for key, name in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+        if key in ca:
+            out[name] = float(ca[key])
+    return out
+
+
+def aot_compile(jit_fn, *args, label: str, **static_kwargs):
+    """``jit_fn.lower(*args, **static_kwargs).compile()`` with the bill
+    captured: returns ``(compiled, meta)`` where meta carries the lower and
+    compile walls, backend-compile seconds and the program's FLOPs/bytes.
+
+    ``args`` may be real arrays or ``jax.ShapeDtypeStruct``s — AOT needs
+    only avals, which is what lets ``orp warm`` compile a 1M-path walk
+    without materialising a single path.
+    """
+    t0 = time.perf_counter()
+    with obs_span("aot/lower", attrs={"fn": label}):
+        lowered = jit_fn.lower(*args, **static_kwargs)
+    t1 = time.perf_counter()
+    with obs_span("aot/compile", attrs={"fn": label}):
+        with CompileTimeMonitor() as mon:
+            compiled = lowered.compile()
+    t2 = time.perf_counter()
+    meta = {
+        "fn": label,
+        "lower_wall_s": round(t1 - t0, 3),
+        "compile_wall_s": round(t2 - t1, 3),
+        "backend_compile_s": round(mon.seconds, 3) if mon.supported else None,
+        **cost_summary(compiled),
+    }
+    obs_count("aot/compiles", fn=label)
+    for key in ("flops", "bytes_accessed"):
+        if key in meta:
+            obs_set_gauge(f"aot_{key}", meta[key], fn=label)
+    return compiled, meta
+
+
+def device_fingerprint() -> dict:
+    """What a serialized executable is compiled FOR: loading it anywhere
+    else is at best a deserialization error, at worst silent garbage —
+    ``aot/bundle_exec.py`` refuses on any field mismatch and falls back to
+    jit."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_devices": jax.local_device_count(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+
+
+def serialize_compiled(compiled) -> tuple[bytes, list[int]]:
+    """A compiled jit program as ``(blob, kept)``: the PJRT-serialized
+    executable plus the sorted flat-input indices XLA kept (unused inputs
+    are pruned at compile time — callers of the raw executable must apply
+    the same pruning to their flattened argument list)."""
+    ex = getattr(compiled, "_executable", None)
+    kept = getattr(ex, "_kept_var_idx", None)
+    if kept is None:
+        raise AotUnsupported(
+            "this jax exposes no kept-input index for compiled programs — "
+            "a raw executable could not be called correctly"
+        )
+    try:
+        rex = compiled.runtime_executable()
+        blob = rex.client.serialize_executable(rex)
+    except Exception as e:
+        raise AotUnsupported(f"executable serialization unavailable: {e}")
+    return blob, sorted(kept)
+
+
+def deserialize_executable(blob: bytes):
+    """The loaded PJRT executable for ``blob`` (zero XLA compilation —
+    the whole point). Raises on an incompatible blob; callers catch and
+    fall back to jit."""
+    import jax
+
+    return jax.devices()[0].client.deserialize_executable(blob, None)
+
+
+def warm_fused_walk(model, cfg, *, n_paths: int, n_dates: int,
+                    dtype=None) -> dict:
+    """Compile the whole-walk training program (``train/backward.py::
+    _fused_walk``) for the given shapes WITHOUT running it, populating the
+    persistent compile cache. A later real run of the same config then
+    reads the executable from disk instead of paying the 60-90s compile.
+
+    Shapes mirror what ``backward_induction`` hands ``_fused_walk``:
+    features ``(n_paths, n_dates+1, n_features)``, stacked instrument
+    prices ``(n_paths, n_dates+1, n_hedge_assets+1)``, terminal values
+    ``(n_paths,)`` and one ``(ka, kb)`` key pair per date. Only avals are
+    built — no path simulation, no HBM.
+
+    ``cfg`` must be the exact ``BackwardConfig`` the run will use (it is a
+    static argument, so every field is part of the program): same
+    epochs/iters, ``fused=True``, and the shuffle policy the entry point
+    sets. The seed is normalised out exactly like ``_walk_impl`` does.
+    """
+    import jax
+
+    from orp_tpu.train.backward import _fused_walk
+
+    if not cfg.fused:
+        raise ValueError("warm_fused_walk compiles the fused walk; pass a "
+                         "cfg with fused=True (the program being warmed)")
+    dtype = model.dtype if dtype is None else dtype
+    cfg0 = dataclasses.replace(cfg, seed=0)  # _walk_impl's normalisation
+    # real (tiny) values where avals alone are awkward: params are ~10^2
+    # floats, the per-date key arrays ~n_dates key pairs — their VALUES are
+    # irrelevant to the compiled program, only their avals enter the trace
+    params = model.init(jax.random.key(0))
+    keys = jax.random.split(jax.random.key(1), n_dates)
+    n_knots = n_dates + 1
+    sds = jax.ShapeDtypeStruct
+    features = sds((n_paths, n_knots, model.n_features), dtype)
+    prices_all = sds((n_paths, n_knots, model.n_hedge_assets + 1), model.dtype)
+    terminal = sds((n_paths,), dtype)
+    _, meta = aot_compile(  # orp: noqa[ORP004] -- kas/kbs share one key array: only avals enter the AOT trace, the key VALUES are never consumed
+        _fused_walk, model, cfg0, params, params, features, prices_all,
+        terminal, keys, keys,
+        label=f"fused_walk/{n_paths}x{n_dates}",
+    )
+    return {**meta, "n_paths": n_paths, "n_dates": n_dates}

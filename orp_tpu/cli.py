@@ -30,14 +30,23 @@ output for scripting. Commands mirror the reference's four entry shapes:
 - ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
 - ``export``    train a hedge pipeline and export the policy as a serve
                 bundle (``orp_tpu/serve/bundle.py``); the hedge commands'
-                ``--export-dir`` does the same inline after a full run
+                ``--export-dir`` does the same inline after a full run.
+                ``--aot`` additionally compiles + serializes the per-bucket
+                serving executables into the bundle (``orp_tpu/aot``), so a
+                cold serve process pays ZERO XLA compiles
 - ``serve-bench`` load a bundle and benchmark the serving path (bucketed
-                engine + micro-batcher), emitting ``BENCH_serve.json``
+                engine + micro-batcher), emitting ``BENCH_serve.json``;
+                ``--prewarm`` asserts no compile lands in the measured window
+- ``warm``      pre-populate the persistent XLA compile cache for training:
+                AOT-compile the fused backward-walk program for the given
+                pipeline/shape WITHOUT simulating or training, so the next
+                real run skips the 60-90s whole-walk compile (``orp_tpu/aot``)
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP007 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP008 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
-                donation, traced-value branches, unblocked timing); exits
-                non-zero on findings so it gates commits (tools/lint_all.py)
+                donation, traced-value branches, unblocked timing, compile-
+                cache config outside orp_tpu/aot); exits non-zero on
+                findings so it gates commits (tools/lint_all.py)
 
 Every training command (and ``serve-bench``) accepts ``--telemetry DIR``: the
 run executes under an ``orp_tpu.obs`` session and drops a telemetry bundle —
@@ -528,6 +537,14 @@ def cmd_export(args):
     # prove the artifact loads before reporting success (a broken export
     # should fail HERE, not at serve time)
     bundle = load_bundle(args.out)
+    aot_manifest = None
+    if args.aot:
+        from orp_tpu.aot import export_aot
+
+        # the LOADED bundle (not the in-memory result) is what the serve
+        # process will construct from — its fingerprint keys the executables
+        buckets = tuple(int(x) for x in args.aot_buckets.split(","))
+        aot_manifest = export_aot(args.out, bundle, buckets=buckets)
     out = {
         "out": args.out,
         "pipeline": args.pipeline,
@@ -535,11 +552,17 @@ def cmd_export(args):
         "v0": res.v0,
         "fingerprint": bundle.fingerprint,
     }
+    if aot_manifest is not None:
+        out["aot_buckets"] = sorted(int(b) for b in aot_manifest["buckets"])
+        out["aot_compile_wall_s"] = round(sum(
+            e["compile_wall_s"] for e in aot_manifest["buckets"].values()), 3)
     if args.json:
         print(json.dumps(out))
     else:
+        aot_note = (f" + {len(out['aot_buckets'])} AOT bucket executables"
+                    if aot_manifest is not None else "")
         print(f"exported {args.pipeline} policy ({bundle.n_dates} dates, "
-              f"v0={res.v0:,.4f}) -> {args.out}")
+              f"v0={res.v0:,.4f}){aot_note} -> {args.out}")
 
 
 def cmd_serve_bench(args):
@@ -552,10 +575,57 @@ def cmd_serve_bench(args):
         batch_sizes=tuple(int(x) for x in args.batch_sizes.split(",")),
         batcher_requests=args.batcher_requests,
         max_wait_us=args.max_wait_us,
+        prewarm=args.prewarm,
     )
     if args.out:
         write_bench_record(record, args.out)
     print(json.dumps(record))
+
+
+def cmd_warm(args):
+    """Pre-populate the persistent compile cache: AOT-compile the fused
+    backward-walk program for the selected pipeline's exact shapes and
+    training config — no paths simulated, no training run. The next real
+    run of the SAME config (same shape, epochs/iters, optimizer — the
+    config is a static argument of the program) reads the executable from
+    the cache instead of paying the whole-walk compile."""
+    from orp_tpu.aot import enable_persistent_cache, warm_fused_walk
+    from orp_tpu.api.pipelines import _backward_cfg
+    from orp_tpu.models.mlp import HedgeMLP
+
+    if not args.fused:
+        # the fused walk IS the program being warmed; mirror _train_cfg's
+        # --fused branch (shuffle="blocks") so the warmed program is the one
+        # `orp <cmd> --fused` will run
+        args.fused = True
+    cache_dir = enable_persistent_cache(args.cache_dir, min_compile_secs=0.0)
+    if cache_dir is None:
+        raise SystemExit("error: the compile cache is disabled "
+                         "(ORP_TESTS_NO_COMPILE_CACHE is set) — nothing to warm")
+    default_dual = "separate" if args.pipeline == "pension" else "mse_only"
+    train = _train_cfg(args, default_dual)
+    n_features = {"euro": 1, "heston": 2, "pension": 3}[args.pipeline]
+    if args.pipeline == "euro":
+        # the head shape is part of the static model, hence of the program:
+        # --unconstrained here must mirror `orp euro --unconstrained` (the
+        # north-star benchmark's free-psi config) or the warm misses the cache
+        model = HedgeMLP(n_features=1,
+                         constrain_self_financing=not args.unconstrained)
+    else:
+        model = HedgeMLP(n_features=n_features)
+    n_dates = args.steps // args.rebalance_every
+    cfg = _backward_cfg(train)
+    meta = warm_fused_walk(model, cfg, n_paths=args.paths, n_dates=n_dates)
+    out = {
+        "cache_dir": str(cache_dir),
+        "pipeline": args.pipeline,
+        **meta,
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"warmed {out['fn']} ({args.pipeline}) into {cache_dir}: "
+              f"compile {out['compile_wall_s']}s, lower {out['lower_wall_s']}s")
 
 
 def cmd_lint(args):
@@ -819,8 +889,40 @@ def build_parser():
     px.add_argument("--steps", type=int, default=364)
     px.add_argument("--rebalance-every", type=int, default=7)
     px.add_argument("--T", type=float, default=1.0)
+    px.add_argument("--aot", action="store_true",
+                    help="also compile + serialize the per-bucket serving "
+                         "executables into the bundle (orp_tpu/aot): a cold "
+                         "serve process then pays ZERO XLA compiles")
+    px.add_argument("--aot-buckets", default="8,16,32,64,128,256,512,1024",
+                    help="with --aot: request sizes to ship executables for "
+                         "(each rounds up to its power-of-two bucket; the "
+                         "default covers every bucket the serve-bench "
+                         "schedule and its batcher bursts can reach)")
     _add_train_flags(px)
     px.set_defaults(fn=cmd_export)
+
+    pw = sub.add_parser(
+        "warm",
+        help="pre-populate the persistent XLA compile cache: AOT-compile "
+             "the fused backward-walk program for a pipeline/shape without "
+             "simulating or training (the next real run of the same config "
+             "skips the whole-walk compile)",
+    )
+    pw.add_argument("--pipeline", choices=["euro", "heston", "pension"],
+                    default="euro")
+    pw.add_argument("--paths", type=int, default=1 << 20)
+    pw.add_argument("--steps", type=int, default=364)
+    pw.add_argument("--rebalance-every", type=int, default=7)
+    pw.add_argument("--T", type=float, default=1.0)
+    pw.add_argument("--unconstrained", action="store_true",
+                    help="euro pipeline: warm the free-psi head's program "
+                         "(matches `orp euro --unconstrained`, the "
+                         "north-star benchmark config)")
+    pw.add_argument("--cache-dir", default=None,
+                    help="persistent cache directory (default: env "
+                         "ORP_JAX_CACHE_DIR, else the repo .jax_cache)")
+    _add_train_flags(pw)
+    pw.set_defaults(fn=cmd_warm)
 
     psb = sub.add_parser(
         "serve-bench",
@@ -839,6 +941,10 @@ def build_parser():
     psb.add_argument("--out", default="BENCH_serve.json",
                      help="record file to write ('' skips the file; the "
                           "record always prints as one JSON line)")
+    psb.add_argument("--prewarm", action="store_true",
+                     help="assert the warmup contract: fail loudly if any "
+                          "measured request paid a first-touch bucket "
+                          "compile (cache_misses_after_warmup must be 0)")
     psb.add_argument("--json", action="store_true",
                      help="accepted for uniformity with the other "
                           "subcommands; the record always prints as JSON")
@@ -848,7 +954,7 @@ def build_parser():
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
-             "drift, key reuse — rules ORP001-ORP007); non-zero exit on "
+             "drift, key reuse — rules ORP001-ORP008); non-zero exit on "
              "findings",
     )
     pl.add_argument("paths", nargs="*", default=None,
@@ -873,6 +979,12 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    # opt-in persistent compile cache for ANY command: ORP_JAX_CACHE_DIR set
+    # in the environment routes every jit compile of this run through the
+    # one cache entry point (orp_tpu/aot/cache.py); unset costs nothing
+    from orp_tpu.aot.cache import enable_from_env
+
+    enable_from_env()
     tdir = getattr(args, "telemetry", None)
     if tdir:
         # one session around the whole command: the pipeline binds its config

@@ -26,6 +26,11 @@ ORP006  Python branching on traced values: ``if x > 0`` on a tracer raises
 ORP007  timing around async dispatch: JAX calls return before the device
         finishes; a ``perf_counter`` delta without ``block_until_ready``
         measures dispatch, not compute (the reference's own benchmark bug).
+ORP008  compile-cache config outside ``orp_tpu/aot``: seven tools each
+        hand-rolled ``jax.config.update("jax_compilation_cache_dir", ...)``
+        until one of them forgot the kill-switch; cache policy is process-
+        global state and has exactly one entry point
+        (``orp_tpu/aot/cache.py::enable_persistent_cache``).
 """
 
 from __future__ import annotations
@@ -505,4 +510,37 @@ def check_unblocked_timing(ctx: FileContext) -> Iterator[Finding]:
                 f"perf_counter delta around async dispatch ({dispatches[0]} "
                 "…) without block_until_ready — this times dispatch, not "
                 "device compute",
+            )
+
+
+# -- ORP008 ------------------------------------------------------------------
+
+# matched on a path-component boundary: a directory that merely ENDS in
+# "aot" (someaot/cache.py) must not inherit the exemption
+_CACHE_ALLOWED = "aot/cache.py"
+# any jax.config key that shapes the persistent compile cache: the dir, the
+# persistence threshold, enablement flags — one policy, one owner
+_CACHE_CONFIG_PREFIXES = ("jax_compilation_cache", "jax_persistent_cache")
+
+
+@rule("ORP008", "compile-cache config outside orp_tpu/aot (single entry point)")
+def check_cache_entrypoint(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if path == _CACHE_ALLOWED or path.endswith("/" + _CACHE_ALLOWED):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d not in ("jax.config.update", "config.update") or not node.args:
+            continue
+        a0 = node.args[0]
+        if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                and a0.value.startswith(_CACHE_CONFIG_PREFIXES)):
+            yield ctx.finding(
+                node, "ORP008",
+                f"{a0.value!r} set directly — compile-cache policy is "
+                "process-global and has ONE entry point: "
+                "orp_tpu.aot.enable_persistent_cache (it also honours the "
+                "env override and the tests' kill-switch this call forgets)",
             )

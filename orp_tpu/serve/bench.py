@@ -71,9 +71,14 @@ def serve_bench(
     batcher_requests: int = 256,
     max_wait_us: float = 500.0,
     seed: int = 0,
+    prewarm: bool = False,
 ) -> dict:
     """Run both phases against ``policy`` (a ``PolicyBundle`` or a trained
-    ``PipelineResult``) and return the bench record."""
+    ``PipelineResult``) and return the bench record.
+
+    ``prewarm=True`` (CLI ``--prewarm``) additionally ASSERTS the warmup
+    contract — ``cache_misses_after_warmup == 0`` — so a CI run fails loudly
+    if any measured request paid a first-touch compile."""
     engine = HedgeEngine(policy)
     n_features = engine.model.n_features
     rng = np.random.default_rng(seed)
@@ -82,11 +87,13 @@ def serve_bench(
     # own sizes but every power-of-two up to the batcher's max coalesced
     # batch, because the batcher phase dispatches timing-dependent sizes and
     # a first-touch compile inside the measured window would dominate p99
+    sizes = []
     b = engine.min_bucket
     top = engine.bucket_for(max(batch_sizes))
     while b <= top:
-        engine.evaluate(0, np.ones((b, n_features), np.float32))
+        sizes.append(b)
         b *= 2
+    engine.prewarm(sizes)
     warm_misses = engine.misses
 
     metrics = _phase_metrics("engine")
@@ -127,6 +134,12 @@ def serve_bench(
         "cache_hit_rate": round(cache["hits"] / max(served, 1), 4),
         "cache_buckets": cache["buckets"],
         "cache_misses_after_warmup": cache["misses"] - warm_misses,
+        # the cold-start ledger: with an --aot bundle the whole column reads
+        # aot_buckets=<all>, xla_compiles=0, misses=0 — the zero-compile proof
+        "aot_buckets": cache["aot_buckets"],
+        "aot_hits": cache["aot_hits"],
+        "xla_compiles": cache["xla_compiles"],
+        "prewarm": prewarm,
         "batcher_requests": batcher_requests,
         "batcher_dispatches": dispatches,
         "batcher_requests_per_s": batcher_summary["requests_per_s"],
@@ -135,6 +148,12 @@ def serve_bench(
     import jax
 
     record["platform"] = jax.devices()[0].platform
+    if prewarm and record["cache_misses_after_warmup"] != 0:
+        raise RuntimeError(
+            "--prewarm contract violated: "
+            f"{record['cache_misses_after_warmup']} bucket compile(s) landed "
+            "inside the measured window (bucket set changed mid-bench?)"
+        )
     obs.emit_record("serve_bench", record)
     return record
 

@@ -64,6 +64,9 @@ class PolicyBundle:
     cost_of_capital: float
     sim_seed: int | None          # training path seed — *_oos refuses replaying it
     fingerprint: str
+    aot_dir: pathlib.Path | None = None  # bundle dir holding serialized
+    # serving executables (orp export --aot → <dir>/aot/); the engine
+    # deserializes them at construction (orp_tpu/aot/bundle_exec.py)
 
     @property
     def n_dates(self) -> int:
@@ -196,6 +199,10 @@ def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
             )
     verify_policy_compat(f"load_bundle({d})", model, n_dates,
                          state["params1_by_date"])
+    # serialized serving executables ride along when the export was --aot;
+    # recording the dir (not deserializing here) keeps loading cheap and
+    # leaves the fingerprint check to the engine that will actually execute
+    has_aot = (d / "aot" / "aot.json").exists()
     return PolicyBundle(
         model=model,
         backward=BackwardResult.from_policy_state(state),
@@ -206,4 +213,5 @@ def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
         cost_of_capital=float(meta["cost_of_capital"]),
         sim_seed=meta["sim_seed"],
         fingerprint=fp,
+        aot_dir=d if has_aot else None,
     )

@@ -85,9 +85,18 @@ class HedgeEngine:
     dispatches the bucket-shaped executable, and slices the padding back off.
     ``hits``/``misses`` count bucket-cache hits (miss = first request landing
     in a bucket = the one compile that bucket ever pays).
+
+    **AOT bundles**: a policy loaded from a bundle exported with
+    ``orp export --aot`` carries serialized per-bucket executables
+    (``orp_tpu/aot/bundle_exec.py``). They are deserialized HERE, at
+    construction, and requests landing in those buckets execute them
+    directly — zero XLA compiles on a cold process. Any fingerprint or
+    deserialization mismatch warns once and keeps the jit path
+    (``use_aot=False`` opts out entirely, e.g. for A/B timing).
     """
 
-    def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20):
+    def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20,
+                 use_aot: bool = True):
         model = getattr(policy, "model", None)
         if model is None:
             raise ValueError(
@@ -110,9 +119,31 @@ class HedgeEngine:
         self._p2 = self._p1 if p2 is None else jax.tree.map(
             lambda x: jnp.asarray(x, model.dtype), p2)
         self.n_dates = int(jax.tree.leaves(self._p1)[0].shape[0])
+        # price legs per request row (risky legs then bond) — the one
+        # definition evaluate() and the AOT exporter both shape against
+        self.n_instruments = (
+            2 if model.constrain_self_financing else model.n_outputs)
         self.hits = 0
         self.misses = 0
+        self.aot_hits = 0
         self._buckets: set[int] = set()
+        # deserialized per-bucket executables from an --aot bundle: requests
+        # in these buckets never touch the jit cache (load_aot returns {} —
+        # after ONE warning — when the artifacts don't fit this process)
+        self._aot = {}
+        aot_dir = getattr(policy, "aot_dir", None)
+        if use_aot and aot_dir is not None:
+            from orp_tpu.aot.bundle_exec import load_aot
+
+            self._aot = load_aot(
+                aot_dir, policy_fingerprint=getattr(policy, "fingerprint", None)
+            ) or {}
+        # constants of the AOT calling convention, hoisted off the hot path:
+        # the flat (p1, p2) leaves (tuple flatten = concatenated child
+        # flattens, so appending the per-request arrays reproduces the full
+        # jit argument order) and the cost-of-capital scalar
+        self._flat_params = jax.tree.leaves((self._p1, self._p2))
+        self._coc = jnp.asarray(self.cost_of_capital, model.dtype)
         # XLA-compile baseline for THIS engine: `_eval_core`'s executable
         # cache is process-wide, so per-engine counts are deltas from here.
         # The counter rides a private jax attribute (_cache_size) — if a jax
@@ -146,6 +177,8 @@ class HedgeEngine:
             "hits": self.hits,
             "misses": self.misses,
             "buckets": sorted(self._buckets),
+            "aot_buckets": sorted(self._aot),
+            "aot_hits": self.aot_hits,
             "xla_compiles": (
                 now - self._compiles0
                 if now is not None and self._compiles0 is not None else None
@@ -190,8 +223,7 @@ class HedgeEngine:
                 f"date_idx {date_idx} out of range for {self.n_dates} dates")
         idx %= self.n_dates
         has_prices = prices is not None
-        k = self.model.n_outputs if not self.model.constrain_self_financing \
-            else 2
+        k = self.n_instruments
         if has_prices:
             prices = np.asarray(prices)
             if prices.ndim == 1:
@@ -202,6 +234,7 @@ class HedgeEngine:
                     "(risky legs then bond, one row per state)"
                 )
         b = self.bucket_for(n)
+        aot_ex = self._aot.get(b)
         if b in self._buckets:
             self.hits += 1
             # per-request counters are registry-only (sink_event=False): a
@@ -209,6 +242,13 @@ class HedgeEngine:
             # latency every caller is timing. Totals still export via
             # metrics.prom; the RARE miss (once per bucket) keeps its event.
             obs_count("serve/bucket_hits", sink_event=False)
+        elif aot_ex is not None:
+            # first touch of an AOT bucket compiles NOTHING (the executable
+            # shipped in the bundle) — a hit, not a miss: `misses` stays the
+            # engine's compile bill
+            self.hits += 1
+            self._buckets.add(b)
+            obs_count("serve/bucket_aot_warm", bucket=str(b))
         else:
             self.misses += 1
             self._buckets.add(b)
@@ -221,14 +261,23 @@ class HedgeEngine:
             pr = np.zeros((b, k), dt)
             if has_prices:
                 pr[:n] = prices
-        with span("serve/dispatch", attrs={"bucket": b}):
-            phi, psi, v = _eval_core(
-                self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
-                jnp.asarray(feats), jnp.asarray(pr),
-                jnp.asarray(self.cost_of_capital, self.model.dtype),
-                dual_mode=self.dual_mode,
-                holdings_combine=self.holdings_combine,
-            )
+        with span("serve/dispatch", attrs={"bucket": b,
+                                           "aot": aot_ex is not None}):
+            if aot_ex is not None:
+                # exact jit argument order (pre-flattened params + the
+                # per-request arrays), pruned to the inputs XLA kept — the
+                # same program the jit path would compile, minus the compile
+                self.aot_hits += 1
+                flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
+                        jnp.asarray(feats), jnp.asarray(pr), self._coc]
+                phi, psi, v = aot_ex.call_flat(flat)
+            else:
+                phi, psi, v = _eval_core(
+                    self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
+                    jnp.asarray(feats), jnp.asarray(pr), self._coc,
+                    dual_mode=self.dual_mode,
+                    holdings_combine=self.holdings_combine,
+                )
             # block: a served result IS the deliverable — latency metrics on
             # dispatch-only timing would be fiction
             phi, psi, v = jax.block_until_ready((phi, psi, v))
@@ -237,3 +286,16 @@ class HedgeEngine:
             psi = np.asarray(psi)[:n]
             value = np.asarray(v)[:n] if has_prices else None
         return phi, psi, value
+
+    def prewarm(self, sizes) -> dict:
+        """Pre-touch every bucket the given request sizes land in, so no
+        live request ever pays first-touch cost: on a jit engine each
+        bucket's one compile happens HERE (populating the persistent cache
+        when ``orp_tpu.aot.enable_persistent_cache`` is active), on an AOT
+        engine this is a cheap executable shakeout. Returns ``cache_info()``
+        — after a prewarm covering the traffic's sizes, ``misses`` stops
+        moving for good."""
+        dt = np.dtype(jnp.dtype(self.model.dtype).name)
+        for b in sorted({self.bucket_for(int(n)) for n in sizes}):
+            self.evaluate(0, np.ones((b, self.model.n_features), dt))
+        return self.cache_info()

@@ -20,10 +20,12 @@ import jax  # noqa: E402
 # the config update (unlike the env var) reliably pins the platform to CPU.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)  # orp: noqa[ORP001] -- test harness runs x64 CPU oracles by design
-# Persistent XLA compile cache: the suite's wall is dominated by per-test
-# compiles of the same fused-walk/fit programs (~8-16s each, re-done every
-# run). Separate dir from the benchmark cache (.jax_cache): the test env
-# differs (x64 + virtual 8-device CPU) and mixing would churn both.
+# Persistent XLA compile cache via the ONE entry point (orp_tpu/aot/cache.py;
+# it honours the same ORP_TESTS_NO_COMPILE_CACHE kill-switch): the suite's
+# wall is dominated by per-test compiles of the same fused-walk/fit programs
+# (~8-16s each, re-done every run). Separate dir from the benchmark cache
+# (.jax_cache): the test env differs (x64 + virtual 8-device CPU) and mixing
+# would churn both.
 #
 # ORP_TESTS_NO_COMPILE_CACHE=1 disables it (debug knob). Context: XLA
 # reproducibly SEGFAULTS compiling (or cache-serializing) the large
@@ -34,12 +36,12 @@ jax.config.update("jax_enable_x64", True)  # orp: noqa[ORP001] -- test harness r
 # serialize path to backend_compile when the cache was off). The per-round
 # gate therefore runs the two tiers as TWO processes (see pytest.ini),
 # each with this cache enabled as usual.
-if not os.environ.get("ORP_TESTS_NO_COMPILE_CACHE"):
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from orp_tpu.aot.cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
+    min_compile_secs=0.5,
+)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,283 @@
+"""AOT-layer oracles (orp_tpu/aot): the serialize→deserialize round trip is
+bitwise-equal to jit evaluation, a cold engine built from an ``--aot`` bundle
+serves EVERY bucket with zero XLA compiles (pinned by
+``lint.trace_audit.compile_count``), any fingerprint mismatch falls back to
+jit with exactly one warning event, ``orp warm`` populates the persistent
+cache from avals alone, and the one cache entry point resolves
+config/env/kill-switch correctly."""
+
+import json
+import pathlib
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from orp_tpu import obs
+from orp_tpu.aot import (CompileTimeMonitor, device_fingerprint,
+                         enable_persistent_cache, export_aot, load_aot,
+                         resolve_cache_dir)
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.lint.trace_audit import compile_count
+from orp_tpu.serve import HedgeEngine, export_bundle, load_bundle, serve_bench
+from orp_tpu.serve.engine import _eval_core
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+# every bucket reachable by the sweep/bench sizes below — so an AOT engine
+# can prove a FULLY compile-free serve, batcher coalescing included
+AOT_BUCKETS = (8, 16, 32, 64)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(scope="module")
+def aot_bundle(tmp_path_factory, trained):
+    d = tmp_path_factory.mktemp("aot") / "bundle"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=AOT_BUCKETS)
+    return d
+
+
+def _requests(engine, sizes=(1, 7, 33, 64)):
+    """One (date, states, prices) request per (size, date) pair, near the
+    training normalisation."""
+    rng = np.random.default_rng(3)
+    for n in sizes:
+        for t in range(engine.n_dates):
+            states = (1.0 + 0.05 * rng.standard_normal((n, 1))).astype(np.float32)
+            prices = np.stack(
+                [states[:, 0], np.full(n, 0.97, np.float32)], axis=1)
+            yield t, states, prices
+
+
+# -- round trip + zero-compile pin -------------------------------------------
+
+
+def test_aot_roundtrip_bitwise_equals_jit(aot_bundle):
+    """Acceptance pin: the deserialized executable IS the program the jit
+    path would compile — same bits out, for phi, psi AND value, across
+    sizes and dates."""
+    bundle = load_bundle(aot_bundle)
+    assert bundle.aot_dir == aot_bundle
+    aot_eng = HedgeEngine(bundle)
+    jit_eng = HedgeEngine(bundle, use_aot=False)
+    assert jit_eng.cache_info()["aot_buckets"] == []
+    for t, states, prices in _requests(aot_eng):
+        pa, sa, va = aot_eng.evaluate(t, states, prices)
+        pj, sj, vj = jit_eng.evaluate(t, states, prices)
+        np.testing.assert_array_equal(pa, pj)
+        np.testing.assert_array_equal(sa, sj)
+        np.testing.assert_array_equal(va, vj)
+    assert aot_eng.aot_hits == len(list(_requests(aot_eng)))
+
+
+def test_cold_engine_serves_every_bucket_with_zero_compiles(aot_bundle):
+    """THE cold-start proof: an engine built from an --aot bundle answers a
+    full bucket sweep across all dates without growing `_eval_core`'s
+    executable cache at all (lint.trace_audit.compile_count)."""
+    engine = HedgeEngine(load_bundle(aot_bundle))
+    before = compile_count(_eval_core)
+    for t, states, prices in _requests(engine):
+        phi, psi, value = engine.evaluate(t, states, prices)
+        assert phi.shape == (len(states),) and value is not None
+    assert compile_count(_eval_core) == before
+    info = engine.cache_info()
+    assert info["xla_compiles"] == 0
+    assert info["misses"] == 0  # no bucket ever paid a compile
+    assert info["buckets"] == [8, 64]  # sizes 1/7 -> 8; 33/64 -> 64
+    assert info["aot_buckets"] == list(AOT_BUCKETS)
+    assert info["aot_hits"] > 0
+
+
+def test_aot_dual_policy_roundtrip(tmp_path):
+    """A separate-dual policy ships TWO per-date param sets: the executable
+    keeps both trees' leaves plus the cost-of-capital scalar, and the
+    pruned calling convention still lines up bitwise with jit."""
+    trained = european_hedge(
+        EURO, SIM, TrainConfig(dual_mode="separate", epochs_first=10,
+                               epochs_warm=5))
+    d = tmp_path / "dual"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=(4,))
+    bundle = load_bundle(d)
+    aot_eng = HedgeEngine(bundle)
+    jit_eng = HedgeEngine(bundle, use_aot=False)
+    states = np.linspace(0.9, 1.1, 5, dtype=np.float32)[:, None]
+    prices = np.stack([states[:, 0], np.full(5, 0.96, np.float32)], axis=1)
+    before = compile_count(_eval_core)
+    pa, sa, va = aot_eng.evaluate(1, states, prices)
+    assert compile_count(_eval_core) == before  # zero compiles for the AOT eval
+    pj, sj, vj = jit_eng.evaluate(1, states, prices)
+    np.testing.assert_array_equal(pa, pj)
+    np.testing.assert_array_equal(sa, sj)
+    np.testing.assert_array_equal(va, vj)
+
+
+# -- fingerprint guard + jit fallback ----------------------------------------
+
+
+def _tampered_copy(aot_bundle, tmp_path, mutate):
+    d = tmp_path / "tampered"
+    shutil.copytree(aot_bundle, d)
+    meta_f = d / "aot" / "aot.json"
+    manifest = json.loads(meta_f.read_text())
+    mutate(manifest)
+    meta_f.write_text(json.dumps(manifest))
+    return d
+
+
+def test_fingerprint_mismatch_falls_back_to_jit(aot_bundle, tmp_path):
+    """A bundle exported for another jaxlib serves CORRECTLY (jit path),
+    costs its compiles again, and says so exactly once — a warning plus one
+    obs counter event; no crash anywhere."""
+    d = _tampered_copy(
+        aot_bundle, tmp_path,
+        lambda m: m["fingerprint"].__setitem__("jaxlib", "0.0.0"))
+    with obs.telemetry(None) as st:
+        with pytest.warns(UserWarning, match="falling back to jit"):
+            engine = HedgeEngine(load_bundle(d))
+        states = np.ones((3, 1), np.float32)
+        phi, psi, _ = engine.evaluate(0, states)
+    assert engine.cache_info()["aot_buckets"] == []
+    events = [e for e in st.sink.events
+              if e.get("name") == "aot/fingerprint_mismatch"]
+    assert len(events) == 1
+    assert "jaxlib" in events[0]["labels"]["reason"]
+    # the jit path serves the same numbers the intact bundle would
+    ref = HedgeEngine(load_bundle(aot_bundle), use_aot=False)
+    np.testing.assert_array_equal(phi, ref.evaluate(0, states)[0])
+
+
+def test_foreign_format_and_policy_mismatch_fall_back(aot_bundle, tmp_path):
+    for mutate, match in (
+        (lambda m: m.__setitem__("format", "orp-aot-v999"), "format"),
+        (lambda m: m.__setitem__("policy_fingerprint", "other"), "policy"),
+    ):
+        d = _tampered_copy(aot_bundle, tmp_path / match, mutate)
+        with pytest.warns(UserWarning, match="falling back to jit"):
+            engine = HedgeEngine(load_bundle(d))
+        assert engine.cache_info()["aot_buckets"] == []
+    # a bundle with NO aot artifacts is silent (nothing to warn about)
+    assert load_aot(tmp_path) is None
+
+
+def test_aot_manifest_records_device_and_cost(aot_bundle):
+    manifest = json.loads((aot_bundle / "aot" / "aot.json").read_text())
+    assert manifest["format"] == "orp-aot-v1"
+    assert manifest["fingerprint"] == device_fingerprint()
+    assert manifest["policy_fingerprint"].startswith("orp-policy-v1")
+    assert sorted(int(b) for b in manifest["buckets"]) == list(AOT_BUCKETS)
+    for b, entry in manifest["buckets"].items():
+        blob = aot_bundle / "aot" / entry["file"]
+        assert blob.stat().st_size == entry["serialized_bytes"] > 0
+        assert entry["kept"] == sorted(entry["kept"])
+        assert entry["compile_wall_s"] >= 0
+        assert entry["flops"] > 0  # cost_analysis rode into the manifest
+
+
+# -- prewarm + serve-bench contract ------------------------------------------
+
+
+def test_engine_prewarm_covers_buckets(trained):
+    engine = HedgeEngine(trained)
+    info = engine.prewarm([1, 7, 64])
+    assert info["buckets"] == [8, 64]
+    assert info["misses"] == 2
+    # idempotent: a second prewarm compiles nothing new
+    info = engine.prewarm([1, 7, 64])
+    assert info["misses"] == 2 and info["hits"] >= 2
+
+
+def test_serve_bench_prewarm_asserts_no_measured_compiles(trained):
+    rec = serve_bench(trained, n_requests=8, batch_sizes=(1, 7),
+                      batcher_requests=4, prewarm=True)
+    assert rec["prewarm"] is True
+    assert rec["cache_misses_after_warmup"] == 0
+
+
+def test_serve_bench_on_aot_bundle_is_compile_free(aot_bundle):
+    """The serving cold-start headline: a fresh engine over an --aot bundle
+    runs the whole bench — batcher coalescing included — with ZERO XLA
+    compiles."""
+    rec = serve_bench(load_bundle(aot_bundle), n_requests=12,
+                      batch_sizes=(1, 7, 64), batcher_requests=8,
+                      prewarm=True)
+    assert rec["xla_compiles"] == 0
+    assert rec["aot_buckets"] == list(AOT_BUCKETS)
+    assert rec["cache_misses_after_warmup"] == 0
+    assert rec["aot_hits"] > 0
+
+
+# -- the one cache entry point ------------------------------------------------
+
+
+def test_cache_entry_point_resolution(tmp_path, monkeypatch):
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        # explicit argument wins; config lands in jax
+        got = enable_persistent_cache(tmp_path / "a", min_compile_secs=0.25)
+        assert got == tmp_path / "a"
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "a")
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.25
+        # env override when no argument
+        monkeypatch.setenv("ORP_JAX_CACHE_DIR", str(tmp_path / "env"))
+        assert resolve_cache_dir() == tmp_path / "env"
+        # kill-switch turns every call into a no-op
+        monkeypatch.setenv("ORP_TESTS_NO_COMPILE_CACHE", "1")
+        assert resolve_cache_dir() is None
+        assert enable_persistent_cache(tmp_path / "b") is None
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "a")
+    finally:
+        # restore through the entry point: it also drops jax's memoized
+        # cache handle, so the rest of the suite writes the harness cache
+        # again instead of this test's deleted tmp dir (kill-switch must go
+        # first or the restore itself would be a no-op)
+        monkeypatch.delenv("ORP_TESTS_NO_COMPILE_CACHE", raising=False)
+        enable_persistent_cache(prev_dir, min_compile_secs=prev_min)
+
+
+def test_warm_cli_populates_cache_from_avals(tmp_path, capsys):
+    """`orp warm` compiles the fused walk for the requested shape without
+    simulating a single path, and the persistent cache dir gains the
+    executables a later same-config run will read."""
+    from orp_tpu import cli
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cache = tmp_path / "warmcache"
+    try:
+        cli.main([
+            "warm", "--pipeline", "euro", "--paths", "256", "--steps", "4",
+            "--rebalance-every", "2", "--epochs-first", "10",
+            "--epochs-warm", "5", "--batch-size", "256",
+            "--cache-dir", str(cache), "--json",
+        ])
+    finally:
+        enable_persistent_cache(prev_dir, min_compile_secs=prev_min)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["cache_dir"] == str(cache)
+    assert out["fn"] == "fused_walk/256x2"
+    assert out["compile_wall_s"] > 0 and out["flops"] > 0
+    assert out["n_paths"] == 256 and out["n_dates"] == 2
+    assert any(cache.iterdir())  # the executable actually persisted
+
+
+def test_compile_time_monitor_splits_compile_from_execute():
+    f = jax.jit(lambda x: x * 2.9173 + x.sum())
+    x = jax.numpy.ones((17, 3))
+    with CompileTimeMonitor() as cold:
+        jax.block_until_ready(f(x))
+    assert cold.supported and cold.events >= 1 and cold.seconds > 0
+    with CompileTimeMonitor() as warm:
+        jax.block_until_ready(f(x))
+    assert warm.seconds == 0.0  # cached executable: no compile events
+    split = cold.split(10.0)
+    assert split["compile_wall_s"] == round(cold.seconds, 3)
+    assert split["execute_wall_s"] == round(10.0 - cold.seconds, 3)

@@ -24,7 +24,7 @@ def codes(src, **kw):
 
 
 def test_rule_registry_complete():
-    assert set(RULES) == {f"ORP00{i}" for i in range(1, 8)}
+    assert set(RULES) == {f"ORP00{i}" for i in range(1, 9)}
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -470,6 +470,50 @@ def test_orp007_nested_sync_does_not_vouch_for_outer_timing():
             return time.perf_counter() - t0, y
     """
     assert codes(src) == ["ORP007"]
+
+
+# -- ORP008: compile-cache single entry point --------------------------------
+
+ORP008_POS = """
+    import jax
+    import pathlib
+
+    def main():
+        jax.config.update("jax_compilation_cache_dir", str(pathlib.Path(".jax_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+"""
+
+ORP008_NEG = """
+    import jax
+    from orp_tpu.aot import enable_persistent_cache
+
+    def main():
+        enable_persistent_cache()                      # THE entry point
+        jax.config.update("jax_platforms", "cpu")      # not a cache key
+        jax.config.update("jax_default_matmul_precision", "highest")
+"""
+
+
+def test_orp008_flags_direct_cache_config():
+    got = codes(ORP008_POS)
+    assert got.count("ORP008") == 2  # cache dir + persistence threshold
+
+
+def test_orp008_clean_negative():
+    assert codes(ORP008_NEG) == []
+
+
+def test_orp008_allowlists_the_aot_cache_module():
+    src = textwrap.dedent(ORP008_POS)
+    assert lint_source(src, path="orp_tpu/aot/cache.py") == []
+
+
+def test_orp008_noqa_suppresses():
+    src = """
+        import jax
+        jax.config.update("jax_compilation_cache_dir", "/tmp/c")  # orp: noqa[ORP008] -- bootstrap probe
+    """
+    assert codes(src) == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -38,7 +38,9 @@ def main(argv=None):
     import jax.numpy as jnp
     import numpy as np
 
-    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    from orp_tpu.aot import enable_persistent_cache
+
+    enable_persistent_cache()  # one entry point (ORP008): repo .jax_cache, env-overridable
 
     from orp_tpu.risk.controls import martingale_ols_price
     from orp_tpu.sde import TimeGrid, payoffs, simulate_gbm_log

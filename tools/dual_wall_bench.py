@@ -27,7 +27,9 @@ def main(argv=None):
 
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    from orp_tpu.aot import enable_persistent_cache
+
+    enable_persistent_cache()  # one entry point (ORP008): repo .jax_cache, env-overridable
     from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
 
     n = 1 << args.paths_log2

@@ -43,7 +43,9 @@ def main(argv=None):
 
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    from orp_tpu.aot import enable_persistent_cache
+
+    enable_persistent_cache()  # one entry point (ORP008): repo .jax_cache, env-overridable
     from benchmarks.north_star import main as ns
 
     if args.configs:

@@ -26,9 +26,9 @@ from tools._measure import Recorder, env_payload, rqmc_stage  # noqa: E402
 
 
 def main(out_path, tag="f32fix"):
-    import jax
+    from orp_tpu.aot import enable_persistent_cache
 
-    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    enable_persistent_cache()  # one entry point (ORP008): repo .jax_cache, env-overridable
     rec = Recorder(out_path)
     rec.emit(f"precision_{tag}_env", env_payload())
 

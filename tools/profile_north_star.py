@@ -40,8 +40,17 @@ from orp_tpu.train import losses as L
 
 
 def main(n_log2=20):
-    jax.config.update("jax_compilation_cache_dir", str(
-        pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"))
+    from orp_tpu.aot import CompileTimeMonitor, enable_persistent_cache
+
+    enable_persistent_cache()  # one entry point (ORP008), env-overridable
+    # every XLA compile second in this run is metered, so the record carries
+    # a first-class compile-vs-execute wall split instead of the split being
+    # inferable only from a cold/warm run pair
+    with CompileTimeMonitor() as _compile_mon:
+        _main_profiled(n_log2, _compile_mon)
+
+
+def _main_profiled(n_log2, compile_mon):
     n_paths = 1 << n_log2
     euro = EuropeanConfig(constrain_self_financing=False)
     sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 364, rebalance_every=7)
@@ -223,6 +232,11 @@ def main(n_log2=20):
     stamps["flops_adam_walk"] = F.phase_report(
         F.adam_walk_flops(n_paths, n_dates, train.epochs_first,
                           train.epochs_warm), stamps["fused_walk_warm"])
+
+    # first-class compile/execute split (ISSUE 5 satellite): total XLA
+    # compile seconds across the whole profile vs everything else
+    total_wall = time.perf_counter() - t_all
+    stamps.update(compile_mon.split(total_wall))
 
     stamps = {
         k: round(v, 3) if isinstance(v, float) else v for k, v in stamps.items()

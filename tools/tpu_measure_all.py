@@ -34,9 +34,9 @@ from tools._measure import (  # noqa: E402
 
 
 def main(out_path, only=None):
-    import jax
+    from orp_tpu.aot import enable_persistent_cache
 
-    jax.config.update("jax_compilation_cache_dir", str(HERE / ".jax_cache"))
+    enable_persistent_cache()  # one entry point (ORP008): repo .jax_cache, env-overridable
     rec = Recorder(out_path)
     emit, stage = rec.emit, rec.stage
 
