@@ -19,6 +19,9 @@ Subpackages (layer map mirrors SURVEY.md §1):
 - ``parallel``     mesh / sharding / distributed-quantile utilities
 - ``api``      L7  config-driven entry points (``replicating_portfolio`` etc.)
 - ``serve``    L8  exportable policy bundles + batched low-latency serving
+- ``guard``    fault tolerance: NaN sentinels + trainer degradation ladder,
+               serve deadlines / load shedding / retries / circuit breaker,
+               deterministic fault injection (chaos suite)
 - ``lint``     JAX/TPU-aware static analyzer + runtime compile auditor
 - ``obs``      telemetry spine: metrics registry, device-complete spans,
                JSONL/Prometheus sinks, run manifests (zero-cost when off)

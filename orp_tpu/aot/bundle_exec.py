@@ -34,6 +34,7 @@ from orp_tpu.aot.compile import (AotUnsupported, aot_compile,
                                  deserialize_executable, device_fingerprint,
                                  serialize_compiled)
 from orp_tpu.obs import count as obs_count
+from orp_tpu.utils.atomic import atomic_write_bytes, atomic_write_text
 
 AOT_SUBDIR = "aot"
 AOT_META = "aot.json"
@@ -113,7 +114,7 @@ def export_aot(directory: str | pathlib.Path, policy, *,
         blob, kept = serialize_compiled(compiled)  # AotUnsupported propagates:
         # an export that cannot ship executables should fail loudly, not
         # write a bundle that silently lacks its advertised artifact
-        (adir / _bucket_file(b)).write_bytes(blob)
+        atomic_write_bytes(adir / _bucket_file(b), blob)
         entries[str(b)] = {
             "file": _bucket_file(b),
             "kept": kept,
@@ -126,7 +127,10 @@ def export_aot(directory: str | pathlib.Path, policy, *,
         "policy_fingerprint": getattr(policy, "fingerprint", None),
         "buckets": entries,
     }
-    (adir / AOT_META).write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    # atomic, and written LAST: the manifest is the load-side source of
+    # truth, so it must never name a blob that didn't finish writing
+    atomic_write_text(adir / AOT_META,
+                      json.dumps(manifest, indent=1, sort_keys=True))
     return manifest
 
 
@@ -182,6 +186,6 @@ def load_aot(directory: str | pathlib.Path, *,
             blob = (adir / entry["file"]).read_bytes()
             out[int(b_str)] = AotExecutable(
                 deserialize_executable(blob), entry["kept"], int(b_str))
-    except Exception as e:  # any failure mode here has the same answer: jit
+    except Exception as e:  # orp: noqa[ORP009] -- _fallback warns + emits aot/fingerprint_mismatch; any failure mode here has the same answer: jit
         return _fallback(directory, f"deserialization failed: {e}")
     return out

@@ -28,6 +28,9 @@ from __future__ import annotations
 
 import os
 import pathlib
+import warnings
+
+from orp_tpu.obs import count as obs_count
 
 ENV_CACHE_DIR = "ORP_JAX_CACHE_DIR"
 ENV_DISABLE = "ORP_TESTS_NO_COMPILE_CACHE"
@@ -81,8 +84,17 @@ def enable_persistent_cache(
             from jax._src import compilation_cache as _cc
 
             _cc.reset_cache()
-        except Exception:
-            pass
+        except Exception as e:
+            # degrading, not silent (guard audit): the redirect may be
+            # ignored by this jax — the operator warming a cache dir needs
+            # to know compiles may land in the OLD one
+            warnings.warn(
+                f"could not drop jax's memoized compile-cache handle "
+                f"({type(e).__name__}: {e}); the cache-dir redirect to {d} "
+                "may be ignored for the rest of this process",
+                stacklevel=2,
+            )
+            obs_count("aot/cache_reset_failed")
     return d
 
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import set_gauge as obs_set_gauge
@@ -78,7 +79,15 @@ class CompileTimeMonitor:
 
             monitoring.register_event_duration_secs_listener(self._listener)
             self._monitoring = monitoring
-        except Exception:
+        except Exception as e:
+            # degrading, not silent (guard audit): the compile/execute wall
+            # split in every bench record downstream will report None
+            warnings.warn(
+                f"jax monitoring listener unavailable ({type(e).__name__}: "
+                f"{e}); compile-wall split degrades to None",
+                stacklevel=2,
+            )
+            obs_count("aot/monitor_unsupported")
             self.supported = False
             self._monitoring = None
         return self
@@ -88,10 +97,17 @@ class CompileTimeMonitor:
             try:
                 self._monitoring._unregister_event_duration_listener_by_callback(
                     self._listener)
-            except Exception:
+            except Exception as e:
                 # worst case the listener outlives the region and keeps
-                # adding to this monitor's counters — never breaks the run
-                pass
+                # adding to this monitor's counters — never breaks the run,
+                # but say so (guard audit: no silent swallows)
+                warnings.warn(
+                    f"could not unregister the compile-time listener "
+                    f"({type(e).__name__}: {e}); this monitor may keep "
+                    "accumulating compile seconds past its region",
+                    stacklevel=2,
+                )
+                obs_count("aot/monitor_unregister_failed")
         self._monitoring = None
 
     def split(self, total_wall_s: float) -> dict:
@@ -110,7 +126,15 @@ def cost_summary(compiled) -> dict:
     JSON-able floats (this jax wraps the dict in a one-element list)."""
     try:
         ca = compiled.cost_analysis()
-    except Exception:
+    except Exception as e:
+        # degrading, not silent (guard audit): the aot manifest/bench
+        # record simply lacks flops/bytes fields on this backend
+        warnings.warn(
+            f"cost_analysis unavailable ({type(e).__name__}: {e}); "
+            "FLOPs/bytes fields will be absent from this compile's record",
+            stacklevel=2,
+        )
+        obs_count("aot/cost_analysis_unavailable")
         return {}
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}

@@ -124,6 +124,9 @@ class TrainConfig:
     checkpoint_dir: str | None = None  # persist/resume per backward date
     shuffle: bool | str = True  # True/"full" | "blocks" | False (FitConfig.shuffle)
     fused: bool = False  # whole walk as one XLA program (BackwardConfig.fused)
+    nan_guard: bool = False  # per-date NaN/Inf sentinel + trainer ladder
+    # (BackwardConfig.nan_guard; orp_tpu/guard/sentinel.py)
+    nan_retries: int = 2  # bounded ladder budget per date (nan_guard only)
 
     def __post_init__(self):
         # fail at config construction, not after an expensive 1M-path sim
@@ -134,6 +137,12 @@ class TrainConfig:
             raise ValueError(
                 "fused=True runs the whole walk device-side; per-date "
                 "checkpointing needs the host loop (fused=False)"
+            )
+        if self.fused and self.nan_guard:
+            raise ValueError(
+                "fused=True runs the whole walk device-side; the NaN "
+                "sentinel's per-date host checks need the host loop "
+                "(fused=False)"
             )
 
 

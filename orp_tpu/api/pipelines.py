@@ -269,6 +269,8 @@ def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfi
         checkpoint_dir=t.checkpoint_dir,
         shuffle=t.shuffle,
         fused=t.fused,
+        nan_guard=t.nan_guard,
+        nan_retries=t.nan_retries,
     )
 
 

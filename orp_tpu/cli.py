@@ -42,11 +42,18 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 pipeline/shape WITHOUT simulating or training, so the next
                 real run skips the 60-90s whole-walk compile (``orp_tpu/aot``)
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP008 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP009 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
-                cache config outside orp_tpu/aot); exits non-zero on
-                findings so it gates commits (tools/lint_all.py)
+                cache config outside orp_tpu/aot, silent broad excepts);
+                exits non-zero on findings so it gates commits
+                (tools/lint_all.py)
+
+Training commands take ``--checkpoint-dir DIR`` (persist per-date state) /
+``--resume DIR`` (continue an interrupted walk, bitwise-equal to an
+uninterrupted run) and ``--nan-guard`` (per-date NaN sentinel with the
+adam->gauss_newton->final_solve degradation ladder) — the ``orp_tpu/guard``
+fault-tolerance layer.
 
 Every training command (and ``serve-bench``) accepts ``--telemetry DIR``: the
 run executes under an ``orp_tpu.obs`` session and drops a telemetry bundle —
@@ -69,18 +76,45 @@ import numpy as np
 def _train_cfg(args, default_dual: str):
     from orp_tpu.api import TrainConfig
 
-    if args.fused and args.checkpoint_dir is not None:
+    ckdir = args.checkpoint_dir
+    resume = getattr(args, "resume", None)
+    if resume is not None:
+        import pathlib
+
+        # --resume DIR = continue an interrupted checkpointed walk: DIR must
+        # actually hold per-date state (a typo'd path silently STARTING a
+        # fresh run is exactly the failure --resume exists to rule out);
+        # the run keeps checkpointing into the same DIR as it continues.
+        # Resolve before comparing: './ck' and 'ck' are the same directory
+        if (ckdir is not None
+                and pathlib.Path(ckdir).resolve()
+                != pathlib.Path(resume).resolve()):
+            raise SystemExit(
+                "error: --resume and --checkpoint-dir name different "
+                "directories; --resume DIR both resumes from and keeps "
+                "checkpointing into DIR (drop one of the flags)"
+            )
+        from orp_tpu.utils.checkpoint import latest_step
+
+        if latest_step(resume) is None:
+            raise SystemExit(
+                f"error: --resume {resume}: no per-date checkpoints found "
+                "there — to start a fresh checkpointed run use "
+                "--checkpoint-dir"
+            )
+        ckdir = resume
+    if args.fused and ckdir is not None:
         # clean CLI error instead of the TrainConfig ValueError traceback
         raise SystemExit(
             "error: --fused runs the whole walk device-side and cannot "
-            "checkpoint per date; drop --fused or --checkpoint-dir"
+            "checkpoint per date; drop --fused or --checkpoint-dir/--resume"
         )
     return TrainConfig(
         epochs_first=args.epochs_first,
         epochs_warm=args.epochs_warm,
         batch_size=args.batch_size,
         dual_mode=args.dual_mode or default_dual,
-        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_dir=ckdir,
         fused=args.fused,
         shuffle="blocks" if args.fused else True,
         final_solve=args.final_solve,
@@ -89,6 +123,8 @@ def _train_cfg(args, default_dual: str):
         gn_iters_warm=args.gn_iters_warm,
         gn_quantile=not args.adam_quantile,
         gn_block_rows=args.gn_block_rows,
+        nan_guard=getattr(args, "nan_guard", False),
+        nan_retries=getattr(args, "nan_retries", 2),
     )
 
 
@@ -99,6 +135,20 @@ def _add_train_flags(p):
     p.add_argument("--dual-mode", choices=["separate", "shared", "mse_only"], default=None)
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist per-date state; rerun resumes automatically")
+    p.add_argument("--resume", default=None, metavar="DIR",
+                   help="resume an interrupted checkpointed walk from DIR "
+                        "(must hold per-date state; refuses an empty dir — "
+                        "use --checkpoint-dir to start one). The resumed "
+                        "ledger is bitwise-equal to an uninterrupted run")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="per-date NaN/Inf sentinel (orp_tpu/guard): on a "
+                        "non-finite loss/params, emit guard/nan_event and "
+                        "retry that date one trainer rung down the ladder "
+                        "adam->gauss_newton->final_solve instead of "
+                        "corrupting every earlier date")
+    p.add_argument("--nan-retries", type=int, default=2,
+                   help="with --nan-guard: bounded ladder budget per date "
+                        "(exhausted -> the walk raises)")
     p.add_argument("--fused", action="store_true",
                    help="whole backward walk as ONE XLA program (blocks "
                         "shuffle; incompatible with --checkpoint-dir)")
@@ -954,8 +1004,8 @@ def build_parser():
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
-             "drift, key reuse — rules ORP001-ORP008); non-zero exit on "
-             "findings",
+             "drift, key reuse, silent excepts — rules ORP001-ORP009); "
+             "non-zero exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: the orp_tpu "

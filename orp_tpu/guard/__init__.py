@@ -1,0 +1,52 @@
+"""orp_tpu.guard — fault tolerance for training and serving.
+
+The north-star is a production system under heavy traffic (ROADMAP); this
+package is the layer that keeps it standing when something breaks mid-run:
+
+- ``sentinel``  — NaN/Inf sentinels on every backward-walk fit with a
+                  bounded trainer degradation ladder
+                  (``adam -> gauss_newton -> final_solve``), wired into
+                  ``train/backward.py`` behind ``BackwardConfig.nan_guard``;
+- ``serve``     — the serving resilience policy types: per-request
+                  deadlines + queue-age tracking, admission-watermark load
+                  shedding with structured :class:`Rejection` results,
+                  bounded retry-with-backoff for transient dispatch
+                  failures, and the :class:`CircuitBreaker` that demotes a
+                  repeatedly-failing AOT bucket executable to the jit path;
+- ``inject``    — the deterministic, seed-driven fault injector the chaos
+                  suite (``tests/test_guard.py``) drives: NaN-poisoned fit
+                  targets, synthetic process death between checkpointed
+                  dates, transient/slow dispatches, corrupted artifact
+                  blobs.
+
+Training-side persistence hardening (atomic side files, per-date integrity
+digests, ``--resume DIR``) lives with the machinery it guards in
+``utils/checkpoint.py`` / ``utils/fingerprint.py``; the walk-level hooks
+are in ``train/backward.py``. Everything is opt-in and zero-cost off: the
+clean path pays one module-global load per hook site, the same discipline
+``orp_tpu.obs`` proved.
+"""
+
+from orp_tpu.guard.inject import (FaultInjector, FaultPlan, InjectedFault,
+                                  WalkKilled, faults)
+from orp_tpu.guard.sentinel import (TRAINER_LADDER, all_finite,
+                                    degradation_ladder, sanitize_target)
+from orp_tpu.guard.serve import (CircuitBreaker, GuardPolicy, Rejection,
+                                 TransientDispatchError, is_rejection)
+
+__all__ = [
+    "CircuitBreaker",
+    "FaultInjector",
+    "FaultPlan",
+    "GuardPolicy",
+    "InjectedFault",
+    "Rejection",
+    "TRAINER_LADDER",
+    "TransientDispatchError",
+    "WalkKilled",
+    "all_finite",
+    "degradation_ladder",
+    "faults",
+    "is_rejection",
+    "sanitize_target",
+]

@@ -1,0 +1,194 @@
+"""Deterministic, seed-driven fault injection: the proof harness for guard.
+
+A resilience layer that has never seen a fault is a comment, not a feature.
+This module is the ONE place synthetic faults come from — every chaos test
+in ``tests/test_guard.py`` drives the real production code paths (the
+backward walk, the serving engine, the micro-batcher) through the same
+hooks, with faults that are:
+
+- **deterministic**: every decision comes from ``numpy.random.default_rng``
+  seeded at construction plus per-site call counters — the same plan
+  replayed against the same workload injects byte-identical faults, so a
+  chaos test is as re-runnable as any other oracle test;
+- **scoped**: hooks fire only while a plan is installed (``with
+  inject.faults(plan):``). The clean path pays ONE module-global load per
+  hook site — the ``orp_tpu.obs`` disabled-mode discipline — and the
+  hooks are no-ops in any process that never imports a chaos test;
+- **budgeted**: failure/delay sites fire for their first ``n`` matching
+  calls and then stop, so a test exercises recovery, not a permanent
+  outage.
+
+Fault kinds (mirroring the guard features they prove):
+
+- ``corrupt_target``  — NaN-poison a fraction of a backward-walk fit
+  target at chosen dates (proves the NaN sentinel + trainer ladder);
+- ``kill_after_step`` — raise ``WalkKilled`` right after date ``k``'s
+  checkpoint is persisted (proves kill-and-resume bitwise equality);
+- ``fail(site)``      — raise ``InjectedFault`` (a transient dispatch
+  error) for the first ``n`` calls at a site (proves retry-with-backoff
+  and the AOT circuit breaker);
+- ``delay(site)``     — sleep a fixed, small duration for the first ``n``
+  calls (proves deadlines/shedding; chaos tests keep every sleep < 50ms);
+- ``corrupt_bytes``   — flip seeded bytes in a serialized blob (proves
+  bundle/AOT artifact tamper detection and fallback).
+
+Hook sites in production code (grep for ``inject.active()``):
+``train/fit_target`` and the kill switch in ``train/backward.py``,
+``serve/dispatch`` and ``serve/aot_dispatch`` in ``serve/engine.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from orp_tpu.guard.serve import TransientDispatchError
+
+
+class InjectedFault(TransientDispatchError):
+    """A synthetic transient failure (retryable by construction)."""
+
+
+class WalkKilled(RuntimeError):
+    """Synthetic process death: raised after a per-date checkpoint commits,
+    simulating preemption between dates. The checkpointed state on disk is
+    exactly what a real SIGKILL at that point would leave behind."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """What to inject, where, how often. Frozen intent, mutable counters."""
+
+    seed: int = 0
+    # backward-walk faults
+    nan_dates: frozenset[int] = frozenset()  # walk step indices (0 = latest date)
+    nan_frac: float = 0.01                   # fraction of target rows poisoned
+    kill_after_step: int | None = None       # raise WalkKilled after this step's save
+    # site faults: site -> how many of its first calls fail / are delayed
+    fail: dict[str, int] = dataclasses.field(default_factory=dict)
+    delay: dict[str, tuple[int, float]] = dataclasses.field(
+        default_factory=dict)  # site -> (n_calls, seconds)
+
+
+class FaultInjector:
+    """One installed :class:`FaultPlan` plus its deterministic state.
+
+    Thread-safe: the batcher worker and request threads may hit sites
+    concurrently; per-site counters advance under one lock, so the fault
+    sequence is a deterministic function of the call ORDER (which the
+    chaos tests make deterministic by construction).
+
+    ``log`` records every injected fault as ``(site, detail)`` tuples —
+    tests assert on it to prove the plan fired exactly as scheduled.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.log: list[tuple[str, str]] = []
+        self._rng = np.random.default_rng(plan.seed)
+        self._lock = threading.Lock()
+        self._site_calls: dict[str, int] = {}
+
+    # -- backward walk -------------------------------------------------------
+
+    def corrupt_target(self, step_i: int, target):
+        """NaN-poison ``target`` when ``step_i`` is a planned NaN date;
+        otherwise return it untouched. The poisoned row set is drawn from
+        the plan's rng — same seed, same rows, every run."""
+        if step_i not in self.plan.nan_dates:
+            return target
+        import jax.numpy as jnp
+
+        n = int(target.shape[0])
+        k = max(1, int(round(self.plan.nan_frac * n)))
+        with self._lock:
+            rows = np.sort(self._rng.choice(n, size=k, replace=False))
+            self.log.append(("train/fit_target", f"step={step_i} rows={k}"))
+        mask = np.zeros(n, bool)
+        mask[rows] = True
+        return jnp.where(jnp.asarray(mask), jnp.nan, target)
+
+    def maybe_kill(self, step_i: int) -> None:
+        """Raise :class:`WalkKilled` if the plan schedules death after this
+        step (called AFTER the step's checkpoint committed)."""
+        if self.plan.kill_after_step == step_i:
+            with self._lock:
+                self.log.append(("train/kill", f"step={step_i}"))
+            raise WalkKilled(
+                f"injected process death after backward step {step_i} "
+                "(checkpoint for this date is already on disk)"
+            )
+
+    # -- site faults ---------------------------------------------------------
+
+    def _take(self, site: str, budget: int) -> int | None:
+        """Consume one call at ``site``; returns the (0-based) call index if
+        it falls inside ``budget``, else None."""
+        with self._lock:
+            i = self._site_calls.get(site, 0)
+            self._site_calls[site] = i + 1
+            return i if i < budget else None
+
+    def fire(self, site: str, **attrs) -> None:
+        """One production call passed ``site``: raise/delay per the plan.
+
+        Delay is applied before failure so a site planned with both
+        simulates a slow THEN failing dependency.
+        """
+        n_delay, secs = self.plan.delay.get(site, (0, 0.0))
+        if n_delay and self._take(f"delay:{site}", n_delay) is not None:
+            with self._lock:
+                self.log.append((site, f"delay {secs * 1e3:.0f}ms {attrs}"))
+            time.sleep(secs)
+        n_fail = self.plan.fail.get(site, 0)
+        if n_fail and self._take(f"fail:{site}", n_fail) is not None:
+            with self._lock:
+                self.log.append((site, f"fail {attrs}"))
+            raise InjectedFault(f"injected fault at {site} {attrs}")
+
+    # -- artifacts -----------------------------------------------------------
+
+    def corrupt_bytes(self, blob: bytes, n_flips: int = 8) -> bytes:
+        """Flip ``n_flips`` seeded byte positions of ``blob`` (tamper a
+        serialized executable / checkpoint array in place). Empty blobs
+        come back empty."""
+        if not blob:
+            return blob
+        buf = bytearray(blob)
+        with self._lock:
+            pos = self._rng.choice(len(buf), size=min(n_flips, len(buf)),
+                                   replace=False)
+            self.log.append(("artifact/corrupt", f"bytes={len(pos)}"))
+        for p in pos:
+            buf[p] ^= 0xFF
+        return bytes(buf)
+
+
+_ACTIVE: FaultInjector | None = None
+
+
+def active() -> FaultInjector | None:
+    """The installed injector, or None (the always-clean production path —
+    one module-global load, the obs disabled-mode discipline)."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def faults(plan: FaultPlan):
+    """Install ``plan`` for the scope; yields the live injector (its ``log``
+    is the test's injection ledger). Nesting is rejected — overlapping
+    chaos plans would destroy the determinism this module exists for."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already installed; chaos plans "
+                           "do not nest")
+    inj = FaultInjector(plan)
+    _ACTIVE = inj
+    try:
+        yield inj
+    finally:
+        _ACTIVE = None

@@ -1,0 +1,101 @@
+"""NaN/Inf sentinels + the trainer degradation ladder for the backward walk.
+
+A non-finite loss or parameter tree at date ``t`` of the backward walk is
+not a local event: date ``t``'s values are date ``t-1``'s fit TARGETS, so
+one divergence silently poisons every earlier date and the final price —
+the worst possible failure shape for a 52-date, 1M-path run (Buehler et
+al. frame exactly this per-step divergence hazard for long-horizon hedge
+training; PAPERS.md). The sentinel turns it into a contained, observable,
+recoverable event:
+
+1. after each date's fits, every float leaf of the date state (losses,
+   params, value/holdings/residual columns) is checked for finiteness;
+2. a non-finite date emits ``guard/nan_event{date=...}`` + a warning and
+   RETRIES the date from its pre-fit params, degrading the trainer one
+   rung down the ladder ``adam -> gauss_newton -> final_solve`` per
+   attempt (``final_solve`` = the closed-form ridge readout,
+   ``HedgeMLP.solve_readout`` — deterministic, no iterative step left to
+   diverge) with the fit target SANITIZED (non-finite rows replaced by
+   the finite mean: refitting on poisoned rows can never converge,
+   whatever the trainer);
+3. the retry budget is bounded (``BackwardConfig.nan_retries``); an
+   exhausted ladder raises instead of writing garbage into the ledgers.
+
+The sentinel is OFF by default (``BackwardConfig.nan_guard=False``): the
+clean path runs byte-for-byte the unguarded walk — the per-date
+finiteness sync is only paid by runs that opted into protection.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from orp_tpu.obs import count as obs_count
+
+#: degradation order: reference-semantics Adam, then full-batch LM-GN,
+#: then the closed-form readout solve (nothing iterative left to diverge)
+TRAINER_LADDER = ("adam", "gauss_newton", "final_solve")
+
+
+def all_finite(*trees) -> bool:
+    """True when every float leaf of every pytree in ``trees`` is finite.
+
+    Host-side check (one device sync over the date's outputs) — only ever
+    called on the guarded path, once per date.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for leaf in jax.tree.leaves(trees):
+        x = jnp.asarray(leaf)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            continue
+        if not bool(np.all(np.isfinite(np.asarray(x)))):
+            return False
+    return True
+
+
+def sanitize_target(target):
+    """Replace non-finite target rows by the finite mean (0 when nothing is
+    finite). Returns ``(sanitized, n_bad)`` — ``n_bad == 0`` hands back the
+    input untouched."""
+    import jax.numpy as jnp
+
+    finite = jnp.isfinite(target)
+    n_bad = int((~finite).sum())
+    if n_bad == 0:
+        return target, 0
+    fill = jnp.where(finite.any(), jnp.nanmean(
+        jnp.where(finite, target, jnp.nan)), jnp.zeros((), target.dtype))
+    return jnp.where(finite, target, fill.astype(target.dtype)), n_bad
+
+
+def degradation_ladder(configured: str, budget: int) -> list[str]:
+    """The trainers to retry with after ``configured`` produced a
+    non-finite date, most-capable first, at most ``budget`` rungs.
+
+    ``final_solve`` as the configured trainer has no rung below it —
+    the ladder is empty and the sentinel raises on the first event.
+    """
+    if configured not in TRAINER_LADDER:
+        raise ValueError(
+            f"unknown trainer {configured!r}; ladder is {TRAINER_LADDER}")
+    start = TRAINER_LADDER.index(configured) + 1
+    return list(TRAINER_LADDER[start:start + max(budget, 0)])
+
+
+def record_nan_event(date_t: int, trainer: str, where: str) -> None:
+    """One non-finite detection: obs counter + a warning (the counter is
+    session-gated; the warning reaches untelemetered runs too)."""
+    obs_count("guard/nan_event", date=str(date_t), trainer=trainer,
+              where=where)
+    warnings.warn(
+        f"guard: non-finite {where} at backward date {date_t} under "
+        f"trainer {trainer!r} — degrading per ladder {TRAINER_LADDER}",
+        stacklevel=3,
+    )
+
+
+def record_degrade(date_t: int, to_trainer: str) -> None:
+    obs_count("guard/degrade", date=str(date_t), to=to_trainer)

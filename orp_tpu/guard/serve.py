@@ -1,0 +1,150 @@
+"""Serving-side resilience policy: deadlines, shedding, retries, breakers.
+
+The serve tier's failure modes and their governed responses (the acted-on
+half of the Dapper loop — PR 4's obs spine records per-request traces;
+these types are how the batcher/engine act on them):
+
+==============================  =============================================
+failure mode                    response (and its obs signal)
+==============================  =============================================
+slow request head-of-line-      per-request deadlines: a request whose queue
+blocks the single worker        age passes its deadline is SHED with a
+                                structured :class:`Rejection`, not served
+                                late (``guard/shed{reason="deadline"}``)
+queue grows without bound       admission watermark: past ``queue_watermark``
+under overload                  pending requests, the earliest-deadline
+                                request is shed at submit time
+                                (``guard/shed{reason="watermark"}``)
+transient dispatch failure      bounded retry with exponential backoff
+(device hiccup, injected)       around the engine call
+                                (``guard/retry{site="serve/dispatch"}``)
+AOT bucket executable fails     circuit breaker: after ``threshold``
+repeatedly at steady state      consecutive failures the bucket is demoted
+                                to the always-correct jit path for the
+                                process lifetime (``guard/circuit_open``)
+==============================  =============================================
+
+Everything here is OPT-IN: a batcher constructed without a
+:class:`GuardPolicy` runs the exact pre-guard code path, and the engine's
+breaker only has work to do when an AOT bundle is loaded AND failing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from orp_tpu.obs import count as obs_count
+
+
+class TransientDispatchError(RuntimeError):
+    """A dispatch failure worth retrying: the request itself is fine, the
+    attempt failed (device hiccup, injected fault). Anything NOT of this
+    type propagates to the caller's future unchanged — retrying a
+    deterministic error just repeats it with latency."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejection:
+    """A structured shed decision delivered THROUGH a request's future (its
+    ``result()`` — not an exception: shedding is the policy working as
+    configured, and an exception-shaped response would page someone for a
+    decision the operator already made).
+
+    Callers under a deadline policy check ``is_rejection(result)`` before
+    unpacking ``(phi, psi, value)``.
+    """
+
+    reason: str           # "deadline" | "watermark"
+    queued_s: float       # how long the request waited before the decision
+    deadline_s: float | None  # its deadline budget (None: shed by watermark
+    # while carrying no deadline of its own)
+
+
+def is_rejection(result) -> bool:
+    """True when a batcher future resolved to a shed decision instead of a
+    ``(phi, psi, value)`` evaluation."""
+    return isinstance(result, Rejection)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardPolicy:
+    """Resilience policy for a :class:`~orp_tpu.serve.batcher.MicroBatcher`.
+
+    ``deadline_ms``     — default per-request deadline (queue age budget);
+                          ``submit(..., deadline_s=...)`` overrides per
+                          request; None = requests never expire.
+    ``queue_watermark`` — max pending requests before admission control
+                          sheds the earliest-deadline one; None = unbounded.
+    ``max_retries``     — retries around one engine dispatch for
+                          :class:`TransientDispatchError` (0 = off).
+    ``backoff_ms``      — first retry backoff; doubles per attempt, capped
+                          at ``backoff_cap_ms``. Kept small: the batcher
+                          worker sleeps through it, so backoff IS added
+                          latency for everything queued behind.
+    """
+
+    deadline_ms: float | None = None
+    queue_watermark: int | None = None
+    max_retries: int = 0
+    backoff_ms: float = 1.0
+    backoff_cap_ms: float = 20.0
+
+    def __post_init__(self):
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(f"deadline_ms={self.deadline_ms} must be > 0")
+        if self.queue_watermark is not None and self.queue_watermark < 1:
+            raise ValueError(
+                f"queue_watermark={self.queue_watermark} must be >= 1")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries={self.max_retries} must be >= 0")
+
+    def backoff_s(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based), seconds."""
+        return min(self.backoff_ms * (2 ** (attempt - 1)),
+                   self.backoff_cap_ms) / 1e3
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over keyed resources (AOT buckets).
+
+    ``record_failure(key)`` returns True when the key just TRIPPED (crossed
+    ``threshold`` consecutive failures) — the caller demotes the resource
+    and the breaker emits ``guard/circuit_open``. A success resets the
+    key's streak: transient flakes never accumulate into a demotion.
+    Thread-safe; trip fires once per key.
+    """
+
+    def __init__(self, threshold: int = 3, *, what: str = "aot_bucket"):
+        if threshold < 1:
+            raise ValueError(f"threshold={threshold} must be >= 1")
+        self.threshold = int(threshold)
+        self.what = what
+        self._lock = threading.Lock()
+        self._streak: dict = {}
+        self._open: set = set()
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            self._streak.pop(key, None)
+
+    def record_failure(self, key) -> bool:
+        with self._lock:
+            if key in self._open:
+                return False
+            n = self._streak.get(key, 0) + 1
+            self._streak[key] = n
+            if n < self.threshold:
+                return False
+            self._open.add(key)
+        obs_count("guard/circuit_open", **{self.what: str(key)})
+        return True
+
+    def is_open(self, key) -> bool:
+        with self._lock:
+            return key in self._open
+
+    @property
+    def open_keys(self) -> list:
+        with self._lock:
+            return sorted(self._open)

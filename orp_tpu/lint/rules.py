@@ -31,6 +31,12 @@ ORP008  compile-cache config outside ``orp_tpu/aot``: seven tools each
         until one of them forgot the kill-switch; cache policy is process-
         global state and has exactly one entry point
         (``orp_tpu/aot/cache.py::enable_persistent_cache``).
+ORP009  silent broad excepts: an ``except Exception`` / bare ``except``
+        that neither re-raises nor emits (obs counter, ``warnings.warn``,
+        logging, ``future.set_exception``) swallows real failures — the
+        guard audit found exactly these hiding degraded AOT paths. A
+        handler that delegates its emission carries a
+        ``# orp: noqa[ORP009] -- reason``.
 """
 
 from __future__ import annotations
@@ -544,3 +550,67 @@ def check_cache_entrypoint(ctx: FileContext) -> Iterator[Finding]:
                 "orp_tpu.aot.enable_persistent_cache (it also honours the "
                 "env override and the tests' kill-switch this call forgets)",
             )
+
+
+# -- ORP009 ------------------------------------------------------------------
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+# a handler body "emits" when it raises, hands the error to a future, or
+# routes it through warnings/obs/logging — the call's terminal attribute is
+# what the AST can see. Two acknowledged heuristic gaps: a helper that
+# warns INTERNALLY reads as silent (false positive — carry a noqa with the
+# reason), and an unrelated method that merely SHARES an emit name
+# (`sink.emit`, `hist.observe` lookalikes) reads as emitting (false
+# negative). The generic collision magnets (`list.count`, `Counter.inc`)
+# are deliberately NOT in the set — the repo idiom is the `obs_count`
+# alias, which is unambiguous.
+_EMIT_CALL_TAILS = {
+    "warn", "warn_explicit",                      # warnings
+    "obs_count", "observe",                       # obs counters/histograms
+    "emit", "emit_record", "set_gauge",           # obs sinks/gauges
+    "set_exception",                              # delivered to a future
+    "exception", "error", "warning", "critical",  # logging
+}
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True  # bare except
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        d = dotted(t)
+        if d is not None and d.split(".")[-1] in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def _handler_emits(h: ast.ExceptHandler) -> bool:
+    for stmt in h.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                tail = (d.split(".")[-1] if d is not None
+                        else getattr(node.func, "attr", None))
+                if tail in _EMIT_CALL_TAILS:
+                    return True
+    return False
+
+
+@rule("ORP009", "except Exception that neither re-raises nor emits")
+def check_silent_broad_except(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if _is_broad_handler(h) and not _handler_emits(h):
+                what = ("bare except" if h.type is None
+                        else f"except {dotted(h.type) or 'Exception'}")
+                yield ctx.finding(
+                    h, "ORP009",
+                    f"{what} neither re-raises nor emits — a swallowed "
+                    "failure degrades silently; re-raise, warnings.warn, or "
+                    "emit an obs counter (or noqa with the reason the "
+                    "emission happens elsewhere)",
+                )

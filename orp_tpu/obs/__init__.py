@@ -43,8 +43,8 @@ from orp_tpu.obs.sink import (SCHEMA, JsonlSink, ListSink, prometheus_text,
                               read_events, validate_event, write_prometheus)
 from orp_tpu.obs.spans import (NOOP_SPAN, ObsState, Span, active,
                                bind_manifest, count, disable, emit_record,
-                               enable, enabled, set_gauge, span, spanned,
-                               state, timed)
+                               enable, enabled, observe, set_gauge, span,
+                               spanned, state, timed)
 
 #: a process-wide scratch registry for ad-hoc, session-independent
 #: instruments. NOTE: ``telemetry()`` exports its OWN per-session registry

@@ -79,7 +79,7 @@ def build_manifest(*, run_fingerprint: str | None = None,
         devs = jax.devices()
         m["platform"] = devs[0].platform
         m["device_count"] = len(devs)
-    except Exception as e:  # noqa: BLE001 — provenance must not kill the run
+    except Exception as e:  # orp: noqa[ORP009] -- the error IS recorded: it lands in the manifest's jax_error field (provenance must not kill the run)
         m["jax_error"] = f"{type(e).__name__}: {e}"
     m["git"] = git_revision()
     if extra:

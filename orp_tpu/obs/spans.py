@@ -227,6 +227,19 @@ def count(name: str, n: int = 1, *, sink_event: bool = True, **labels) -> None:
                       "labels": labels or {}})
 
 
+def observe(name: str, value: float, **labels) -> None:
+    """Record one sample into the registry histogram ``name`` (bounded
+    window, exported via ``metrics.prom`` as summary quantiles). Registry-
+    only — per-sample JSONL events would put sink-lock I/O inside hot
+    paths like the batcher queue, the same rationale as ``count``'s
+    ``sink_event=False`` mode. No-op (no instrument lookup, no lock) when
+    telemetry is off."""
+    st = _STATE
+    if st is None:
+        return
+    st.registry.histogram(name, labels or None).observe(float(value))
+
+
 def emit_record(name: str, payload: dict) -> None:
     """Emit a tool's result record as one schema-stamped ``record`` event on
     the active sink (the bench/profile artifact path). No-op when telemetry

@@ -10,7 +10,9 @@ that into production artifacts and serves them:
   with shape-bucketed executable caching (arbitrary request sizes hit a
   small fixed set of compiled programs);
 - ``batcher`` — micro-batching: coalesce many small synchronous requests
-  into one device batch (max-batch / max-wait policy);
+  into one device batch (max-batch / max-wait policy); an optional
+  ``orp_tpu.guard.GuardPolicy`` adds per-request deadlines, watermark
+  load shedding and transient-dispatch retries;
 - ``metrics`` — p50/p95/p99 latency + throughput counters;
 - ``bench``   — the ``serve-bench`` mode emitting ``BENCH_serve.json``.
 """

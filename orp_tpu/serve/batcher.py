@@ -14,23 +14,45 @@ the order it submitted them, bitwise-equal to a solo ``engine.evaluate`` of
 the same rows padded into the same bucket family — the batcher changes
 latency/throughput, never results. A failed dispatch propagates the
 exception to every future in that group (not to unrelated groups).
+
+Resilience (``orp_tpu/guard``, opt-in via a :class:`GuardPolicy`): the
+single-worker design means one slow request head-of-line-blocks everything
+behind it (BENCH_serve.json: the Python queue, not the device, is the
+bottleneck). Under a policy the batcher therefore
+
+- tracks every request's QUEUE AGE (``serve/queue_age_seconds`` histogram,
+  labelled ``outcome=served|shed``) — the trace signal the shed decisions
+  act on (the Dapper loop, PAPERS.md);
+- enforces per-request DEADLINES: a request whose queue age passes its
+  deadline is shed with a structured :class:`Rejection` through its future
+  (``guard/shed{reason="deadline"}``), never served late — so the queue
+  age of every *served* request is bounded by its deadline, whatever a
+  slow neighbour did;
+- applies ADMISSION CONTROL: past ``queue_watermark`` pending requests,
+  the earliest-deadline (then oldest) request is shed at submit time
+  (``guard/shed{reason="watermark"}``);
+- RETRIES a dispatch that raised :class:`TransientDispatchError`, with
+  bounded exponential backoff (``guard/retry``).
+
+Without a policy none of this runs: the clean path is the pre-guard
+batcher, and the per-request obs calls are the usual disabled-mode no-ops.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-import queue
 import threading
 import time
 from concurrent.futures import Future
 
 import numpy as np
 
+from orp_tpu.guard.serve import GuardPolicy, Rejection, TransientDispatchError
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import observe as obs_observe
 from orp_tpu.obs import span
 from orp_tpu.serve.metrics import ServingMetrics
-
-_STOP = object()
 
 
 @dataclasses.dataclass
@@ -40,6 +62,15 @@ class _Request:
     prices: np.ndarray | None     # (rows, k) or None
     future: Future
     submitted_at: float
+    deadline: float | None = None  # absolute perf_counter instant; None = never
+
+
+def _shed_order(req: _Request) -> tuple:
+    """Watermark victim selection: earliest deadline first (the request
+    most likely to expire unserved anyway), oldest submission as the
+    tie-break / no-deadline fallback."""
+    return (req.deadline if req.deadline is not None else float("inf"),
+            req.submitted_at)
 
 
 class MicroBatcher:
@@ -49,21 +80,30 @@ class MicroBatcher:
     long the first request of a batch waits for company. Small waits trade
     single-request latency for device throughput — at 200µs a burst of
     single-row requests rides one executable instead of hundreds.
+
+    ``policy`` (optional :class:`~orp_tpu.guard.GuardPolicy`) switches on
+    deadlines, watermark shedding and transient-dispatch retries — see the
+    module docstring. With a deadline in force, a future may resolve to a
+    :class:`~orp_tpu.guard.Rejection` instead of ``(phi, psi, value)``;
+    check ``guard.is_rejection(result)`` before unpacking.
     """
 
     def __init__(self, engine, *, max_batch: int = 1024,
-                 max_wait_us: float = 200.0, metrics: ServingMetrics | None = None):
+                 max_wait_us: float = 200.0,
+                 metrics: ServingMetrics | None = None,
+                 policy: GuardPolicy | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         self.engine = engine
         self.max_batch = int(max_batch)
         self.max_wait_us = float(max_wait_us)
         self.metrics = metrics
-        self._q: queue.SimpleQueue = queue.SimpleQueue()
-        # guards the closed-check + put pair: without it a submit racing
-        # close() can land its request AFTER the stop sentinel, and that
-        # future would never resolve
-        self._submit_lock = threading.Lock()
+        self.policy = policy
+        # one condition guards the deque + closed flag: submit needs to shed
+        # arbitrary queued requests under the watermark policy, which a
+        # SimpleQueue cannot express
+        self._cv = threading.Condition()
+        self._pending: collections.deque[_Request] = collections.deque()
         self._closed = False
         self._worker = threading.Thread(
             target=self._run, name="orp-serve-batcher", daemon=True)
@@ -71,20 +111,49 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, date_idx: int, states, prices=None) -> Future:
+    def submit(self, date_idx: int, states, prices=None, *,
+               deadline_s: float | None = None) -> Future:
         """Enqueue one request; the Future resolves to ``(phi, psi, value)``
-        for exactly these rows (``value`` None when ``prices`` is None)."""
+        for exactly these rows (``value`` None when ``prices`` is None) —
+        or to a :class:`Rejection` when a guard policy shed it.
+
+        ``deadline_s``: queue-age budget for THIS request (seconds from
+        now), overriding the policy default. Ignored without a policy.
+        """
         # promote scalars/rows to (rows, width) HERE: the worker indexes
         # .shape[0]/.shape[1] before any try block, so a lower-rank array
         # reaching it would kill the thread (and every pending future)
         feats = np.atleast_2d(np.asarray(states))
         pr = None if prices is None else np.atleast_2d(np.asarray(prices))
         fut: Future = Future()
-        with self._submit_lock:
+        now = time.perf_counter()
+        budget = deadline_s
+        if budget is None and self.policy is not None:
+            budget = (None if self.policy.deadline_ms is None
+                      else self.policy.deadline_ms / 1e3)
+        req = _Request(int(date_idx), feats, pr, fut, now,
+                       None if (budget is None or self.policy is None)
+                       else now + budget)
+        shed: list[_Request] = []
+        with self._cv:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._q.put(
-                _Request(int(date_idx), feats, pr, fut, time.perf_counter()))
+            self._pending.append(req)
+            wm = None if self.policy is None else self.policy.queue_watermark
+            while wm is not None and len(self._pending) > wm:
+                # admission control: keep the queue at the watermark by
+                # shedding the earliest-deadline request (possibly the one
+                # just submitted) — a structured decision, not an error
+                victim = min(self._pending, key=_shed_order)
+                self._pending.remove(victim)
+                shed.append(victim)
+            self._cv.notify()
+        for victim in shed:
+            # resolved OUTSIDE the lock: set_result runs the future's
+            # done-callbacks synchronously, and a callback that re-enters
+            # the batcher (submit-on-reject is a natural client shape)
+            # would deadlock on the held Condition
+            self._shed(victim, "watermark")
         return fut
 
     def evaluate(self, date_idx: int, states, prices=None):
@@ -93,11 +162,11 @@ class MicroBatcher:
 
     def close(self, timeout: float | None = 10.0) -> None:
         """Drain outstanding requests and stop the worker."""
-        with self._submit_lock:
+        with self._cv:
             if self._closed:
                 return
             self._closed = True
-            self._q.put(_STOP)
+            self._cv.notify_all()
         self._worker.join(timeout)
 
     def __enter__(self):
@@ -107,32 +176,78 @@ class MicroBatcher:
         self.close()
         return False
 
+    # -- guard decisions -----------------------------------------------------
+
+    def _shed(self, req: _Request, reason: str) -> None:
+        """Resolve ``req`` with a structured Rejection + the shed signals."""
+        queued = time.perf_counter() - req.submitted_at
+        obs_count("guard/shed", reason=reason)
+        obs_observe("serve/queue_age_seconds", queued, outcome="shed")
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(Rejection(
+                reason=reason, queued_s=queued,
+                deadline_s=(None if req.deadline is None
+                            else req.deadline - req.submitted_at)))
+
     # -- worker side ---------------------------------------------------------
 
     def _run(self) -> None:
         while True:
-            item = self._q.get()
-            if item is _STOP:
-                return
-            batch = [item]
-            rows = item.features.shape[0]
-            deadline = time.perf_counter() + self.max_wait_us * 1e-6
-            stop_after = False
-            while rows < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                try:
-                    nxt = (self._q.get(timeout=remaining) if remaining > 0
-                           else self._q.get_nowait())
-                except queue.Empty:
-                    break
-                if nxt is _STOP:
-                    stop_after = True
-                    break
-                batch.append(nxt)
-                rows += nxt.features.shape[0]
-            self._dispatch(batch)
-            if stop_after:
-                return
+            batch: list[_Request] = []
+            expired: list[_Request] = []
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # closed and drained
+                rows = 0
+                window_end = None  # opens at the first LIVE request
+                while rows < self.max_batch:
+                    if self._pending:
+                        req = self._pending.popleft()
+                        now = time.perf_counter()
+                        if req.deadline is not None and now > req.deadline:
+                            # expired while queued: never burn a device
+                            # dispatch on an answer nobody is waiting for
+                            expired.append(req)
+                            continue
+                        obs_observe("serve/queue_age_seconds",
+                                    now - req.submitted_at, outcome="served")
+                        batch.append(req)
+                        rows += req.features.shape[0]
+                        if window_end is None:
+                            window_end = now + self.max_wait_us * 1e-6
+                        continue
+                    if not batch:
+                        break  # everything popped had expired
+                    remaining = window_end - time.perf_counter()
+                    if self._closed or remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+            for req in expired:
+                # outside the lock: resolving a future runs its
+                # done-callbacks synchronously (see submit's shed note)
+                self._shed(req, "deadline")
+            if batch:
+                self._dispatch(batch)
+
+    def _dispatch_engine(self, date_idx: int, feats, pr):
+        """One engine dispatch, with the policy's bounded retry-with-backoff
+        for transient failures (a deterministic error propagates on attempt
+        one — retrying it only repeats it with latency)."""
+        pol = self.policy
+        attempts = 1 + (pol.max_retries if pol is not None else 0)
+        for attempt in range(1, attempts + 1):
+            try:
+                return self.engine.evaluate(date_idx, feats, pr)
+            except TransientDispatchError:
+                if attempt >= attempts:
+                    raise
+                obs_count("guard/retry", site="serve/dispatch",
+                          attempt=str(attempt))
+                # the worker sleeps through the backoff, so it is bounded
+                # and small by policy (backoff_cap_ms)
+                time.sleep(pol.backoff_s(attempt))
 
     def _dispatch(self, batch: list[_Request]) -> None:
         # group rows that can share one executable dispatch: same date, same
@@ -157,7 +272,7 @@ class MicroBatcher:
                                                 "rows": int(feats.shape[0])}):
                     # no set_result: evaluate() blocks device-side internally,
                     # so the span is already device-complete
-                    phi, psi, value = self.engine.evaluate(date_idx, feats, pr)
+                    phi, psi, value = self._dispatch_engine(date_idx, feats, pr)
             except Exception as e:  # noqa: BLE001 — delivered per-future
                 for r in reqs:
                     if not r.future.set_running_or_notify_cancel():

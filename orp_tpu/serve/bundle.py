@@ -33,6 +33,7 @@ import numpy as np
 
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.train.backward import BackwardResult
+from orp_tpu.utils.atomic import atomic_write_text
 from orp_tpu.utils.checkpoint import latest_step, load_checkpoint, save_checkpoint
 from orp_tpu.utils.fingerprint import (
     policy_fingerprint,
@@ -41,7 +42,12 @@ from orp_tpu.utils.fingerprint import (
     write_fingerprint,
 )
 
-_FORMAT = "orp-bundle-v1"
+# v2 (guard round): the policy step under policy/ carries a per-step
+# integrity digest side file that the loader now VERIFIES — a digest-less
+# v1 bundle would refuse deep inside the checkpoint layer with a
+# resume-worded error, so the format gate refuses it up front instead
+# (clean message: re-export with the current code)
+_FORMAT = "orp-bundle-v2"
 _META = "bundle.json"
 _POLICY_SUBDIR = "policy"
 
@@ -146,7 +152,9 @@ def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
         "cost_of_capital": float(result.cost_of_capital),
         "sim_seed": result.sim_seed,
     }
-    meta_file.write_text(json.dumps(meta, indent=1, sort_keys=True))
+    # atomic: bundle.json is what load_bundle trusts to rebuild the model —
+    # a torn write must leave the previous (complete) metadata or nothing
+    atomic_write_text(meta_file, json.dumps(meta, indent=1, sort_keys=True))
     write_fingerprint(d, fp)
     policy_dir = d / _POLICY_SUBDIR
     if policy_dir.exists():
@@ -176,7 +184,9 @@ def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
     if meta.get("format") != _FORMAT:
         raise ValueError(
             f"{d}: unsupported bundle format {meta.get('format')!r} "
-            f"(this loader reads {_FORMAT})"
+            f"(this loader reads {_FORMAT}; a pre-guard v1 bundle lacks "
+            "the policy integrity digest — re-export it with the current "
+            "code)"
         )
     model = _model_from_meta(meta["model"])
     n_dates = int(meta["n_dates"])

@@ -26,11 +26,14 @@ recording layer is new and session-gated).
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orp_tpu.guard import inject as _inject
+from orp_tpu.guard.serve import CircuitBreaker
 from orp_tpu.lint.trace_audit import compile_count
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import enabled as obs_enabled
@@ -96,7 +99,7 @@ class HedgeEngine:
     """
 
     def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20,
-                 use_aot: bool = True):
+                 use_aot: bool = True, aot_failure_threshold: int = 3):
         model = getattr(policy, "model", None)
         if model is None:
             raise ValueError(
@@ -131,6 +134,14 @@ class HedgeEngine:
         # in these buckets never touch the jit cache (load_aot returns {} —
         # after ONE warning — when the artifacts don't fit this process)
         self._aot = {}
+        # runtime circuit breaker (orp_tpu/guard): aot_failure_threshold
+        # CONSECUTIVE execution failures of one bucket's serialized
+        # executable demote that bucket to the always-correct jit path for
+        # the process lifetime — the steady-state extension of load_aot's
+        # construction-time fallback. Each individual failure already falls
+        # back to jit for its own request (bitwise-equal program).
+        self._breaker = CircuitBreaker(aot_failure_threshold,
+                                       what="aot_bucket")
         aot_dir = getattr(policy, "aot_dir", None)
         if use_aot and aot_dir is not None:
             from orp_tpu.aot.bundle_exec import load_aot
@@ -179,6 +190,7 @@ class HedgeEngine:
             "buckets": sorted(self._buckets),
             "aot_buckets": sorted(self._aot),
             "aot_hits": self.aot_hits,
+            "aot_circuit_open": self._breaker.open_keys,
             "xla_compiles": (
                 now - self._compiles0
                 if now is not None and self._compiles0 is not None else None
@@ -235,14 +247,43 @@ class HedgeEngine:
                 )
         b = self.bucket_for(n)
         aot_ex = self._aot.get(b)
-        if b in self._buckets:
+        # categorize now, RECORD after the dispatch succeeds: a failed
+        # attempt that the batcher's guard policy retries must not inflate
+        # the request/row counters (telemetry under degradation would
+        # overstate traffic by one per retry)
+        bucket_kind = ("hit" if b in self._buckets
+                       else "aot_warm" if aot_ex is not None else "miss")
+        dt = np.dtype(jnp.dtype(self.model.dtype).name)
+        with span("serve/pad"):
+            feats = np.zeros((b, f), dt)
+            feats[:n] = states
+            pr = np.zeros((b, k), dt)
+            if has_prices:
+                pr[:n] = prices
+        inj = _inject.active()
+        with span("serve/dispatch", attrs={"bucket": b,
+                                           "aot": aot_ex is not None}):
+            if inj is not None:
+                # chaos harness (orp_tpu/guard/inject.py): may sleep (slow
+                # dependency) and/or raise a TransientDispatchError, which
+                # propagates to the batcher's retry-with-backoff policy
+                inj.fire("serve/dispatch", bucket=b)
+            if aot_ex is not None:
+                phi, psi, v = self._dispatch_aot(aot_ex, b, idx, feats, pr,
+                                                 inj)
+            else:
+                phi, psi, v = self._jit_eval(idx, feats, pr)
+            # block: a served result IS the deliverable — latency metrics on
+            # dispatch-only timing would be fiction
+            phi, psi, v = jax.block_until_ready((phi, psi, v))
+        if bucket_kind == "hit":
             self.hits += 1
             # per-request counters are registry-only (sink_event=False): a
             # JSONL write per request would put sink-lock I/O inside the
             # latency every caller is timing. Totals still export via
             # metrics.prom; the RARE miss (once per bucket) keeps its event.
             obs_count("serve/bucket_hits", sink_event=False)
-        elif aot_ex is not None:
+        elif bucket_kind == "aot_warm":
             # first touch of an AOT bucket compiles NOTHING (the executable
             # shipped in the bundle) — a hit, not a miss: `misses` stays the
             # engine's compile bill
@@ -254,38 +295,52 @@ class HedgeEngine:
             self._buckets.add(b)
             obs_count("serve/bucket_misses", bucket=str(b))
         obs_count("serve/rows", n, sink_event=False)
-        dt = np.dtype(jnp.dtype(self.model.dtype).name)
-        with span("serve/pad"):
-            feats = np.zeros((b, f), dt)
-            feats[:n] = states
-            pr = np.zeros((b, k), dt)
-            if has_prices:
-                pr[:n] = prices
-        with span("serve/dispatch", attrs={"bucket": b,
-                                           "aot": aot_ex is not None}):
-            if aot_ex is not None:
-                # exact jit argument order (pre-flattened params + the
-                # per-request arrays), pruned to the inputs XLA kept — the
-                # same program the jit path would compile, minus the compile
-                self.aot_hits += 1
-                flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
-                        jnp.asarray(feats), jnp.asarray(pr), self._coc]
-                phi, psi, v = aot_ex.call_flat(flat)
-            else:
-                phi, psi, v = _eval_core(
-                    self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
-                    jnp.asarray(feats), jnp.asarray(pr), self._coc,
-                    dual_mode=self.dual_mode,
-                    holdings_combine=self.holdings_combine,
-                )
-            # block: a served result IS the deliverable — latency metrics on
-            # dispatch-only timing would be fiction
-            phi, psi, v = jax.block_until_ready((phi, psi, v))
         with span("serve/unpad"):
             phi = np.asarray(phi)[:n]
             psi = np.asarray(psi)[:n]
             value = np.asarray(v)[:n] if has_prices else None
         return phi, psi, value
+
+    def _jit_eval(self, idx: int, feats, pr):
+        """The always-correct jit path: one bucket-shaped ``_eval_core``
+        dispatch (compiles on the bucket's first jit touch)."""
+        return _eval_core(
+            self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
+            jnp.asarray(feats), jnp.asarray(pr), self._coc,
+            dual_mode=self.dual_mode,
+            holdings_combine=self.holdings_combine,
+        )
+
+    def _dispatch_aot(self, aot_ex, b: int, idx: int, feats, pr, inj):
+        """Execute bucket ``b``'s serialized executable; any failure falls
+        back to the jit path for THIS request (same program, bitwise-equal
+        results) and feeds the circuit breaker — ``aot_failure_threshold``
+        consecutive failures open the circuit and demote the bucket to jit
+        for the process lifetime (``guard/circuit_open``)."""
+        try:
+            if inj is not None:
+                inj.fire("serve/aot_dispatch", bucket=b)
+            # exact jit argument order (pre-flattened params + the
+            # per-request arrays), pruned to the inputs XLA kept — the
+            # same program the jit path would compile, minus the compile
+            flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
+                    jnp.asarray(feats), jnp.asarray(pr), self._coc]
+            out = aot_ex.call_flat(flat)
+        except Exception as e:  # noqa: BLE001 — counted, breakered, fallen back
+            obs_count("guard/aot_exec_failure", bucket=str(b))
+            if self._breaker.record_failure(b):
+                self._aot.pop(b, None)
+                warnings.warn(
+                    f"AOT executable for bucket {b} failed "
+                    f"{self._breaker.threshold} consecutive times "
+                    f"({type(e).__name__}: {e}); circuit opened — bucket "
+                    "demoted to the jit path for this process",
+                    stacklevel=3,
+                )
+            return self._jit_eval(idx, feats, pr)
+        self.aot_hits += 1
+        self._breaker.record_success(b)
+        return out
 
     def prewarm(self, sizes) -> dict:
         """Pre-touch every bucket the given request sizes land in, so no

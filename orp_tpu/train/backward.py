@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orp_tpu.guard import inject as _inject
+from orp_tpu.guard import sentinel as _sentinel
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import enabled as obs_enabled
@@ -214,6 +216,90 @@ def _date_body(
     return params1, params2, v_t, comb, var_resid, aux1
 
 
+@functools.partial(jax.jit, static_argnames=("model",))
+def _solve_readout(model, params, feats, prices, target):
+    return model.solve_readout(params, feats, prices, target)
+
+
+def _final_solve_date(model, cfg, params0, feats_t, prices_t, prices_t1,
+                      target, mse, outputs_fn):
+    """Terminal rung of the guard's trainer ladder (orp_tpu/guard/sentinel):
+    no iterative trainer left to diverge — replace the readout of the
+    PRE-FIT ``params0`` with its closed-form ridge optimum
+    (``HedgeMLP.solve_readout``), use the solved params for BOTH legs (the
+    dual combine collapses when the legs share params, so the outputs run
+    as ``mse_only``), and derive the date outputs with the shared fused
+    program. Returns the ``_date_body`` tuple shape."""
+    solved = _solve_readout(model, params0, feats_t, prices_t1, target)
+    pred = _value(model, solved, feats_t, prices_t1)
+    aux = {
+        "final_loss": mse(pred, target),
+        "mae": L.mae(pred, target),
+        "mape": L.mape(pred, target),
+        "n_epochs_ran": 0,
+    }
+    v_t, comb, var_resid = outputs_fn(
+        model, solved, solved, feats_t, prices_t, prices_t1, target,
+        cfg.cost_of_capital, jnp.zeros((), model.dtype),
+        dual_mode="mse_only", holdings_combine=cfg.holdings_combine,
+    )
+    return solved, solved, v_t, comb, var_resid, aux
+
+
+def _date_finite(state_tuple) -> bool:
+    """The sentinel's per-date check: params, loss and every ledger column
+    this date contributes must be finite (one host sync; guarded path only)."""
+    params1, params2, v_t, comb, var_resid, aux1 = state_tuple
+    return _sentinel.all_finite(
+        (aux1["final_loss"], params1, params2, v_t, comb, var_resid))
+
+
+def _degrade_date(model, cfg, pre1, pre2, feats_t, prices_t, prices_t1,
+                  target, ka, kb, first, mse, q_loss, metric_fns,
+                  outputs_fn, t):
+    """The sentinel fired at date ``t``: walk the trainer ladder
+    (orp_tpu/guard/sentinel.py) from the PRE-FIT params on a sanitized
+    target until a rung produces finite state. The retry budget is
+    ``cfg.nan_retries`` rungs; running dry raises instead of letting every
+    earlier date train on garbage. Returns the ``_date_body`` tuple."""
+    _sentinel.record_nan_event(t, cfg.optimizer, "post-fit date state")
+    target, n_bad = _sentinel.sanitize_target(target)
+    if n_bad:
+        obs_count("guard/target_sanitized", n_bad, date=str(t))
+    ladder = _sentinel.degradation_ladder(cfg.optimizer, cfg.nan_retries)
+    for rung in ladder:
+        _sentinel.record_degrade(t, rung)
+        if rung == "gauss_newton":
+            n_iters = cfg.gn_iters_first if first else cfg.gn_iters_warm
+            state = _date_body(
+                model, cfg, pre1, pre2, feats_t, prices_t, prices_t1,
+                target, ka, kb,
+                GNConfig(n_iters=n_iters, block_rows=cfg.gn_block_rows),
+                mse, q_loss, metric_fns,
+                # spanned like the main loop's fits: the degraded date is
+                # the one an operator chasing a guard/nan_event most needs
+                # timing for (obs_spanned is fn itself when telemetry off)
+                fit_fn=obs_spanned("train/fit", fit_gn_jit),
+                value_fn=_value, outputs_fn=outputs_fn,
+                q_fit_fn=obs_spanned("train/fit_quantile",
+                                     fit_gn_pinball_jit),
+                q_fit_cfg=GNPinballConfig(n_iters=n_iters, q=cfg.quantile,
+                                          block_rows=cfg.gn_block_rows),
+            )
+        else:  # "final_solve": the closed-form terminal rung
+            state = _final_solve_date(model, cfg, pre1, feats_t, prices_t,
+                                      prices_t1, target, mse, outputs_fn)
+        if _date_finite(state):
+            return state
+        _sentinel.record_nan_event(t, rung, "degraded retry")
+    raise RuntimeError(
+        f"guard: backward date {t} is still non-finite after the trainer "
+        f"ladder {ladder} (nan_retries={cfg.nan_retries}) — refusing to "
+        "continue: every earlier date would train on this garbage. Raise "
+        "nan_retries or inspect the guard/nan_event telemetry."
+    )
+
+
 def _split_holdings(comb):
     """``(n, k)`` holdings -> (phi, psi): scalar phi for the 2-instrument
     head (ledger shape ``(n,)``, reference semantics), per-asset phi
@@ -267,6 +353,14 @@ class BackwardConfig:
     # then lax.scan over the warm dates, inside a single jit) instead of a host
     # loop with per-date dispatch/sync. Same math, same key stream; incompatible
     # with checkpoint_dir (per-date persistence needs the host between dates)
+    nan_guard: bool = False  # per-date NaN/Inf sentinel (orp_tpu/guard):
+    # after each date's fits, check loss/params/ledger columns for
+    # non-finite values; on detection emit guard/nan_event and retry the
+    # date from its pre-fit params one trainer rung down the ladder
+    # adam -> gauss_newton -> final_solve, on a sanitized target. Off by
+    # default: the clean path is byte-for-byte the unguarded walk
+    nan_retries: int = 2  # bounded ladder budget per date (nan_guard only);
+    # an exhausted ladder raises instead of corrupting every earlier date
 
     def __post_init__(self):
         object.__setattr__(self, "shuffle", _validate_shuffle(self.shuffle))
@@ -274,6 +368,12 @@ class BackwardConfig:
             raise ValueError(
                 "fused=True runs the whole walk device-side; per-date "
                 "checkpointing needs the host loop (fused=False)"
+            )
+        if self.fused and self.nan_guard:
+            raise ValueError(
+                "fused=True runs the whole walk device-side; the NaN "
+                "sentinel's per-date host checks need the host loop "
+                "(fused=False)"
             )
         if self.optimizer not in ("adam", "gauss_newton"):
             raise ValueError(
@@ -636,8 +736,12 @@ def _walk_impl(
         # grew gn_quantile + GNPinballConfig folded in (r4); v8 =
         # gn_block_rows/block_rows fields (r4 — block_rows changes the
         # reduction order, so resumed-vs-uninterrupted exactness requires it
-        # in the fingerprint). A dir from an older field set refuses cleanly
-        # here instead of failing in replay
+        # in the fingerprint); v9 = guard round: BackwardConfig grew
+        # nan_guard/nan_retries (a degraded date's columns depend on them)
+        # and every step now carries an integrity digest side file
+        # (utils/checkpoint.py) that pre-guard directories lack. A dir from
+        # an older field set refuses cleanly here instead of failing in
+        # replay
         # GN config class defaults (LM damping, IRLS floor etc.) are training
         # policy that lives OUTSIDE BackwardConfig — folding the instance
         # reprs in makes any future default change auto-invalidate old dirs
@@ -645,9 +749,12 @@ def _walk_impl(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
             f"gn={GNConfig(n_iters=0)} gnq={GNPinballConfig(n_iters=0)} "
-            "ckpt_format=increment-v8",
+            "ckpt_format=increment-v9",
         )
-        last = ckpt.latest_step(cfg.checkpoint_dir)
+        # trust only steps whose integrity digest landed: a save killed
+        # between orbax's commit and the digest write costs ONE recomputed
+        # date, not the whole directory (utils/checkpoint.py)
+        last = ckpt.latest_complete_step(cfg.checkpoint_dir)
         if last is not None:
             # each step holds only its own date's increment (O(1) columns);
             # replay 0..last to rebuild the ledgers — a missing middle step
@@ -708,17 +815,33 @@ def _walk_impl(
                             block_rows=cfg.gn_block_rows)
             if gn_q else adam_cfg
         )
+        target = values[:, t + 1]
+        inj = _inject.active()
+        if inj is not None:
+            # chaos harness (orp_tpu/guard/inject.py): may NaN-poison this
+            # date's fit target — the LOCAL copy only; values[:, t+1] stays
+            # the clean ledger column, exactly like a transient read fault
+            target = inj.corrupt_target(step_i, target)
+        pre1, pre2 = params1, params2  # pre-fit params (~100 floats): the
+        # guard ladder refits from these on a sentinel hit
         # one date = MSE fit + dual-mode quantile fit + fused outputs program
         # (RP.py:103-125, :221) via the shared body, with jitted pieces
-        params1, params2, v_t, comb, var_resid, aux1 = _date_body(
+        state = _date_body(
             model, cfg, params1, params2,
             features[:, t], prices_all[:, t], prices_all[:, t + 1],
-            values[:, t + 1], ka, kb, fit_cfg, mse, q_loss, metric_fns,
+            target, ka, kb, fit_cfg, mse, q_loss, metric_fns,
             fit_fn=fit_fn_sp, value_fn=_value,
             outputs_fn=outputs_fn_sp,
             q_fit_fn=q_fit_fn_sp if gn else None,
             q_fit_cfg=q_cfg if gn else None,
         )
+        if cfg.nan_guard and not _date_finite(state):
+            state = _degrade_date(  # orp: noqa[ORP004] -- deterministic retry: the degraded refit intentionally replays THIS date's key pair (same data, same keys, different trainer)
+                model, cfg, pre1, pre2, features[:, t], prices_all[:, t],
+                prices_all[:, t + 1], target, ka, kb, first, mse, q_loss,
+                metric_fns, outputs_fn_sp, t,
+            )
+        params1, params2, v_t, comb, var_resid, aux1 = state
         values = values.at[:, t].set(v_t)
         phi_t, psi_t = _split_holdings(comb)
         phi_cols.append(phi_t)
@@ -755,6 +878,10 @@ def _walk_impl(
                     "epochs_ran": eps_ran[-1],
                 },
             )
+            if inj is not None:
+                # chaos harness: synthetic preemption AFTER this date's
+                # checkpoint committed (the kill-and-resume oracle)
+                inj.maybe_kill(step_i)
 
     # ledgers were appended walking t downward; store date-ascending
     stack_asc = lambda cols: jnp.stack(cols[::-1], axis=1)

@@ -1,0 +1,52 @@
+"""Atomic small-file writes: write-temp-then-rename, same directory.
+
+Every side file the persistence layers write next to their artifacts —
+``run_fingerprint.txt``, ``bundle.json``, ``aot/aot.json``, the per-bucket
+executable blobs, per-date checkpoint digests — is a compatibility or
+integrity GUARD. A guard half-written by a killed process is worse than a
+missing one: it can pass a naive existence check while carrying garbage.
+``os.replace`` of a same-directory temp file is atomic on POSIX and
+Windows, so readers only ever observe the old content or the complete new
+content, never a torn write.
+
+(The orbax checkpoint payloads themselves already commit atomically via
+the CheckpointManager's finalisation protocol; this module covers the
+plain-text/bytes side files written around them.)
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import tempfile
+
+
+def _atomic_write(path: str | pathlib.Path, data, *, binary: bool) -> None:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=p.parent, prefix=f".{p.name}.", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb" if binary else "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+    except BaseException:
+        # never leave the temp behind a failed write (ENOSPC, kill mid-
+        # fsync): the artifact dir must hold guards and payloads only
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str | pathlib.Path, text: str) -> None:
+    """Write ``text`` to ``path`` atomically (temp file + ``os.replace``)."""
+    _atomic_write(path, text, binary=False)
+
+
+def atomic_write_bytes(path: str | pathlib.Path, blob: bytes) -> None:
+    """Write ``blob`` to ``path`` atomically (temp file + ``os.replace``)."""
+    _atomic_write(path, blob, binary=True)

@@ -8,28 +8,62 @@ preempted run resumes at the next date instead of re-simulating/retraining.
 
 Built on ``orbax.checkpoint.CheckpointManager`` (the supported step-management
 API: atomic finalisation, latest-step discovery, retention). A *fingerprint*
-side-file guards resume compatibility: a directory written by a different run
+side file guards resume compatibility: a directory written by a different run
 configuration refuses to resume instead of silently returning stale results.
 The fingerprint mechanics live in ``orp_tpu/utils/fingerprint.py``, shared
 with the hedge-policy bundles of ``orp_tpu/serve``.
+
+Integrity (orp_tpu.guard): every save also records a SHA-256 digest of the
+step's leaves in an atomically-written side file
+(``orp_digest_<step>.sha256``); every restore recomputes and compares. A
+truncated or bit-rotted step — the on-disk state a process death or a bad
+copy leaves behind — is DETECTED AND REFUSED with a clean ``ValueError``
+instead of resuming a walk from garbage (orbax's own commit protocol makes
+torn *writes* unlikely; the digest also catches post-commit damage, which
+no commit protocol can).
 """
 
 from __future__ import annotations
 
+import hashlib
 import pathlib
+import warnings
 
 import jax
 import orbax.checkpoint as ocp
 
+from orp_tpu.utils.atomic import atomic_write_text
 from orp_tpu.utils.fingerprint import check_fingerprint
 
 __all__ = [
     "check_fingerprint",
     "save_checkpoint",
     "latest_step",
+    "latest_complete_step",
     "load_checkpoint",
     "load_checkpoints",
+    "state_digest",
 ]
+
+_DIGEST_FILE = "orp_digest_{step}.sha256"
+
+
+def state_digest(state) -> str:
+    """SHA-256 over every leaf's key path, dtype, shape and raw bytes —
+    the integrity identity of one checkpoint step. Computed on the exact
+    (``jnp.asarray``-normalised) tree handed to orbax at save time and on
+    the restored tree at load time; any torn/flipped byte in between
+    changes it."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+        x = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(x.dtype).encode())
+        h.update(str(x.shape).encode())
+        h.update(np.ascontiguousarray(x).tobytes())
+    return h.hexdigest()
 
 
 def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
@@ -44,14 +78,23 @@ def _manager(directory: str | pathlib.Path) -> ocp.CheckpointManager:
 
 
 def save_checkpoint(directory: str | pathlib.Path, step: int, state) -> None:
-    """Persist ``state`` (any pytree of arrays/scalars) under ``step``."""
+    """Persist ``state`` (any pytree of arrays/scalars) under ``step``,
+    plus its integrity digest side file (written atomically AFTER orbax
+    finalises the step: a digest must never exist for a payload that
+    didn't fully commit)."""
+    state = jax.tree.map(jax.numpy.asarray, state)
     with _manager(directory) as mgr:
-        mgr.save(
-            step,
-            args=ocp.args.PyTreeSave(jax.tree.map(jax.numpy.asarray, state)),
-            force=True,
-        )
+        if step in mgr.all_steps():
+            # redoing an existing step (e.g. a torn save whose digest never
+            # landed, being recomputed on resume): this orbax refuses to
+            # re-save a committed step even under force, so clear it first
+            mgr.delete(step)
+        mgr.save(step, args=ocp.args.PyTreeSave(state), force=True)
         mgr.wait_until_finished()
+    atomic_write_text(
+        pathlib.Path(directory) / _DIGEST_FILE.format(step=step),
+        state_digest(state),
+    )
 
 
 def latest_step(directory: str | pathlib.Path) -> int | None:
@@ -62,13 +105,79 @@ def latest_step(directory: str | pathlib.Path) -> int | None:
         return mgr.latest_step()
 
 
+def latest_complete_step(directory: str | pathlib.Path) -> int | None:
+    """Highest step that BOTH committed in orbax and carries its integrity
+    digest — the step resume may trust.
+
+    A kill can land between orbax's commit and the digest write; that
+    leaves a payload-complete but UNVERIFIABLE latest step. Refusing the
+    whole directory for it would brick exactly the recovery the
+    checkpoint layer exists for, so resume treats that one step as
+    not-saved (its date is recomputed) and continues from the step below.
+    Only the latest step can legitimately lack a digest — each earlier
+    save finished its digest before the next began — so a digest-less
+    MIDDLE step still refuses in the loaders (partial copy / pre-guard
+    layout).
+    """
+    last = latest_step(directory)
+    if last is None:
+        return None
+    if (pathlib.Path(directory) / _DIGEST_FILE.format(step=last)).exists():
+        return last
+    warnings.warn(
+        f"checkpoint step {last} in {pathlib.Path(directory)} committed "
+        "without its integrity digest (save was interrupted between commit "
+        "and digest write); treating it as unsaved — that step will be "
+        "recomputed on resume",
+        stacklevel=2,
+    )
+    return last - 1 if last > 0 else None
+
+
+def _verified(directory: str | pathlib.Path, step: int, restored):
+    """Digest-check one restored step; returns it or refuses loudly."""
+    df = pathlib.Path(directory) / _DIGEST_FILE.format(step=step)
+    if not df.exists():
+        raise ValueError(
+            f"checkpoint step {step} in {pathlib.Path(directory)} has no "
+            f"integrity digest ({df.name}) — a pre-guard layout, a partial "
+            "copy, or a save torn between commit and digest write; refusing "
+            "to resume from unverifiable state (resume callers should pick "
+            "their step via latest_complete_step)"
+        )
+    want = df.read_text().strip()
+    got = state_digest(restored)
+    if got != want:
+        raise ValueError(
+            f"checkpoint step {step} in {pathlib.Path(directory)} failed its "
+            f"integrity check (digest {got[:12]}… != recorded {want[:12]}…) — "
+            "truncated or corrupted on disk; refusing to resume"
+        )
+    return restored
+
+
+def _restore(mgr: ocp.CheckpointManager, directory, step: int):
+    # explicit PyTreeRestore: a fresh manager (new process — exactly the
+    # resume case) cannot infer the handler from the directory alone and
+    # raises KeyError 'Item "default" ... could not be restored'
+    try:
+        restored = mgr.restore(step, args=ocp.args.PyTreeRestore())
+    except Exception as e:
+        # orbax surfaces a truncated/half-deleted step as whatever its
+        # storage layer happened to hit (KeyError, OSError, msgpack
+        # errors…) — resume callers need ONE refusal shape, not a zoo
+        raise ValueError(
+            f"checkpoint step {step} in {pathlib.Path(directory)} could not "
+            f"be restored ({type(e).__name__}: {e}) — truncated or "
+            "corrupted on disk; refusing to resume"
+        ) from e
+    return _verified(directory, step, restored)
+
+
 def load_checkpoint(directory: str | pathlib.Path, step: int):
-    """Restore the pytree saved at ``step``."""
+    """Restore the pytree saved at ``step`` (integrity-verified)."""
     with _manager(directory) as mgr:
-        # explicit PyTreeRestore: a fresh manager (new process — exactly the
-        # resume case) cannot infer the handler from the directory alone and
-        # raises KeyError 'Item "default" ... could not be restored'
-        return mgr.restore(step, args=ocp.args.PyTreeRestore())
+        return _restore(mgr, directory, step)
 
 
 def load_checkpoints(directory: str | pathlib.Path, steps):
@@ -76,8 +185,10 @@ def load_checkpoints(directory: str | pathlib.Path, steps):
 
     Resume replays every per-date increment; constructing a manager per step
     would re-enumerate the whole directory each time (quadratic in walk length
-    now that all steps are retained).
+    now that all steps are retained). Each step is integrity-verified; a
+    corrupt middle step refuses the whole resume rather than splicing
+    garbage into the ledgers.
     """
     with _manager(directory) as mgr:
         for step in steps:
-            yield mgr.restore(step, args=ocp.args.PyTreeRestore())
+            yield _restore(mgr, directory, step)

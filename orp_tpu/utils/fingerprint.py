@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import pathlib
 
+from orp_tpu.utils.atomic import atomic_write_text
+
 FINGERPRINT_FILE = "run_fingerprint.txt"
 
 
@@ -29,9 +31,13 @@ def read_fingerprint(directory: str | pathlib.Path) -> str | None:
 
 
 def write_fingerprint(directory: str | pathlib.Path, fingerprint: str) -> None:
+    # atomic (write-temp-then-rename): a guard file torn by a killed
+    # process would make an otherwise-valid directory unopenable — or, if
+    # truncation happened to produce a prefix match, silently waive the
+    # compatibility check it exists to enforce
     d = pathlib.Path(directory)
     d.mkdir(parents=True, exist_ok=True)
-    (d / FINGERPRINT_FILE).write_text(fingerprint)
+    atomic_write_text(d / FINGERPRINT_FILE, fingerprint)
 
 
 def verify_fingerprint(

@@ -1,0 +1,481 @@
+"""Chaos suite for ``orp_tpu/guard`` — every resilience claim is proven by
+driving the REAL production paths through the deterministic fault injector
+(``guard/inject.py``): kill-and-resume bitwise equality, truncation/bit-rot
+refusal, NaN sentinel + trainer degradation containment, AOT circuit
+breaking, deadline/watermark shedding with bounded served queue age, and
+transient-dispatch retry. The injector is seed-driven and the suite keeps
+every synthetic sleep under 50ms, so the whole file rides in tier-1."""
+
+import pathlib
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orp_tpu import guard, obs
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.guard import (CircuitBreaker, FaultInjector, FaultPlan,
+                           GuardPolicy, is_rejection)
+from orp_tpu.models import HedgeMLP
+from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
+from orp_tpu.serve import HedgeEngine, MicroBatcher, export_bundle, load_bundle
+from orp_tpu.train import BackwardConfig, backward_induction
+from orp_tpu.utils import latest_step, save_checkpoint
+from orp_tpu.utils.atomic import atomic_write_bytes, atomic_write_text
+
+BASE = dict(epochs_first=30, epochs_warm=15, dual_mode="mse_only",
+            batch_size=512)
+
+
+def _setup(n_paths=512, n_steps=4):
+    grid = TimeGrid(1.0, n_steps)
+    idx = jnp.arange(n_paths, dtype=jnp.uint32)
+    s = simulate_gbm_log(idx, grid, 100.0, 0.08, 0.2, seed=1)
+    b = bond_curve(grid, 0.08)
+    payoff = payoffs.call(s[:, -1], 100.0)
+    model = HedgeMLP(n_features=1, constrain_self_financing=True)
+    return model, (s / 100)[:, :, None], s / 100, b / 100, payoff / 100
+
+
+def _walk(args, **cfg):
+    model, feats, y, b, term = args
+    return backward_induction(model, feats, y, b, term,
+                              BackwardConfig(**{**BASE, **cfg}))
+
+
+def _tree_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    for (path, x), (_, y) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=str(path))
+
+
+# -- kill-and-resume ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("kill_after", [0, 2])
+def test_kill_and_resume_bitwise_equal(tmp_path, kill_after):
+    """A walk killed right after date k's checkpoint committed, then resumed
+    with the same directory, yields ledgers BITWISE-equal to an
+    uninterrupted run — pinned for two kill points per the guard
+    acceptance bar."""
+    args = _setup()
+    full = _walk(args)
+    ckdir = str(tmp_path / "walk")
+    with guard.faults(FaultPlan(kill_after_step=kill_after)) as inj:
+        with pytest.raises(guard.WalkKilled):
+            _walk(args, checkpoint_dir=ckdir)
+    assert inj.log == [("train/kill", f"step={kill_after}")]
+    assert latest_step(ckdir) == kill_after  # death landed where planned
+    resumed = _walk(args, checkpoint_dir=ckdir)
+    for name in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, name)), np.asarray(getattr(resumed, name)),
+            err_msg=name)
+    _tree_equal(full.params1_by_date, resumed.params1_by_date)
+
+
+def test_truncated_checkpoint_detected_and_refused(tmp_path):
+    """A per-date checkpoint truncated on disk (the state a died write or a
+    bad copy leaves) is refused with a clean ValueError — never resumed."""
+    args = _setup()
+    ckdir = tmp_path / "trunc"
+    _walk(args, checkpoint_dir=str(ckdir))
+    blobs = sorted((p for p in (ckdir / "1").rglob("d/*") if p.is_file()),
+                   key=lambda p: -p.stat().st_size)
+    blob = blobs[0]
+    blob.write_bytes(blob.read_bytes()[: blob.stat().st_size // 2])
+    with pytest.raises(ValueError, match="refusing to resume"):
+        _walk(args, checkpoint_dir=str(ckdir))
+
+
+def test_bitflipped_checkpoint_refused(tmp_path):
+    """Same-size corruption (bit rot, not truncation) is caught by the
+    integrity digest even when the storage layer deserializes happily."""
+    args = _setup()
+    ckdir = tmp_path / "rot"
+    _walk(args, checkpoint_dir=str(ckdir))
+    inj = FaultInjector(FaultPlan(seed=5))
+    blobs = sorted((p for p in (ckdir / "1").rglob("d/*") if p.is_file()),
+                   key=lambda p: -p.stat().st_size)
+    blob = blobs[0]
+    blob.write_bytes(inj.corrupt_bytes(blob.read_bytes()))
+    with pytest.raises(ValueError, match="refusing to resume"):
+        _walk(args, checkpoint_dir=str(ckdir))
+
+
+def test_missing_digest_refused(tmp_path):
+    """A MIDDLE step without its integrity digest (pre-guard layout /
+    partial copy) cannot be proven intact and is refused."""
+    args = _setup()
+    ckdir = tmp_path / "nodigest"
+    _walk(args, checkpoint_dir=str(ckdir))
+    (ckdir / "orp_digest_0.sha256").unlink()
+    with pytest.raises(ValueError, match="integrity digest"):
+        _walk(args, checkpoint_dir=str(ckdir))
+
+
+def test_torn_save_recomputes_one_date_not_the_directory(tmp_path, recwarn):
+    """A kill between orbax's commit and the digest write leaves the LATEST
+    step unverifiable. That costs one recomputed date — never the whole
+    directory — and the resumed run still matches the uninterrupted one
+    bitwise."""
+    args = _setup()
+    full = _walk(args)
+    ckdir = tmp_path / "torn"
+    _walk(args, checkpoint_dir=str(ckdir))
+    (ckdir / "orp_digest_3.sha256").unlink()  # the torn-save on-disk state
+    assert latest_step(ckdir) == 3
+    resumed = _walk(args, checkpoint_dir=str(ckdir))
+    assert any("recomputed on resume" in str(w.message) for w in recwarn.list)
+    for name in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, name)), np.asarray(getattr(resumed, name)),
+            err_msg=name)
+
+
+# -- NaN sentinel + trainer ladder -------------------------------------------
+
+
+def test_nan_injection_degrades_only_that_date(recwarn):
+    """NaN-poisoned fit targets at ONE date trip the sentinel there and only
+    there; the ladder lands on gauss_newton, the walk stays finite, the
+    date trained before the fault is bitwise-untouched, and the price stays
+    within the golden band of the clean run."""
+    args = _setup()
+    clean = _walk(args)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(seed=3, nan_dates=frozenset({1}),
+                                    nan_frac=0.02)) as inj:
+            res = _walk(args, nan_guard=True)
+    assert any("guard: non-finite" in str(w.message) for w in recwarn.list)
+    assert [site for site, _ in inj.log] == ["train/fit_target"]
+    guard_events = [e for e in sink.events
+                    if e["type"] == "counter" and e["name"].startswith("guard/")]
+    nan_events = [e for e in guard_events if e["name"] == "guard/nan_event"]
+    # step 1 of a 4-date walk is date t=2; no other date saw an event
+    assert nan_events and all(
+        e["labels"]["date"] == "2" for e in nan_events)
+    degrades = [e for e in guard_events if e["name"] == "guard/degrade"]
+    assert [e["labels"]["to"] for e in degrades] == ["gauss_newton"]
+    assert all(e["labels"]["date"] == "2" for e in degrades)
+    # contained: everything finite, the pre-fault date bitwise identical,
+    # the price inside a 5% band of the clean run
+    assert np.isfinite(np.asarray(res.values)).all()
+    assert np.isfinite(np.asarray(res.phi)).all()
+    np.testing.assert_array_equal(np.asarray(clean.values[:, 3]),
+                                  np.asarray(res.values[:, 3]))
+    np.testing.assert_array_equal(np.asarray(clean.phi[:, 3]),
+                                  np.asarray(res.phi[:, 3]))
+    v_clean, v_got = float(clean.v0.mean()), float(res.v0.mean())
+    assert abs(v_got - v_clean) <= 0.05 * abs(v_clean)
+
+
+def test_nan_guard_clean_path_bitwise_and_silent():
+    """The guard acceptance bar: with the sentinel ON but nothing injected,
+    the walk emits ZERO guard signals and its ledgers are bitwise-equal to
+    the unguarded walk (same discipline as obs's disabled-mode proof)."""
+    args = _setup(n_steps=3)
+    off = _walk(args)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        on = _walk(args, nan_guard=True)
+    assert [e for e in sink.events
+            if e.get("name", "").startswith("guard/")] == []
+    for name in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(off, name)), np.asarray(getattr(on, name)),
+            err_msg=name)
+
+
+def test_nan_guard_budget_exhausted_raises(recwarn):
+    """nan_retries bounds the ladder: budget 0 means the first sentinel trip
+    raises instead of silently corrupting every earlier date."""
+    args = _setup(n_steps=3)
+    with guard.faults(FaultPlan(seed=3, nan_dates=frozenset({0}),
+                                nan_frac=0.02)):
+        with pytest.raises(RuntimeError, match="still non-finite"):
+            _walk(args, nan_guard=True, nan_retries=0)
+
+
+def test_degradation_ladder_shape():
+    assert guard.degradation_ladder("adam", 2) == ["gauss_newton",
+                                                   "final_solve"]
+    assert guard.degradation_ladder("adam", 1) == ["gauss_newton"]
+    assert guard.degradation_ladder("gauss_newton", 2) == ["final_solve"]
+    assert guard.degradation_ladder("final_solve", 2) == []
+    with pytest.raises(ValueError, match="unknown trainer"):
+        guard.degradation_ladder("sgd", 1)
+
+
+def test_sanitize_target():
+    t = jnp.asarray([1.0, jnp.nan, 3.0, jnp.inf])
+    cleaned, n_bad = guard.sanitize_target(t)
+    assert n_bad == 2
+    assert np.isfinite(np.asarray(cleaned)).all()
+    np.testing.assert_allclose(np.asarray(cleaned), [1.0, 2.0, 3.0, 2.0])
+    same, n0 = guard.sanitize_target(jnp.asarray([1.0, 2.0]))
+    assert n0 == 0 and same.shape == (2,)
+
+
+def test_fused_walk_rejects_nan_guard():
+    with pytest.raises(ValueError, match="host loop"):
+        BackwardConfig(fused=True, nan_guard=True)
+    with pytest.raises(ValueError, match="host loop"):
+        TrainConfig(fused=True, nan_guard=True)
+
+
+# -- injector determinism ----------------------------------------------------
+
+
+def test_injector_is_deterministic():
+    t = jnp.linspace(0.0, 1.0, 64)
+    a = FaultInjector(FaultPlan(seed=7, nan_dates=frozenset({0}),
+                                nan_frac=0.1)).corrupt_target(0, t)
+    b = FaultInjector(FaultPlan(seed=7, nan_dates=frozenset({0}),
+                                nan_frac=0.1)).corrupt_target(0, t)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.isnan(np.asarray(a)).sum()) == 6  # round(0.1 * 64)
+    blob = bytes(range(64))
+    c1 = FaultInjector(FaultPlan(seed=9)).corrupt_bytes(blob)
+    c2 = FaultInjector(FaultPlan(seed=9)).corrupt_bytes(blob)
+    assert c1 == c2 and c1 != blob and len(c1) == len(blob)
+
+
+def test_fault_plans_do_not_nest():
+    with guard.faults(FaultPlan()):
+        with pytest.raises(RuntimeError, match="do not nest"):
+            with guard.faults(FaultPlan()):
+                pass
+
+
+# -- serving: breaker, deadlines, shedding, retry ----------------------------
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(scope="module")
+def aot_bundle(tmp_path_factory, trained):
+    from orp_tpu.aot import export_aot
+
+    d = tmp_path_factory.mktemp("bundle")
+    export_bundle(trained, d)
+    bundle = load_bundle(d)
+    export_aot(d, bundle, buckets=(8,))
+    return load_bundle(d)
+
+
+def _rows(n, n_features, seed=0):
+    rng = np.random.default_rng(seed)
+    return (1.0 + 0.1 * rng.standard_normal((n, n_features))).astype(np.float32)
+
+
+def test_circuit_breaker_demotes_failing_aot_bucket_to_jit(aot_bundle, recwarn):
+    """Steady-state AOT failures: each failed execution falls back to jit
+    for its own request (bitwise-equal), and threshold consecutive failures
+    open the circuit — the bucket is demoted to jit for the process."""
+    jit_engine = HedgeEngine(aot_bundle, use_aot=False)
+    engine = HedgeEngine(aot_bundle, aot_failure_threshold=2)
+    assert engine.cache_info()["aot_buckets"] == [8]
+    feats = _rows(4, aot_bundle.model.n_features)
+    ref_phi, ref_psi, _ = jit_engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(fail={"serve/aot_dispatch": 2})) as inj:
+            outs = [engine.evaluate(0, feats) for _ in range(3)]
+    assert [site for site, _ in inj.log] == ["serve/aot_dispatch"] * 2
+    for phi, psi, _ in outs:  # every response bitwise-equal to pure jit
+        np.testing.assert_array_equal(phi, ref_phi)
+        np.testing.assert_array_equal(psi, ref_psi)
+    ci = engine.cache_info()
+    assert ci["aot_circuit_open"] == [8]
+    assert ci["aot_buckets"] == []  # demoted for the process lifetime
+    assert reg.counter("guard/aot_exec_failure", {"bucket": "8"}).value == 2
+    assert reg.counter("guard/circuit_open", {"aot_bucket": "8"}).value == 1
+    assert any("circuit opened" in str(w.message) for w in recwarn.list)
+
+
+def test_circuit_breaker_success_resets_streak():
+    br = CircuitBreaker(3)
+    assert not br.record_failure("b")
+    assert not br.record_failure("b")
+    br.record_success("b")  # streak broken: flakes never accumulate
+    assert not br.record_failure("b")
+    assert not br.record_failure("b")
+    assert br.record_failure("b")  # third CONSECUTIVE: trips once
+    assert br.is_open("b")
+    assert not br.record_failure("b")  # already open: no re-trip
+
+
+def test_batcher_deadline_sheds_and_bounds_served_queue_age(trained):
+    """The head-of-line scenario: one slow request occupies the worker; the
+    requests that aged past their deadline behind it are SHED with a
+    structured Rejection, the rest are served — so the queue age of every
+    SERVED request stays inside its deadline (pinned via the obs queue-age
+    histogram), whatever the slow neighbour did."""
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1, 8])  # no first-touch compile inside the timed window
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with MicroBatcher(engine, max_batch=8, max_wait_us=200.0,
+                              policy=GuardPolicy(deadline_ms=200.0)) as mb:
+                slow = mb.submit(0, _rows(1, nf))
+                time.sleep(0.005)  # worker picks it up, sleeps 40ms inside
+                doomed = [mb.submit(0, _rows(1, nf), deadline_s=0.005)
+                          for _ in range(5)]
+                fine = [mb.submit(0, _rows(1, nf), deadline_s=1.0)
+                        for _ in range(10)]
+                results = [f.result() for f in fine]
+    assert not is_rejection(slow.result())
+    for f in doomed:  # aged ~40ms against a 5ms budget: shed, not served late
+        r = f.result()
+        assert is_rejection(r) and r.reason == "deadline"
+        assert r.queued_s >= 0.005 and r.deadline_s == pytest.approx(0.005)
+    assert all(not is_rejection(r) for r in results)
+    served = reg.histogram("serve/queue_age_seconds", {"outcome": "served"})
+    assert served.count >= 11  # slow + the 10 fast survivors
+    assert served.percentiles([99])[0] <= 1.0  # bounded by the deadline
+    shed = reg.histogram("serve/queue_age_seconds", {"outcome": "shed"})
+    assert shed.count == 5
+    assert reg.counter("guard/shed", {"reason": "deadline"}).value == 5
+
+
+def test_batcher_watermark_sheds_earliest_deadline(trained):
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1, 8])
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with MicroBatcher(engine, max_batch=8, max_wait_us=200.0,
+                              policy=GuardPolicy(queue_watermark=3)) as mb:
+                blocker = mb.submit(0, _rows(1, nf))
+                time.sleep(0.005)  # worker now inside the slow dispatch
+                early = mb.submit(0, _rows(1, nf), deadline_s=0.03)
+                late = [mb.submit(0, _rows(1, nf), deadline_s=5.0)
+                        for _ in range(2)]
+                # queue is AT the watermark; the next admit sheds the
+                # earliest-deadline request — `early`, not the newcomer
+                late.append(mb.submit(0, _rows(1, nf), deadline_s=5.0))
+                r_early = early.result()
+                r_late = [f.result() for f in late]
+    assert is_rejection(r_early) and r_early.reason == "watermark"
+    assert not is_rejection(blocker.result())
+    assert all(not is_rejection(r) for r in r_late)
+    assert reg.counter("guard/shed", {"reason": "watermark"}).value == 1
+
+
+def test_batcher_retry_recovers_transient_dispatch(trained):
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1])
+    feats = _rows(1, nf)
+    ref_phi, _, _ = engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(fail={"serve/dispatch": 1})):
+            with MicroBatcher(engine, max_wait_us=200.0,
+                              policy=GuardPolicy(max_retries=2,
+                                                 backoff_ms=1.0)) as mb:
+                phi, psi, value = mb.evaluate(0, feats)
+    np.testing.assert_array_equal(phi, ref_phi)
+    assert reg.counter("guard/retry",
+                       {"site": "serve/dispatch", "attempt": "1"}).value == 1
+
+
+def test_batcher_retry_budget_exhausted_propagates(trained):
+    engine = HedgeEngine(trained)
+    engine.prewarm([1])
+    with guard.faults(FaultPlan(fail={"serve/dispatch": 5})):
+        with MicroBatcher(engine, max_wait_us=200.0,
+                          policy=GuardPolicy(max_retries=1,
+                                             backoff_ms=1.0)) as mb:
+            fut = mb.submit(0, _rows(1, trained.model.n_features))
+            with pytest.raises(guard.InjectedFault):
+                fut.result()
+
+
+def test_batcher_without_policy_is_clean_path(trained):
+    """No policy -> the pre-guard contract exactly: correct results, no
+    deadline, no shed, and ZERO guard signals even under a live obs session
+    (the disabled-mode discipline)."""
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    feats = _rows(3, nf)
+    ref_phi, ref_psi, _ = engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with MicroBatcher(engine, max_wait_us=200.0) as mb:
+            phi, psi, value = mb.evaluate(0, feats)
+    np.testing.assert_array_equal(phi, ref_phi)
+    np.testing.assert_array_equal(psi, ref_psi)
+    assert [e for e in sink.events
+            if e.get("name", "").startswith("guard/")] == []
+    assert guard.inject.active() is None  # no injector outside chaos scopes
+
+
+def test_guard_policy_validation():
+    with pytest.raises(ValueError, match="deadline_ms"):
+        GuardPolicy(deadline_ms=0.0)
+    with pytest.raises(ValueError, match="queue_watermark"):
+        GuardPolicy(queue_watermark=0)
+    with pytest.raises(ValueError, match="max_retries"):
+        GuardPolicy(max_retries=-1)
+    p = GuardPolicy(backoff_ms=2.0, backoff_cap_ms=3.0)
+    assert p.backoff_s(1) == pytest.approx(0.002)
+    assert p.backoff_s(5) == pytest.approx(0.003)  # capped
+
+
+# -- atomic side files + CLI resume ------------------------------------------
+
+
+def test_atomic_writes_replace_and_leave_no_temps(tmp_path):
+    atomic_write_text(tmp_path / "a.txt", "hello")
+    atomic_write_bytes(tmp_path / "b.bin", b"\x00\x01")
+    assert (tmp_path / "a.txt").read_text() == "hello"
+    assert (tmp_path / "b.bin").read_bytes() == b"\x00\x01"
+    atomic_write_text(tmp_path / "a.txt", "world")  # atomic replace
+    assert (tmp_path / "a.txt").read_text() == "world"
+    leftovers = [p.name for p in tmp_path.iterdir()
+                 if p.suffix == ".tmp" or p.name.startswith(".")]
+    assert leftovers == []
+
+
+def test_cli_resume_flag(tmp_path):
+    from orp_tpu.cli import _train_cfg, build_parser
+
+    parser = build_parser()
+    # an empty/missing dir refuses: --resume must never silently START a run
+    args = parser.parse_args(["euro", "--resume", str(tmp_path / "nope")])
+    with pytest.raises(SystemExit, match="no per-date checkpoints"):
+        _train_cfg(args, "mse_only")
+    # a dir with per-date state resumes (and keeps checkpointing there)
+    d = tmp_path / "ck"
+    save_checkpoint(d, 0, {"x": jnp.ones(2)})
+    args = parser.parse_args(["euro", "--resume", str(d)])
+    cfg = _train_cfg(args, "mse_only")
+    assert cfg.checkpoint_dir == str(d)
+    # two different directories is a user error, not a guess
+    args = parser.parse_args(["euro", "--resume", str(d),
+                              "--checkpoint-dir", str(tmp_path / "other")])
+    with pytest.raises(SystemExit, match="different"):
+        _train_cfg(args, "mse_only")
+    # --nan-guard flows into the train config
+    args = parser.parse_args(["euro", "--nan-guard", "--nan-retries", "1"])
+    cfg = _train_cfg(args, "mse_only")
+    assert cfg.nan_guard and cfg.nan_retries == 1

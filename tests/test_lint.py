@@ -24,7 +24,7 @@ def codes(src, **kw):
 
 
 def test_rule_registry_complete():
-    assert set(RULES) == {f"ORP00{i}" for i in range(1, 9)}
+    assert set(RULES) == {f"ORP00{i}" for i in range(1, 10)}
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -512,6 +512,86 @@ def test_orp008_noqa_suppresses():
     src = """
         import jax
         jax.config.update("jax_compilation_cache_dir", "/tmp/c")  # orp: noqa[ORP008] -- bootstrap probe
+    """
+    assert codes(src) == []
+
+
+# -- ORP009: silent broad excepts --------------------------------------------
+
+ORP009_POS = """
+    def swallow(fn):
+        try:
+            return fn()
+        except Exception:
+            return None
+
+    def swallow_bare(fn):
+        try:
+            fn()
+        except:
+            pass
+
+    def swallow_tuple(fn):
+        try:
+            fn()
+        except (ValueError, Exception) as e:
+            result = str(e)
+"""
+
+ORP009_NEG = """
+    import warnings
+    from orp_tpu.obs import count as obs_count
+
+    def narrow(fn):
+        try:
+            return fn()
+        except ValueError:      # narrow types are the caller's business
+            return None
+
+    def reraises(fn):
+        try:
+            return fn()
+        except Exception as e:
+            raise RuntimeError("context") from e
+
+    def warns(fn):
+        try:
+            return fn()
+        except Exception as e:
+            warnings.warn(f"degraded: {e}")
+            return None
+
+    def counts(fn):
+        try:
+            return fn()
+        except Exception:
+            obs_count("guard/swallowed")
+            return None
+
+    def delivers(fn, fut):
+        try:
+            fut.set_result(fn())
+        except Exception as e:
+            fut.set_exception(e)
+"""
+
+
+def test_orp009_flags_silent_broad_excepts():
+    got = codes(ORP009_POS)
+    assert got.count("ORP009") == 3  # except Exception, bare, tuple-with-broad
+
+
+def test_orp009_clean_negative():
+    assert codes(ORP009_NEG) == []
+
+
+def test_orp009_noqa_suppresses():
+    src = """
+        def swallow(fn):
+            try:
+                return fn()
+            except Exception:  # orp: noqa[ORP009] -- helper warns internally
+                return None
     """
     assert codes(src) == []
 

@@ -20,6 +20,9 @@ sys.path.insert(0, str(HERE))
 
 from orp_tpu.lint import format_findings, format_json, lint_paths  # noqa: E402
 
+# "orp_tpu" is the package DIRECTORY, so every subpackage — orp_tpu/guard
+# included — is gated automatically the moment it exists; no per-subsystem
+# registration to forget
 GATED = ("orp_tpu", "tools", "examples", "benchmarks", "bench.py",
          "tests/conftest.py")
 

@@ -69,7 +69,7 @@ def main(argv=None):
         t0 = time.perf_counter()
         try:
             rec = {**base, **run()}
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # orp: noqa[ORP009] -- the error is captured into the emitted JSONL record's error field
             rec = {**base, "error": f"{type(e).__name__}: {e}"[:200]}
         rec["total_s"] = round(time.perf_counter() - t0, 1)
         rec["platform"] = jax.devices()[0].platform
