@@ -42,12 +42,12 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 pipeline/shape WITHOUT simulating or training, so the next
                 real run skips the 60-90s whole-walk compile (``orp_tpu/aot``)
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP009 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP010 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
-                cache config outside orp_tpu/aot, silent broad excepts);
-                exits non-zero on findings so it gates commits
-                (tools/lint_all.py)
+                cache config outside orp_tpu/aot, silent broad excepts,
+                blocking calls in serve dispatch-loop code); exits non-zero
+                on findings so it gates commits (tools/lint_all.py)
 
 Training commands take ``--checkpoint-dir DIR`` (persist per-date state) /
 ``--resume DIR`` (continue an interrupted walk, bitwise-equal to an
@@ -103,29 +103,47 @@ def _train_cfg(args, default_dual: str):
                 "--checkpoint-dir"
             )
         ckdir = resume
-    if args.fused and ckdir is not None:
-        # clean CLI error instead of the TrainConfig ValueError traceback
-        raise SystemExit(
-            "error: --fused runs the whole walk device-side and cannot "
-            "checkpoint per date; drop --fused or --checkpoint-dir/--resume"
+    try:
+        return TrainConfig(
+            epochs_first=args.epochs_first,
+            epochs_warm=args.epochs_warm,
+            batch_size=args.batch_size,
+            dual_mode=args.dual_mode or default_dual,
+            checkpoint_dir=ckdir,
+            fused=args.fused,
+            shuffle="blocks" if args.fused else True,
+            final_solve=args.final_solve,
+            optimizer=args.optimizer,
+            gn_iters_first=args.gn_iters_first,
+            gn_iters_warm=args.gn_iters_warm,
+            gn_quantile=not args.adam_quantile,
+            gn_block_rows=args.gn_block_rows,
+            nan_guard=getattr(args, "nan_guard", False),
+            nan_retries=getattr(args, "nan_retries", 2),
         )
-    return TrainConfig(
-        epochs_first=args.epochs_first,
-        epochs_warm=args.epochs_warm,
-        batch_size=args.batch_size,
-        dual_mode=args.dual_mode or default_dual,
-        checkpoint_dir=ckdir,
-        fused=args.fused,
-        shuffle="blocks" if args.fused else True,
-        final_solve=args.final_solve,
-        optimizer=args.optimizer,
-        gn_iters_first=args.gn_iters_first,
-        gn_iters_warm=args.gn_iters_warm,
-        gn_quantile=not args.adam_quantile,
-        gn_block_rows=args.gn_block_rows,
-        nan_guard=getattr(args, "nan_guard", False),
-        nan_retries=getattr(args, "nan_retries", 2),
-    )
+    except ValueError as e:
+        # config-conflict validation has ONE source of truth —
+        # TrainConfig.__post_init__ (mirroring train.BackwardConfig); the
+        # CLI only translates the config-field message into flag-speak
+        # instead of duplicating the rules here and letting them drift
+        raise SystemExit(f"error: {_flagspeak(str(e))}") from None
+
+
+_FLAG_NAMES = (
+    ("fused=True", "--fused"),
+    ("fused=False", "no --fused"),
+    ("per-date checkpointing", "--checkpoint-dir/--resume checkpointing"),
+    ("checkpoint_dir", "--checkpoint-dir/--resume"),
+    ("nan_guard", "--nan-guard"),
+    ("nan_retries", "--nan-retries"),
+)
+
+
+def _flagspeak(msg: str) -> str:
+    """Rephrase a TrainConfig ValueError's field names as CLI flags."""
+    for field, flag in _FLAG_NAMES:
+        msg = msg.replace(field, flag)
+    return msg
 
 
 def _add_train_flags(p):
@@ -616,9 +634,23 @@ def cmd_export(args):
 
 
 def cmd_serve_bench(args):
+    import pathlib
+
     from orp_tpu.serve import load_bundle, serve_bench, write_bench_record
 
     bundle = load_bundle(args.bundle)
+    # the existing record (if any) is the before: its batcher numbers ride
+    # into the new record as batcher_before, so BENCH_serve.json carries
+    # its own sync-vs-async comparison
+    previous = None
+    if args.out and pathlib.Path(args.out).exists():
+        try:
+            previous = json.loads(pathlib.Path(args.out).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: ignoring unreadable previous record "
+                  f"{args.out}: {e}", file=sys.stderr)
+    sweep = (tuple(int(x) for x in args.sweep_concurrency.split(","))
+             if args.sweep_concurrency else ())
     record = serve_bench(
         bundle,
         n_requests=args.requests,
@@ -626,6 +658,9 @@ def cmd_serve_bench(args):
         batcher_requests=args.batcher_requests,
         max_wait_us=args.max_wait_us,
         prewarm=args.prewarm,
+        sweep_concurrency=sweep,
+        sweep_requests=args.sweep_requests,
+        previous=previous,
     )
     if args.out:
         write_bench_record(record, args.out)
@@ -985,9 +1020,14 @@ def build_parser():
     psb.add_argument("--batch-sizes", default="1,7,64,1000",
                      help="comma-separated request sizes the schedule cycles")
     psb.add_argument("--batcher-requests", type=int, default=256,
-                     help="single-row burst size for the micro-batcher phase")
+                     help="single-row burst size for the batcher phase")
     psb.add_argument("--max-wait-us", type=float, default=500.0,
-                     help="micro-batcher coalescing window")
+                     help="batcher idle-device coalescing window")
+    psb.add_argument("--sweep-concurrency", default="1,2,4",
+                     help="comma-separated submitter-thread counts for the "
+                          "sustained concurrency sweep ('' skips the sweep)")
+    psb.add_argument("--sweep-requests", type=int, default=2048,
+                     help="total single-row requests per sweep level")
     psb.add_argument("--out", default="BENCH_serve.json",
                      help="record file to write ('' skips the file; the "
                           "record always prints as one JSON line)")
@@ -1004,8 +1044,8 @@ def build_parser():
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
-             "drift, key reuse, silent excepts — rules ORP001-ORP009); "
-             "non-zero exit on findings",
+             "drift, key reuse, silent excepts, blocking dispatch loops — "
+             "rules ORP001-ORP010); non-zero exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: the orp_tpu "

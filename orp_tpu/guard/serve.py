@@ -55,10 +55,11 @@ class Rejection:
     unpacking ``(phi, psi, value)``.
     """
 
-    reason: str           # "deadline" | "watermark"
+    reason: str           # "deadline" | "watermark" | "quota" (multi-tenant
+    # host: the tenant is over its in-flight budget, serve/host.py)
     queued_s: float       # how long the request waited before the decision
     deadline_s: float | None  # its deadline budget (None: shed by watermark
-    # while carrying no deadline of its own)
+    # or quota while carrying no deadline of its own)
 
 
 def is_rejection(result) -> bool:

@@ -1,8 +1,8 @@
 """orp_tpu.lint — JAX/TPU-aware static analyzer + runtime compile auditor.
 
 Static side (``orp lint [--json] [paths]``, ``python -m orp_tpu.lint``):
-an AST rules engine (orp_tpu/lint/engine.py) with seven rules targeting
-this codebase's real hazards (orp_tpu/lint/rules.py, ORP001-ORP007) and
+an AST rules engine (orp_tpu/lint/engine.py) with ten rules targeting
+this codebase's real hazards (orp_tpu/lint/rules.py, ORP001-ORP010) and
 per-line ``# orp: noqa[RULE] -- reason`` suppressions. The package lints
 itself clean in CI (tests/test_lint_self.py); ``tools/lint_all.py`` is the
 commit gate.
@@ -21,7 +21,7 @@ from orp_tpu.lint.engine import (
     lint_paths,
     lint_source,
 )
-from orp_tpu.lint import rules as _rules  # noqa: F401  (registers ORP001-007)
+from orp_tpu.lint import rules as _rules  # noqa: F401  (registers ORP001-010)
 from orp_tpu.lint.trace_audit import (
     CompileAudit,
     CompileBudgetExceeded,

@@ -37,6 +37,15 @@ ORP009  silent broad excepts: an ``except Exception`` / bare ``except``
         guard audit found exactly these hiding degraded AOT paths. A
         handler that delegates its emission carries a
         ``# orp: noqa[ORP009] -- reason``.
+ORP010  blocking calls in serve dispatch-loop code: the continuous
+        batcher's whole design is that admit/dispatch never wait on
+        anything but the Condition — a ``time.sleep``, a bare
+        ``Future.result()`` (no timeout), or a host sync
+        (``block_until_ready`` / ``device_get`` / ``.item()``) inside the
+        loop head-of-line-blocks every queued request (the synchronous
+        tier's 19ms-p99-vs-0.68ms-engine pathology, BENCH_serve.json).
+        Resolution is the one stage whose JOB is to block, so ``*resolve*``
+        functions are out of scope by name.
 """
 
 from __future__ import annotations
@@ -596,6 +605,62 @@ def _handler_emits(h: ast.ExceptHandler) -> bool:
                 if tail in _EMIT_CALL_TAILS:
                     return True
     return False
+
+
+# -- ORP010 ------------------------------------------------------------------
+
+# scope: functions that ARE the serve tier's dispatch loop — admit/dispatch/
+# drain/schedule stages (and the loop driver `_run`) in any file under a
+# serve package. Resolution functions are deliberately OUT of scope: their
+# job is to block on the oldest in-flight batch; everything before them must
+# stay non-blocking or the device idles behind Python.
+_DISPATCH_LOOP_RE = re.compile(r"(^_?run$)|dispatch|admit|drain|schedule")
+_BLOCKING_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get",
+                        "block_until_ready", "device_get"}
+
+
+@rule("ORP010", "blocking call inside serve dispatch-loop code")
+def check_dispatch_loop_blocking(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _DISPATCH_LOOP_RE.search(fdef.name):
+            continue
+        for node in walk_scope(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d == "time.sleep":
+                yield ctx.finding(
+                    node, "ORP010",
+                    f"time.sleep in dispatch-loop {fdef.name!r} — every "
+                    "queued request pays this nap; wait on the loop's "
+                    "Condition/Event with a timeout so close() can "
+                    "interrupt it",
+                )
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "result"
+                  and not node.args
+                  and not any(kw.arg == "timeout" for kw in node.keywords)):
+                yield ctx.finding(
+                    node, "ORP010",
+                    f"bare .result() (no timeout) in dispatch-loop "
+                    f"{fdef.name!r} — an unbounded block while requests "
+                    "queue behind it; resolve futures in the resolve "
+                    "stage, or pass a timeout",
+                )
+            elif (d in _BLOCKING_SYNC_CALLS
+                  or (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in ("item",))):
+                yield ctx.finding(
+                    node, "ORP010",
+                    f"host sync ({d or node.func.attr}) in dispatch-loop "
+                    f"{fdef.name!r} — blocks the loop on the device; defer "
+                    "device reads to the resolve stage",
+                )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

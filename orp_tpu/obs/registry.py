@@ -118,6 +118,16 @@ class Histogram:
             self._count += 1
             self._sum += v
 
+    def observe_many(self, values) -> None:
+        """Record a batch of samples under ONE lock acquisition — the serve
+        tier resolves whole coalesced batches at once, and per-sample lock
+        churn would put the recorder inside the latency it measures."""
+        vals = [float(v) for v in values]
+        with self._lock:
+            self._samples.extend(vals)
+            self._count += len(vals)
+            self._sum += sum(vals)
+
     def reset(self) -> None:
         with self._lock:
             self._reset_locked()
@@ -144,6 +154,16 @@ class Histogram:
         if lat.size == 0:
             return [0.0 for _ in qs]
         return [float(p) for p in np.percentile(lat, list(qs))]
+
+    def fraction_over(self, threshold: float) -> float:
+        """Fraction of the retained window strictly above ``threshold`` —
+        the SLO-violation rate an error-budget burn evaluation divides by
+        its budget (``serve/host.py``). 0.0 when empty: no traffic burns
+        no budget."""
+        vals = self.snapshot()
+        if vals.size == 0:
+            return 0.0
+        return float((vals > float(threshold)).mean())
 
 
 class Registry:

@@ -8,26 +8,39 @@ that into production artifacts and serves them:
   (orbax params + JSON metadata + run-fingerprint guard);
 - ``engine``  — jit-compiled ``evaluate(date_idx, states) -> (phi, psi, v)``
   with shape-bucketed executable caching (arbitrary request sizes hit a
-  small fixed set of compiled programs);
-- ``batcher`` — micro-batching: coalesce many small synchronous requests
-  into one device batch (max-batch / max-wait policy); an optional
-  ``orp_tpu.guard.GuardPolicy`` adds per-request deadlines, watermark
-  load shedding and transient-dispatch retries;
-- ``metrics`` — p50/p95/p99 latency + throughput counters;
-- ``bench``   — the ``serve-bench`` mode emitting ``BENCH_serve.json``.
+  small fixed set of compiled programs) and a non-blocking
+  ``evaluate_async`` twin (dispatch now, block later) the batcher
+  overlaps;
+- ``batcher`` — async continuous batching: a dispatch loop that admits
+  in-flight requests into the next bucket while the previous batch
+  executes on device (double-buffered submit riding JAX's async
+  dispatch); an optional ``orp_tpu.guard.GuardPolicy`` adds per-request
+  deadlines, watermark load shedding and transient-dispatch retries;
+- ``host``    — multi-tenant serving: many policy bundles in one process
+  under an LRU engine cap, per-tenant quotas (``Rejection``
+  ``reason="quota"``) and SLO burn-rate evaluation off the obs registry;
+- ``metrics`` — p50/p95/p99 latency + throughput counters + dispatch-
+  amortisation gauges (batch occupancy, dispatches per request);
+- ``bench``   — the ``serve-bench`` mode (mixed-size engine schedule,
+  batcher burst, concurrency sweep) emitting ``BENCH_serve.json``.
 """
 
 from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.bench import serve_bench, write_bench_record
 from orp_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
-from orp_tpu.serve.engine import HedgeEngine
+from orp_tpu.serve.engine import HedgeEngine, PendingEval
+from orp_tpu.serve.host import ServeHost, SloPolicy, burn_rate
 from orp_tpu.serve.metrics import ServingMetrics
 
 __all__ = [
     "HedgeEngine",
     "MicroBatcher",
+    "PendingEval",
     "PolicyBundle",
+    "ServeHost",
     "ServingMetrics",
+    "SloPolicy",
+    "burn_rate",
     "export_bundle",
     "load_bundle",
     "serve_bench",

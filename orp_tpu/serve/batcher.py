@@ -1,41 +1,61 @@
-"""Micro-batching: coalesce small synchronous requests into device batches.
+"""Continuous batching: keep the device busy while requests keep arriving.
 
 The serving anti-pattern is one device dispatch per one-row request — launch
-overhead dominates and the MXU runs at batch size 1. The standard fix (the
-shape every production JAX/Triton/TF-Serving stack converges on) is a
-micro-batcher: requests land on a queue, a worker drains it under a
-``max_batch`` / ``max_wait_us`` policy, groups rows that can share an
-executable (same rebalance date, same prices-presence), dispatches ONE
-bucketed evaluation per group, and scatters the row slices back to each
-caller's future.
+overhead dominates and the MXU runs at batch size 1. The first fix (PR 1)
+was a synchronous micro-batcher: drain the queue, dispatch ONE bucketed
+evaluation, block on it, repeat. That amortized dispatch but serialized the
+host and the device: while the worker blocked on batch N, newly arrived
+requests just aged in the queue (BENCH_serve.json before this tier: batcher
+p99 19ms against an engine p99 of 0.68ms — the Python queue, not the
+device, was the bottleneck).
 
-Correctness contract: every request gets exactly the rows it submitted, in
-the order it submitted them, bitwise-equal to a solo ``engine.evaluate`` of
-the same rows padded into the same bucket family — the batcher changes
-latency/throughput, never results. A failed dispatch propagates the
-exception to every future in that group (not to unrelated groups).
+This module is the production-inference shape instead — an async
+CONTINUOUS-BATCHING dispatch loop riding JAX's async dispatch:
 
-Resilience (``orp_tpu/guard``, opt-in via a :class:`GuardPolicy`): the
-single-worker design means one slow request head-of-line-blocks everything
-behind it (BENCH_serve.json: the Python queue, not the device, is the
-bottleneck). Under a policy the batcher therefore
+- **admit**    — drain everything pending into the largest batch that fits
+  (``max_batch`` rows), grouped so rows that can share an executable ride
+  one dispatch; requests that aged past their deadline are shed here,
+  never dispatched.
+- **dispatch** — submit the batch to the device WITHOUT blocking
+  (``HedgeEngine.evaluate_async``): XLA's runtime owns it now.
+- **overlap**  — while that batch executes, loop straight back to admit:
+  requests that arrived in the meantime form the next batch, which is
+  dispatched too (double-buffered — up to ``max_inflight`` batches queued
+  on the device, so the device never waits on Python).
+- **resolve**  — block on the OLDEST in-flight batch, slice each request's
+  rows back out, and resolve every future in bulk OUTSIDE the lock (a
+  done-callback that re-enters the batcher must never deadlock on the
+  held Condition — the PR 6 lesson, generalized to the whole loop).
 
-- tracks every request's QUEUE AGE (``serve/queue_age_seconds`` histogram,
-  labelled ``outcome=served|shed``) — the trace signal the shed decisions
-  act on (the Dapper loop, PAPERS.md);
-- enforces per-request DEADLINES: a request whose queue age passes its
-  deadline is shed with a structured :class:`Rejection` through its future
+Correctness contract is unchanged from the synchronous batcher: every
+request gets exactly the rows it submitted, in the order it submitted
+them, bitwise-equal to a solo ``engine.evaluate`` of the same rows padded
+into the same bucket family — the batcher changes latency/throughput,
+never results. A failed dispatch propagates the exception to every future
+in that group (not to unrelated groups).
+
+Resilience (``orp_tpu/guard``, opt-in via a :class:`GuardPolicy`) keeps
+its exact pre-async semantics under concurrency:
+
+- every request's QUEUE AGE lands in ``serve/queue_age_seconds{outcome}``
+  — the trace signal the shed decisions act on (the Dapper loop,
+  PAPERS.md);
+- per-request DEADLINES: a request whose queue age passes its deadline is
+  shed with a structured :class:`Rejection` through its future
   (``guard/shed{reason="deadline"}``), never served late — so the queue
   age of every *served* request is bounded by its deadline, whatever a
   slow neighbour did;
-- applies ADMISSION CONTROL: past ``queue_watermark`` pending requests,
-  the earliest-deadline (then oldest) request is shed at submit time
+- ADMISSION CONTROL: past ``queue_watermark`` pending requests, the
+  earliest-deadline (then oldest) request is shed at submit time
   (``guard/shed{reason="watermark"}``);
-- RETRIES a dispatch that raised :class:`TransientDispatchError`, with
-  bounded exponential backoff (``guard/retry``).
+- RETRIES of a dispatch that raised :class:`TransientDispatchError`, with
+  bounded exponential backoff (``guard/retry``) — the backoff waits on an
+  Event the close path sets, not ``time.sleep``, so it is interruptible
+  and the dispatch loop never takes an unbreakable nap (lint rule
+  ORP010's whole point).
 
-Without a policy none of this runs: the clean path is the pre-guard
-batcher, and the per-request obs calls are the usual disabled-mode no-ops.
+Without a policy none of this runs; the per-request obs calls are the
+usual disabled-mode no-ops.
 """
 
 from __future__ import annotations
@@ -44,7 +64,10 @@ import collections
 import dataclasses
 import threading
 import time
-from concurrent.futures import Future
+# distinct from builtin TimeoutError on Python <= 3.10, an alias after —
+# raising THIS keeps every `except concurrent.futures.TimeoutError` a
+# stdlib-Future client already wrote working against SlimFuture
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 
 import numpy as np
 
@@ -54,15 +77,132 @@ from orp_tpu.obs import observe as obs_observe
 from orp_tpu.obs import span
 from orp_tpu.serve.metrics import ServingMetrics
 
+_PENDING, _DONE, _FAILED = 0, 1, 2
+
+
+class SlimFuture:
+    """The per-request future, slimmed to what a serve tier needs.
+
+    ``concurrent.futures.Future`` costs ~6µs to CONSTRUCT (a fresh
+    Condition — two lock allocations — per instance) and ~1µs to resolve;
+    at 10^5 requests/s that alone is more than half the Python budget.
+    This class carries the used subset of the contract — ``result([
+    timeout])``, ``exception()``, ``done()``, ``add_done_callback``,
+    ``set_result``/``set_exception``, ``set_running_or_notify_cancel``
+    (always True: a submitted request is never cancellable, its rows may
+    already ride an in-flight dispatch) — over one CLASS-LEVEL lock and a
+    lazily-allocated per-waiter Event, so the common open-loop shape
+    (submit a stream, gather at the end, most futures already resolved)
+    pays ~0.3µs per request.
+
+    The shared lock is held only for state handoff (never while running
+    callbacks or waiting), so resolutions on the dispatch-loop thread and
+    waits on client threads contend for nanoseconds, not milliseconds.
+    """
+
+    __slots__ = ("_state", "_value", "_event", "_cbs")
+    _lock = threading.Lock()  # class-level: state handoff only
+
+    def __init__(self):
+        self._state = _PENDING
+        self._value = None
+        self._event = None
+        self._cbs = None
+
+    def _resolve(self, state, value) -> None:
+        with SlimFuture._lock:
+            if self._state != _PENDING:
+                raise RuntimeError("future already resolved")
+            self._value = value
+            self._state = state
+            ev = self._event
+            cbs = self._cbs
+            self._cbs = None
+        if ev is not None:
+            ev.set()
+        if cbs:
+            for cb in cbs:
+                cb(self)
+
+    def set_result(self, value) -> None:
+        self._resolve(_DONE, value)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._resolve(_FAILED, exc)
+
+    def set_running_or_notify_cancel(self) -> bool:
+        return True
+
+    def done(self) -> bool:
+        return self._state != _PENDING
+
+    def add_done_callback(self, fn) -> None:
+        run_now = False
+        with SlimFuture._lock:
+            if self._state != _PENDING:
+                run_now = True
+            elif self._cbs is None:
+                self._cbs = [fn]
+            else:
+                self._cbs.append(fn)
+        if run_now:
+            fn(self)
+
+    def _wait(self, timeout) -> None:
+        with SlimFuture._lock:
+            if self._state != _PENDING:
+                return
+            if self._event is None:
+                self._event = threading.Event()
+            ev = self._event
+        if not ev.wait(timeout):
+            raise _FutureTimeoutError("request not resolved within timeout")
+
+    def result(self, timeout: float | None = None):
+        if self._state == _PENDING:
+            self._wait(timeout)
+        if self._state == _FAILED:
+            raise self._value
+        return self._value
+
+    def exception(self, timeout: float | None = None):
+        if self._state == _PENDING:
+            self._wait(timeout)
+        return self._value if self._state == _FAILED else None
+
+
+class _Request:
+    __slots__ = ("date_idx", "features", "prices", "future", "submitted_at",
+                 "deadline")
+
+    def __init__(self, date_idx: int, features, prices, future: SlimFuture,
+                 submitted_at: float, deadline: float | None):
+        self.date_idx = date_idx
+        self.features = features      # (rows, n_features)
+        self.prices = prices          # (rows, k) or None
+        self.future = future
+        self.submitted_at = submitted_at
+        self.deadline = deadline      # absolute perf_counter instant; None = never
+
 
 @dataclasses.dataclass
-class _Request:
-    date_idx: int
-    features: np.ndarray          # (rows, n_features)
-    prices: np.ndarray | None     # (rows, k) or None
-    future: Future
-    submitted_at: float
-    deadline: float | None = None  # absolute perf_counter instant; None = never
+class _Group:
+    """One executable-sharing slice of an admitted batch: the requests whose
+    concatenated rows ride one engine dispatch, plus that dispatch's outcome
+    (a ``PendingEval``-shaped handle, or the exception that must be
+    delivered to every future in the group at resolve time). The
+    concatenated inputs are kept until resolution so a transient failure
+    that only surfaces at BLOCK time can be re-dispatched under the same
+    retry policy a dispatch-time failure gets."""
+
+    reqs: list
+    has_prices: bool
+    rows: int
+    date_idx: int = 0
+    feats: object = None
+    prices: object = None
+    pending: object = None        # engine handle with .result()
+    error: Exception | None = None
 
 
 def _shed_order(req: _Request) -> tuple:
@@ -74,12 +214,15 @@ def _shed_order(req: _Request) -> tuple:
 
 
 class MicroBatcher:
-    """Queue + worker thread in front of a ``HedgeEngine``.
+    """Async continuous-batching front of a ``HedgeEngine``.
 
     ``max_batch`` caps coalesced rows per dispatch; ``max_wait_us`` caps how
-    long the first request of a batch waits for company. Small waits trade
-    single-request latency for device throughput — at 200µs a burst of
-    single-row requests rides one executable instead of hundreds.
+    long the first request of a batch waits for company WHEN THE DEVICE IS
+    IDLE — once a batch is in flight, its execution time is the coalescing
+    window (requests arriving meanwhile ride the next dispatch for free).
+    ``max_inflight`` bounds how many dispatched batches may be queued on
+    the device at once (2 = classic double buffering: one executing, one
+    queued, the host free to admit a third).
 
     ``policy`` (optional :class:`~orp_tpu.guard.GuardPolicy`) switches on
     deadlines, watermark shedding and transient-dispatch retries — see the
@@ -91,12 +234,26 @@ class MicroBatcher:
     def __init__(self, engine, *, max_batch: int = 1024,
                  max_wait_us: float = 200.0,
                  metrics: ServingMetrics | None = None,
-                 policy: GuardPolicy | None = None):
+                 policy: GuardPolicy | None = None,
+                 max_inflight: int = 2,
+                 min_fill: int | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight={max_inflight} must be >= 1")
         self.engine = engine
         self.max_batch = int(max_batch)
         self.max_wait_us = float(max_wait_us)
+        self.max_inflight = int(max_inflight)
+        # busy-device admission threshold: while a batch is in flight,
+        # don't dispatch another until this many requests are pending —
+        # resolving the in-flight batch first lets arrivals accumulate into
+        # a fuller bucket (each dispatch has a fixed launch cost; under
+        # sustained load eager tiny batches burn it per handful of rows).
+        # Never delays an idle device: with nothing in flight the
+        # max_wait_us window is the only batching wait.
+        self.min_fill = (max(1, self.max_batch // 8) if min_fill is None
+                         else int(min_fill))
         self.metrics = metrics
         self.policy = policy
         # one condition guards the deque + closed flag: submit needs to shed
@@ -105,6 +262,9 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._pending: collections.deque[_Request] = collections.deque()
         self._closed = False
+        # set at close(): wakes a retry backoff immediately instead of
+        # letting the dispatch loop finish a nap nobody is waiting for
+        self._interrupt = threading.Event()
         self._worker = threading.Thread(
             target=self._run, name="orp-serve-batcher", daemon=True)
         self._worker.start()
@@ -112,8 +272,8 @@ class MicroBatcher:
     # -- client side ---------------------------------------------------------
 
     def submit(self, date_idx: int, states, prices=None, *,
-               deadline_s: float | None = None) -> Future:
-        """Enqueue one request; the Future resolves to ``(phi, psi, value)``
+               deadline_s: float | None = None) -> SlimFuture:
+        """Enqueue one request; the future resolves to ``(phi, psi, value)``
         for exactly these rows (``value`` None when ``prices`` is None) —
         or to a :class:`Rejection` when a guard policy shed it.
 
@@ -125,7 +285,7 @@ class MicroBatcher:
         # reaching it would kill the thread (and every pending future)
         feats = np.atleast_2d(np.asarray(states))
         pr = None if prices is None else np.atleast_2d(np.asarray(prices))
-        fut: Future = Future()
+        fut = SlimFuture()
         now = time.perf_counter()
         budget = deadline_s
         if budget is None and self.policy is not None:
@@ -147,7 +307,11 @@ class MicroBatcher:
                 victim = min(self._pending, key=_shed_order)
                 self._pending.remove(victim)
                 shed.append(victim)
-            self._cv.notify()
+            if len(self._pending) == 1:
+                # notify only on the empty->nonempty edge: a worker in its
+                # coalescing window picks up company at the window end
+                # anyway, and per-submit notifies are measurable at 10^5/s
+                self._cv.notify()
         for victim in shed:
             # resolved OUTSIDE the lock: set_result runs the future's
             # done-callbacks synchronously, and a callback that re-enters
@@ -166,6 +330,7 @@ class MicroBatcher:
             if self._closed:
                 return
             self._closed = True
+            self._interrupt.set()
             self._cv.notify_all()
         self._worker.join(timeout)
 
@@ -189,104 +354,206 @@ class MicroBatcher:
                 deadline_s=(None if req.deadline is None
                             else req.deadline - req.submitted_at)))
 
-    # -- worker side ---------------------------------------------------------
+    # -- dispatch loop -------------------------------------------------------
+    #
+    # admit -> dispatch -> (overlap) -> resolve. The loop never blocks on a
+    # device result while there is admission or dispatch work to do, and it
+    # never resolves futures under the Condition. ORP010 lints the admit/
+    # dispatch stages for blocking calls; _resolve is the one stage whose
+    # JOB is to block.
 
     def _run(self) -> None:
+        inflight: collections.deque[list[_Group]] = collections.deque()
         while True:
-            batch: list[_Request] = []
-            expired: list[_Request] = []
-            with self._cv:
-                while not self._pending and not self._closed:
-                    self._cv.wait()
-                if not self._pending:
-                    return  # closed and drained
-                rows = 0
-                window_end = None  # opens at the first LIVE request
-                while rows < self.max_batch:
-                    if self._pending:
-                        req = self._pending.popleft()
-                        now = time.perf_counter()
-                        if req.deadline is not None and now > req.deadline:
-                            # expired while queued: never burn a device
-                            # dispatch on an answer nobody is waiting for
-                            expired.append(req)
-                            continue
-                        obs_observe("serve/queue_age_seconds",
-                                    now - req.submitted_at, outcome="served")
-                        batch.append(req)
-                        rows += req.features.shape[0]
-                        if window_end is None:
-                            window_end = now + self.max_wait_us * 1e-6
-                        continue
-                    if not batch:
-                        break  # everything popped had expired
-                    remaining = window_end - time.perf_counter()
-                    if self._closed or remaining <= 0:
-                        break
-                    self._cv.wait(timeout=remaining)
+            # only block waiting for work when the device has none either —
+            # with a batch in flight its execution is the natural window
+            batch, expired, closed = self._admit(block=not inflight)
             for req in expired:
                 # outside the lock: resolving a future runs its
                 # done-callbacks synchronously (see submit's shed note)
                 self._shed(req, "deadline")
             if batch:
-                self._dispatch(batch)
+                inflight.append(self._dispatch(batch))
+            if inflight and (not batch or len(inflight) >= self.max_inflight):
+                # oldest batch first: FIFO resolution preserves the
+                # submission-order contract per request
+                self._resolve(inflight.popleft())
+                continue
+            if closed and not batch and not inflight:
+                return
 
-    def _dispatch_engine(self, date_idx: int, feats, pr):
-        """One engine dispatch, with the policy's bounded retry-with-backoff
-        for transient failures (a deterministic error propagates on attempt
-        one — retrying it only repeats it with latency)."""
-        pol = self.policy
-        attempts = 1 + (pol.max_retries if pol is not None else 0)
-        for attempt in range(1, attempts + 1):
-            try:
-                return self.engine.evaluate(date_idx, feats, pr)
-            except TransientDispatchError:
-                if attempt >= attempts:
-                    raise
-                obs_count("guard/retry", site="serve/dispatch",
-                          attempt=str(attempt))
-                # the worker sleeps through the backoff, so it is bounded
-                # and small by policy (backoff_cap_ms)
-                time.sleep(pol.backoff_s(attempt))
+    def _admit(self, block: bool):
+        """Drain pending requests into the largest batch that fits
+        (``max_batch`` rows): returns ``(batch, expired, closed)``. With
+        ``block=True`` waits for the first live request and then holds the
+        ``max_wait_us`` coalescing window open for company; with
+        ``block=False`` (a batch is already executing) takes whatever is
+        there RIGHT NOW and returns — continuous batching's admission
+        rule."""
+        batch: list[_Request] = []
+        expired: list[_Request] = []
+        with self._cv:
+            if block:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+            elif len(self._pending) < self.min_fill and not self._closed:
+                # device busy + thin queue: let the resolve of the
+                # in-flight batch be the wait that fills this one
+                return batch, expired, False
+            rows = 0
+            window_end = None  # opens at the first LIVE request
+            while rows < self.max_batch:
+                if self._pending:
+                    req = self._pending.popleft()
+                    now = time.perf_counter()
+                    if req.deadline is not None and now > req.deadline:
+                        # expired while queued: never burn a device
+                        # dispatch on an answer nobody is waiting for
+                        expired.append(req)
+                        continue
+                    obs_observe("serve/queue_age_seconds",
+                                now - req.submitted_at, outcome="served")
+                    batch.append(req)
+                    rows += req.features.shape[0]
+                    if window_end is None:
+                        window_end = now + self.max_wait_us * 1e-6
+                    continue
+                if not batch or not block:
+                    break
+                remaining = window_end - time.perf_counter()
+                if self._closed or remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            return batch, expired, self._closed
 
-    def _dispatch(self, batch: list[_Request]) -> None:
-        # group rows that can share one executable dispatch: same date, same
-        # feature width and same prices shape-presence. Width in the key
-        # means a malformed request (wrong feature count) fails on ITS OWN
-        # future with the engine's error instead of poisoning the concat of
-        # an entire well-formed batch.
+    def _dispatch(self, batch: list[_Request]) -> list[_Group]:
+        """Group the admitted batch by executable compatibility and submit
+        each group to the device WITHOUT blocking. Returns the in-flight
+        groups; exceptions are captured per group and delivered at resolve
+        time (outside any lock, never poisoning unrelated groups).
+
+        Grouping key: same date, same feature width and same prices
+        shape-presence. Width in the key means a malformed request (wrong
+        feature count) fails on ITS OWN future with the engine's error
+        instead of poisoning the concat of an entire well-formed batch."""
         groups: dict[tuple, list[_Request]] = {}
         for req in batch:
             key = (req.date_idx, req.features.shape[1],
                    None if req.prices is None else req.prices.shape[1])
             groups.setdefault(key, []).append(req)
+        out: list[_Group] = []
         for (date_idx, _, pwidth), reqs in groups.items():
             has_prices = pwidth is not None
+            g = _Group(reqs=reqs, has_prices=has_prices,
+                       rows=sum(r.features.shape[0] for r in reqs),
+                       date_idx=date_idx)
+            out.append(g)
             try:
-                feats = np.concatenate([r.features for r in reqs], axis=0)
-                pr = (np.concatenate([r.prices for r in reqs], axis=0)
-                      if has_prices else None)
-                obs_count("serve/batcher_dispatches")
-                obs_count("serve/batcher_coalesced_requests", len(reqs))
-                with span("serve/batch", attrs={"requests": len(reqs),
-                                                "rows": int(feats.shape[0])}):
-                    # no set_result: evaluate() blocks device-side internally,
-                    # so the span is already device-complete
-                    phi, psi, value = self._dispatch_engine(date_idx, feats, pr)
+                g.feats = np.concatenate([r.features for r in reqs], axis=0)
+                g.prices = (np.concatenate([r.prices for r in reqs], axis=0)
+                            if has_prices else None)
+                g.pending = self._dispatch_engine(g.date_idx, g.feats,
+                                                  g.prices)
+            except Exception as e:  # orp: noqa[ORP009] -- delivered to every future in the group by _resolve
+                g.error = e
+                continue
+            # counters record AFTER the dispatch succeeds: a group whose
+            # retries exhaust must not inflate the device-traffic telemetry
+            obs_count("serve/batcher_dispatches")
+            obs_count("serve/batcher_coalesced_requests", len(reqs))
+            if self.metrics is not None:
+                cap = (self.engine.bucket_for(g.rows)
+                       if hasattr(self.engine, "bucket_for") else
+                       self.max_batch)
+                self.metrics.record_dispatch(len(reqs), g.rows, cap)
+        return out
+
+    def _dispatch_engine(self, date_idx: int, feats, pr):
+        """One non-blocking engine dispatch, with the policy's bounded
+        retry-with-backoff for transient failures (a deterministic error
+        propagates on attempt one — retrying it only repeats it with
+        latency). The backoff waits on the close-interrupt Event, not
+        ``time.sleep``: bounded, small by policy, and breakable."""
+        submit = getattr(self.engine, "evaluate_async", None)
+        if submit is None:
+            # a plain-evaluate engine still works behind the batcher: its
+            # blocking result is wrapped to look already-resolved
+            submit = lambda d, f, p: _Resolved(self.engine.evaluate(d, f, p))
+        pol = self.policy
+        attempts = 1 + (pol.max_retries if pol is not None else 0)
+        for attempt in range(1, attempts + 1):
+            try:
+                return submit(date_idx, feats, pr)
+            except TransientDispatchError:
+                if attempt >= attempts:
+                    raise
+                obs_count("guard/retry", site="serve/dispatch",
+                          attempt=str(attempt))
+                self._interrupt.wait(pol.backoff_s(attempt))
+
+    def _blocked_result(self, g: _Group):
+        """Block on ``g``'s dispatched evaluation. A transient failure that
+        only SURFACES here (XLA's async runtime raises at block time, not
+        submission) gets the same bounded retry policy a dispatch-time
+        failure got: the whole group re-dispatches through
+        ``_dispatch_engine`` (whose own retry loop then applies). Without a
+        retrying policy the error propagates as before — retrying is the
+        operator's call, never a silent default."""
+        try:
+            return g.pending.result()
+        except TransientDispatchError:
+            pol = self.policy
+            if pol is None or pol.max_retries < 1:
+                raise
+            obs_count("guard/retry", site="serve/block", attempt="1")
+            self._interrupt.wait(pol.backoff_s(1))
+            return self._dispatch_engine(g.date_idx, g.feats,
+                                         g.prices).result()
+
+    def _resolve(self, groups: list[_Group]) -> None:
+        """Block on the oldest in-flight batch and resolve every future in
+        bulk — strictly outside the Condition (done-callbacks run
+        synchronously and may re-enter the batcher)."""
+        for g in groups:
+            if g.error is not None:
+                for r in g.reqs:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(g.error)
+                continue
+            try:
+                with span("serve/batch", attrs={"requests": len(g.reqs),
+                                                "rows": g.rows}) as sp:
+                    # result() blocks device-side internally, so the span
+                    # is already device-complete
+                    phi, psi, value = self._blocked_result(g)
             except Exception as e:  # noqa: BLE001 — delivered per-future
-                for r in reqs:
-                    if not r.future.set_running_or_notify_cancel():
-                        continue
-                    r.future.set_exception(e)
+                for r in g.reqs:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(e)
                 continue
             done = time.perf_counter()
             off = 0
-            for r in reqs:
+            served = []
+            for r in g.reqs:
                 n = r.features.shape[0]
                 sl = (phi[off:off + n], psi[off:off + n],
-                      value[off:off + n] if has_prices else None)
+                      value[off:off + n] if g.has_prices else None)
                 off += n
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_result(sl)
-                if self.metrics is not None:
-                    self.metrics.record(done - r.submitted_at, n)
+                served.append((done - r.submitted_at, n))
+            if self.metrics is not None:
+                self.metrics.record_many(served)
+
+
+class _Resolved:
+    """Adapter: a blocking engine's already-materialized result wearing the
+    ``PendingEval`` interface, so the dispatch loop has one resolve path."""
+
+    __slots__ = ("_out",)
+
+    def __init__(self, out):
+        self._out = out
+
+    def result(self):
+        return self._out

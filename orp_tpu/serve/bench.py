@@ -1,15 +1,24 @@
 """serve-bench: measure the serving path, emit a ``BENCH_serve.json`` record.
 
-Two phases over one loaded policy:
+Three phases over one loaded policy:
 
 1. **engine** — direct ``HedgeEngine.evaluate`` calls cycling a mixed
    batch-size schedule (default 1/7/64/1000 — the acceptance shapes) across
    all rebalance dates. Warmup pre-touches every bucket once, so the
    recorded window is compile-free; the cache counters then prove at most
    one compile per bucket.
-2. **batcher** — a burst of single-row submissions through ``MicroBatcher``,
-   the dispatch-amortisation story: many tiny synchronous requests, few
-   device batches.
+2. **batcher** — a burst of single-row submissions through the continuous
+   batcher, the dispatch-amortisation story: many tiny requests, few device
+   batches (``batcher_dispatches`` / ``batcher_dispatches_per_request`` /
+   ``batcher_batch_occupancy`` make the amortisation a first-class number —
+   the old synchronous tier's "26 dispatches for 256 requests" pathology is
+   now measured, not archaeologically inferred).
+3. **sweep** — sustained closed-traffic concurrency sweep: C submitter
+   threads each stream single-row requests through one batcher while the
+   dispatch loop double-buffers the device. The best sustained rate is the
+   headline the ROADMAP 10-100x target is judged on; the previous record's
+   synchronous-batcher numbers are carried forward under ``batcher_before``
+   so the record holds its own before/after.
 
 The record is one flat JSON object in the ``BENCH_r*.json`` style (a
 ``metric``/``value``/``unit`` headline plus namespaced detail keys), written
@@ -21,6 +30,7 @@ from __future__ import annotations
 
 import json
 import pathlib
+import threading
 import time
 
 import numpy as np
@@ -31,12 +41,15 @@ from orp_tpu.serve.engine import HedgeEngine
 from orp_tpu.serve.metrics import ServingMetrics
 
 DEFAULT_BATCH_SIZES = (1, 7, 64, 1000)
+# low levels on purpose: submitters are pure-Python threads, and past ~4 of
+# them GIL churn starves the dispatch loop instead of feeding it
+DEFAULT_SWEEP_CONCURRENCY = (1, 2, 4)
 
 
 def _phase_metrics(phase: str) -> ServingMetrics:
     """A recorder for one bench phase. Under an active telemetry session the
     instruments intern into the session registry (label ``phase=...`` keeps
-    the two phases' series apart), so ``metrics.prom`` carries the serving
+    the phases' series apart), so ``metrics.prom`` carries the serving
     percentiles; otherwise each phase gets its own private registry exactly
     as before."""
     st = obs.state()
@@ -63,6 +76,72 @@ def _request_stream(rng, n_requests, batch_sizes, n_dates, n_features):
         yield date_idx, feats.astype(np.float32)
 
 
+def _sweep_level(engine, *, concurrency: int, n_requests: int,
+                 max_batch: int, max_wait_us: float, seed: int,
+                 window: int | None = None) -> dict:
+    """One sweep point: ``concurrency`` threads each stream their share of
+    ``n_requests`` single-row requests through ONE continuous batcher,
+    timed submit-to-all-resolved. Open-loop by default (every request
+    submitted as fast as Python allows — max sustained throughput; the
+    reported percentiles then include the drain of the level's own
+    backlog, so size ``n_requests`` to the queue depth whose tail you want
+    to know about). ``window`` bounds each thread's in-flight requests for
+    a flow-controlled client shape instead (lower latency, smaller
+    batches). Features are pre-generated so the measured window is pure
+    serving."""
+    nf = engine.model.n_features
+    rng = np.random.default_rng(seed)
+    per = n_requests // concurrency
+    feats = [
+        [(1.0 + 0.1 * rng.standard_normal((1, nf))).astype(np.float32)
+         for _ in range(per)]
+        for _ in range(concurrency)
+    ]
+    metrics = _phase_metrics(f"sweep_c{concurrency}")
+    errors: list[Exception] = []
+
+    def stream(mb, tid):
+        try:
+            inflight = []
+            for i, f in enumerate(feats[tid]):
+                inflight.append(mb.submit((tid + i) % engine.n_dates, f))
+                if window is not None and len(inflight) >= window:
+                    inflight.pop(0).result(timeout=120)
+            for f in inflight:
+                f.result(timeout=120)
+        except Exception as e:  # orp: noqa[ORP009] -- re-raised on the bench thread after join
+            errors.append(e)
+
+    with MicroBatcher(engine, max_batch=max_batch,
+                      max_wait_us=max_wait_us, metrics=metrics) as mb:
+        threads = [threading.Thread(target=stream, args=(mb, t), daemon=True)
+                   for t in range(concurrency)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    s = metrics.summary()
+    return {
+        "concurrency": concurrency,
+        "requests": concurrency * per,
+        # sustained rate over the SERVING window (first submit -> last
+        # resolve, the engine phase's own convention); wall_s additionally
+        # includes thread spawn/join for the end-to-end picture
+        "requests_per_s": s["requests_per_s"],
+        "wall_s": round(wall, 4),
+        "p50_ms": s["p50_ms"],
+        "p99_ms": s["p99_ms"],
+        "rows_per_s": s["rows_per_s"],
+        "dispatches": s["dispatches"],
+        "dispatches_per_request": s["dispatches_per_request"],
+        "batch_occupancy": s["batch_occupancy"],
+    }
+
+
 def serve_bench(
     policy,
     *,
@@ -72,24 +151,34 @@ def serve_bench(
     max_wait_us: float = 500.0,
     seed: int = 0,
     prewarm: bool = False,
+    sweep_concurrency: tuple[int, ...] = DEFAULT_SWEEP_CONCURRENCY,
+    sweep_requests: int = 2048,
+    sweep_max_batch: int = 1024,
+    previous: dict | None = None,
 ) -> dict:
-    """Run both phases against ``policy`` (a ``PolicyBundle`` or a trained
-    ``PipelineResult``) and return the bench record.
+    """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
+    trained ``PipelineResult``) and return the bench record.
 
     ``prewarm=True`` (CLI ``--prewarm``) additionally ASSERTS the warmup
     contract — ``cache_misses_after_warmup == 0`` — so a CI run fails loudly
-    if any measured request paid a first-touch compile."""
+    if any measured request paid a first-touch compile.
+
+    ``sweep_concurrency=()`` skips the sweep (quick smoke runs).
+    ``previous`` (the last record, CLI-loaded from ``--out``) carries the
+    synchronous-tier baseline forward as ``batcher_before``."""
     engine = HedgeEngine(policy)
     n_features = engine.model.n_features
     rng = np.random.default_rng(seed)
 
     # warmup: one evaluation per REACHABLE bucket — not just the schedule's
-    # own sizes but every power-of-two up to the batcher's max coalesced
-    # batch, because the batcher phase dispatches timing-dependent sizes and
-    # a first-touch compile inside the measured window would dominate p99
+    # own sizes but every power-of-two up to the largest coalesced batch
+    # (burst or sweep), because the batcher dispatches timing-dependent
+    # sizes and a first-touch compile inside the measured window would
+    # dominate p99
     sizes = []
     b = engine.min_bucket
-    top = engine.bucket_for(max(batch_sizes))
+    top = engine.bucket_for(max(*batch_sizes,
+                                sweep_max_batch if sweep_concurrency else 1))
     while b <= top:
         sizes.append(b)
         b *= 2
@@ -106,7 +195,9 @@ def serve_bench(
     cache = engine.cache_info()
     served = cache["hits"] + cache["misses"]
 
-    # batcher phase: a burst of single-row requests, coalesced
+    # batcher phase: a burst of single-row requests, coalesced by the
+    # continuous dispatch loop (the legacy comparison shape: same burst the
+    # synchronous tier measured)
     bmetrics = _phase_metrics("batcher")
     with MicroBatcher(engine, max_batch=max(batch_sizes),
                       max_wait_us=max_wait_us, metrics=bmetrics) as mb:
@@ -116,9 +207,17 @@ def serve_bench(
             for i in range(batcher_requests)
         ]
         for f in futures:
-            f.result()
+            f.result(timeout=120)
     batcher_summary = bmetrics.summary()
-    dispatches = engine.cache_info()["hits"] + engine.cache_info()["misses"] - served
+
+    # sweep phase: sustained concurrent traffic, the 10-100x headline
+    sweep = [
+        _sweep_level(engine, concurrency=c, n_requests=sweep_requests,
+                     max_batch=sweep_max_batch, max_wait_us=max_wait_us,
+                     seed=seed + c)
+        for c in sweep_concurrency
+    ]
+    best = max(sweep, key=lambda r: r["requests_per_s"]) if sweep else None
 
     record = {
         "metric": "serve_requests_per_sec",
@@ -141,10 +240,39 @@ def serve_bench(
         "xla_compiles": cache["xla_compiles"],
         "prewarm": prewarm,
         "batcher_requests": batcher_requests,
-        "batcher_dispatches": dispatches,
+        "batcher_dispatches": batcher_summary["dispatches"],
+        "batcher_dispatches_per_request":
+            batcher_summary["dispatches_per_request"],
+        "batcher_batch_occupancy": batcher_summary["batch_occupancy"],
         "batcher_requests_per_s": batcher_summary["requests_per_s"],
+        "batcher_p50_ms": batcher_summary["p50_ms"],
         "batcher_p99_ms": batcher_summary["p99_ms"],
     }
+    if sweep:
+        record["sweep"] = sweep
+        record["batcher_sustained_requests_per_s"] = best["requests_per_s"]
+        record["batcher_sustained_p99_ms"] = best["p99_ms"]
+        record["batcher_sustained_concurrency"] = best["concurrency"]
+    if previous is not None:
+        # before/after: the synchronous tier's own measured numbers, sticky
+        # across re-runs (a record that already carries a before keeps it).
+        # Only a record WITHOUT a sweep can be the sync tier — an async
+        # record mistaken for the before would "compare" async vs async
+        before = previous.get("batcher_before")
+        if before is None and "sweep" not in previous:
+            before = {
+                k: previous[k]
+                for k in ("batcher_requests_per_s", "batcher_p50_ms",
+                          "batcher_p99_ms", "batcher_dispatches",
+                          "batcher_requests")
+                if k in previous
+            }
+        if before:
+            record["batcher_before"] = before
+            prev_rps = before.get("batcher_requests_per_s")
+            if prev_rps and sweep:
+                record["batcher_speedup_vs_sync"] = round(
+                    best["requests_per_s"] / prev_rps, 2)
     import jax
 
     record["platform"] = jax.devices()[0].platform

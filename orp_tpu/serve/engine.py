@@ -80,6 +80,41 @@ def next_bucket(n: int, *, min_bucket: int = 8) -> int:
     return max(min_bucket, 1 << (n - 1).bit_length())
 
 
+class PendingEval:
+    """An in-flight bucket evaluation: the executable has been DISPATCHED
+    (XLA's async runtime owns it now) but nobody has blocked on the result.
+
+    This is the unit the continuous batcher overlaps: while one pending
+    evaluation executes on device, the dispatch loop admits and pads the
+    next one. ``result()`` blocks device-side, unpads, and returns the
+    ``(phi, psi, value)`` host arrays — bitwise what a blocking
+    ``HedgeEngine.evaluate`` of the same rows returns, because it IS the
+    same dispatch, split at the block point.
+    """
+
+    __slots__ = ("_phi", "_psi", "_v", "_n", "_has_prices", "bucket")
+
+    def __init__(self, phi, psi, v, n: int, has_prices: bool, bucket: int):
+        self._phi = phi
+        self._psi = psi
+        self._v = v
+        self._n = int(n)
+        self._has_prices = has_prices
+        self.bucket = int(bucket)
+
+    def result(self):
+        """Block until the device finishes, then slice the padding off:
+        ``(phi, psi, value)`` host numpy arrays of the requested rows
+        (``value`` None when the request carried no prices)."""
+        n = self._n
+        phi, psi, v = jax.block_until_ready((self._phi, self._psi, self._v))
+        with span("serve/unpad"):
+            phi = np.asarray(phi)[:n]
+            psi = np.asarray(psi)[:n]
+            value = np.asarray(v)[:n] if self._has_prices else None
+        return phi, psi, value
+
+
 class HedgeEngine:
     """Evaluate a hedge policy (a ``PolicyBundle`` or a ``PipelineResult``
     carrying its model) for arbitrary request sizes.
@@ -219,6 +254,20 @@ class HedgeEngine:
         them ``value`` is returned as None (phi/psi need no prices).
         ``date_idx``: rebalance-date index ``0..n_dates-1``; negative
         indices count from the end like numpy.
+
+        Blocking convenience over :meth:`evaluate_async` — same dispatch,
+        same bits; a served result IS the deliverable, so the caller's
+        clock stops only after the device finishes.
+        """
+        return self.evaluate_async(date_idx, states, prices).result()
+
+    def evaluate_async(self, date_idx: int, states, prices=None) -> PendingEval:
+        """Validate, pad and DISPATCH the batch without blocking on the
+        device: returns a :class:`PendingEval` whose ``result()`` does the
+        block + unpad. This is the continuous batcher's overlap point —
+        batch N executes while batch N+1 is admitted and padded. Counters
+        (bucket hits/misses, aot) record here, at successful dispatch, so
+        a retried transient failure never inflates telemetry.
         """
         states = np.asarray(states)
         if states.ndim == 1:
@@ -273,9 +322,6 @@ class HedgeEngine:
                                                  inj)
             else:
                 phi, psi, v = self._jit_eval(idx, feats, pr)
-            # block: a served result IS the deliverable — latency metrics on
-            # dispatch-only timing would be fiction
-            phi, psi, v = jax.block_until_ready((phi, psi, v))
         if bucket_kind == "hit":
             self.hits += 1
             # per-request counters are registry-only (sink_event=False): a
@@ -295,11 +341,7 @@ class HedgeEngine:
             self._buckets.add(b)
             obs_count("serve/bucket_misses", bucket=str(b))
         obs_count("serve/rows", n, sink_event=False)
-        with span("serve/unpad"):
-            phi = np.asarray(phi)[:n]
-            psi = np.asarray(psi)[:n]
-            value = np.asarray(v)[:n] if has_prices else None
-        return phi, psi, value
+        return PendingEval(phi, psi, v, n, has_prices, b)
 
     def _jit_eval(self, idx: int, feats, pr):
         """The always-correct jit path: one bucket-shaped ``_eval_core``

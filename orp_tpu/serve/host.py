@@ -1,0 +1,390 @@
+"""Multi-tenant serving host: many policy bundles, one process, one budget.
+
+A production serve fleet does not run one process per policy — it packs
+many small policies (per desk, per product, per cohort) into each process
+and shares the device between them. This module is that packing layer on
+top of the continuous batcher:
+
+- **tenants** — each a policy (bundle directory or in-memory
+  ``PolicyBundle``/``PipelineResult``) served by its own
+  :class:`~orp_tpu.serve.batcher.MicroBatcher` + ``HedgeEngine``, with its
+  own optional :class:`~orp_tpu.guard.GuardPolicy` (deadlines, watermark,
+  retries keep their exact single-tenant semantics — the host never
+  reaches into a tenant's batcher).
+- **LRU engine cap** — at most ``max_live_engines`` tenants keep a live
+  engine (and its deserialized AOT bucket executables, the real memory
+  cost: one PJRT executable per bucket per tenant). Submitting to a cold
+  tenant activates it and, over the cap, evicts the least-recently-used
+  one: its batcher drains (guard sheds still apply during the drain), its
+  engine — executables included — is dropped, and the next submit rebuilds
+  it from the retained source (``serve/tenant_evict`` counts evictions;
+  an AOT bundle re-activates with zero XLA compiles, which is what makes
+  the LRU cheap enough to be a cap rather than a crash).
+- **quotas / backpressure** — ``max_pending`` per tenant bounds its
+  in-flight requests; past it, submits are shed immediately with a
+  structured :class:`~orp_tpu.guard.Rejection` ``reason="quota"`` through
+  the future (``guard/shed{reason="quota", tenant=...}``) — one tenant's
+  burst cannot starve another's batcher, and the response shape is the
+  same one the deadline/watermark sheds already taught clients to handle.
+- **SLO burn rate** — per-tenant served-latency objectives evaluated
+  straight off the obs registry histograms the metrics façade already
+  publishes (``serve_request_latency_seconds{tenant=...}``):
+  ``burn_rate = violation_fraction / error_budget``, the standard
+  error-budget consumption ratio (>1 means the budget is burning faster
+  than it accrues; alert). No second bookkeeping path — the Dapper spine
+  (PR 4) records, the host reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from orp_tpu.guard.serve import GuardPolicy, Rejection
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import state as obs_state
+from orp_tpu.obs.registry import Registry
+from orp_tpu.serve.batcher import MicroBatcher, SlimFuture
+from orp_tpu.serve.engine import HedgeEngine
+from orp_tpu.serve.metrics import LATENCY_HISTOGRAM, ServingMetrics
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """A served-latency objective with an error budget.
+
+    ``latency_slo_ms`` — the per-request latency objective (submit to
+    resolved, device-complete — the ``ServingMetrics`` clock).
+    ``error_budget``  — the tolerated fraction of requests over the
+    objective (SRE convention: 0.01 = 99% of requests in SLO).
+    """
+
+    latency_slo_ms: float
+    error_budget: float = 0.01
+
+    def __post_init__(self):
+        if self.latency_slo_ms <= 0:
+            raise ValueError(
+                f"latency_slo_ms={self.latency_slo_ms} must be > 0")
+        if not 0.0 < self.error_budget <= 1.0:
+            raise ValueError(
+                f"error_budget={self.error_budget} must be in (0, 1]")
+
+
+def burn_rate(histogram, slo: SloPolicy) -> float:
+    """Error-budget consumption ratio of a latency histogram (seconds)
+    against ``slo``: observed violation fraction / budget. 1.0 = burning
+    exactly at budget; > 1 = the objective will be missed over the window."""
+    return histogram.fraction_over(slo.latency_slo_ms / 1e3) / slo.error_budget
+
+
+class _Tenant:
+    """One hosted policy: retained source + (while live) engine/batcher."""
+
+    __slots__ = ("name", "source", "policy", "max_pending", "slo",
+                 "engine", "batcher", "metrics", "pending", "activations",
+                 "last_used", "build_lock", "in_submit")
+
+    def __init__(self, name, source, policy, max_pending, slo):
+        self.name = name
+        self.source = source          # bundle dir (str/Path) or policy object
+        self.policy = policy
+        self.max_pending = max_pending
+        self.slo = slo
+        self.engine = None
+        self.batcher = None
+        self.metrics = None
+        self.pending = 0              # futures submitted and not yet resolved
+        self.activations = 0
+        self.last_used = 0.0
+        self.in_submit = 0            # submits between claim and enqueue —
+        # eviction never unlinks a tenant mid-submit (host-lock guarded)
+        # serializes THIS tenant's engine build without the host lock: a
+        # cold start (bundle load + engine construction + possible jit
+        # compiles) must never head-of-line-block other tenants' submits
+        self.build_lock = threading.Lock()
+
+
+class ServeHost:
+    """Serve many policies from one process under an engine-memory cap.
+
+    ``max_live_engines`` — LRU cap on simultaneously-live engines (each
+    holds its policy's device params and deserialized AOT executables).
+    ``registry``         — metrics registry the per-tenant ``ServingMetrics``
+    façades intern into (labelled ``tenant=<name>``); defaults to the
+    active obs session's registry, else a private one. ``slo_report``
+    reads the same histograms back — one spine, no side bookkeeping.
+    ``engine_kwargs`` / ``batcher_kwargs`` apply to every tenant's engine /
+    batcher (per-tenant overrides via ``add_tenant``).
+    """
+
+    def __init__(self, *, max_live_engines: int = 4,
+                 registry: Registry | None = None,
+                 engine_kwargs: dict | None = None,
+                 batcher_kwargs: dict | None = None):
+        if max_live_engines < 1:
+            raise ValueError(
+                f"max_live_engines={max_live_engines} must be >= 1")
+        self.max_live_engines = int(max_live_engines)
+        st = obs_state()
+        self.registry = (registry if registry is not None
+                         else st.registry if st is not None else Registry())
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.batcher_kwargs = dict(batcher_kwargs or {})
+        self._lock = threading.RLock()
+        # pending counts live under their OWN lock: future done-callbacks
+        # fire on the batcher worker thread, and an eviction drains that
+        # worker while holding the host lock — a callback that needed the
+        # host lock would stall the very drain waiting on it
+        self._pending_lock = threading.Lock()
+        self._tenants: dict[str, _Tenant] = {}
+        self._closed = False
+
+    # -- tenant lifecycle ----------------------------------------------------
+
+    def add_tenant(self, name: str, source, *,
+                   policy: GuardPolicy | None = None,
+                   max_pending: int | None = None,
+                   slo: SloPolicy | None = None) -> None:
+        """Register a tenant. ``source`` is a bundle directory (loaded
+        lazily on first use, reloaded after an eviction) or an in-memory
+        policy (``PolicyBundle`` / trained ``PipelineResult`` — retained,
+        only the engine is rebuilt). Registration is cheap: no engine is
+        built until the first submit."""
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(f"max_pending={max_pending} must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ServeHost is closed")
+            if name in self._tenants:
+                raise ValueError(f"tenant {name!r} already registered")
+            self._tenants[name] = _Tenant(name, source, policy, max_pending,
+                                          slo)
+
+    def _activate(self, name: str):
+        """Touch ``name`` in the LRU, building its engine/batcher if cold.
+        Returns ``(tenant, batcher, evicted_batchers)``. Called WITHOUT the
+        host lock held: the build (bundle load + engine construction +
+        possible jit compiles — seconds on a cold jit bundle) runs under
+        the tenant's OWN lock so other tenants' submits never queue behind
+        one tenant's cold start. Over-cap victims are UNLINKED under the
+        host lock but their batchers are returned for the caller to drain
+        outside every lock (a drain runs client done-callbacks, and a
+        callback may re-enter the host)."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                raise KeyError(f"unknown tenant {name!r}; registered: "
+                               f"{sorted(self._tenants)}")
+            t.last_used = time.perf_counter()
+            if t.batcher is not None:
+                # sweep HERE too, not only after a build: a build-time
+                # sweep that found every candidate mid-submit would
+                # otherwise leave the cap exceeded forever
+                return t, t.batcher, self._sweep_locked(t)
+        evicted = []
+        with t.build_lock:
+            with self._lock:
+                batcher = t.batcher
+            if batcher is None:
+                source = t.source
+                if (isinstance(source, (str, bytes))
+                        or hasattr(source, "__fspath__")):
+                    from orp_tpu.serve.bundle import load_bundle
+
+                    source = load_bundle(source)
+                engine = HedgeEngine(source, **self.engine_kwargs)
+                metrics = ServingMetrics(registry=self.registry,
+                                         labels={"tenant": t.name})
+                batcher = MicroBatcher(engine, metrics=metrics,
+                                       policy=t.policy, **self.batcher_kwargs)
+                with self._lock:
+                    if self._closed:
+                        # a close() raced the build: never install a live
+                        # worker on a closed host
+                        batcher.close()
+                        raise RuntimeError("ServeHost is closed")
+                    t.engine = engine
+                    t.metrics = metrics
+                    t.batcher = batcher
+                    t.activations += 1
+                    evicted = self._sweep_locked(t)
+                obs_count("serve/tenant_activate", tenant=t.name)
+        return t, batcher, evicted
+
+    def _sweep_locked(self, current: _Tenant) -> list:
+        """Unlink LRU tenants until the live-engine count is back at the
+        cap; returns their batchers for an out-of-lock drain. Caller holds
+        the host lock. Never unlinks ``current`` or a tenant mid-submit
+        (an in-flight claim would enqueue on the closed batcher) — if
+        every candidate is busy the cap is exceeded transiently (a soft
+        cap beats a raced RuntimeError) and the next activation sweeps
+        again."""
+        evicted = []
+        live = [x for x in self._tenants.values() if x.batcher is not None]
+        while len(live) > self.max_live_engines:
+            idle = [x for x in live if x is not current and x.in_submit == 0]
+            if not idle:
+                break
+            victim = min(idle, key=lambda x: x.last_used)
+            evicted.append(self._unlink(victim))
+            live.remove(victim)
+        return evicted
+
+    def _unlink(self, t: _Tenant):
+        """Detach ``t``'s serving state under the host lock (new submits
+        now rebuild) and hand its batcher back for an out-of-lock drain:
+        the queue finishes with guard sheds still applying — a deadline
+        that expires during the drain is still a structured Rejection —
+        then the engine and its deserialized AOT executables are released.
+        The tenant stays registered."""
+        batcher = t.batcher
+        t.batcher = None
+        t.engine = None
+        # t.metrics stays: the façade interns shared-registry series, so a
+        # reactivation accumulates into the same instruments and stats()
+        # keeps reporting what an evicted tenant served
+        obs_count("serve/tenant_evict", tenant=t.name)
+        return batcher
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, tenant: str, date_idx: int, states, prices=None, *,
+               deadline_s: float | None = None):
+        """Route one request to ``tenant``'s batcher; returns its future
+        (``(phi, psi, value)``, or a :class:`Rejection` — the tenant's own
+        guard sheds plus the host's ``reason="quota"``)."""
+        # claim loop: between activation and the claim a concurrent
+        # activation may LRU-evict this tenant (its batcher closes); the
+        # claim (in_submit, under the host lock) is what makes the batcher
+        # un-evictable, so a failed claim just re-activates. Bounded: a
+        # freshly-activated tenant loses the race only to an eviction that
+        # slipped between the two locks.
+        for _ in range(16):
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("ServeHost is closed")
+            t, batcher, evicted = self._activate(tenant)
+            with self._lock:
+                claimed = t.batcher is batcher and batcher is not None
+                if claimed:
+                    t.in_submit += 1
+            for victim in evicted:
+                # drained OUTSIDE every lock: the drain resolves futures,
+                # and a done-callback may re-enter the host (submit-on-
+                # reject) — under a held lock that would deadlock the drain
+                victim.close()
+            if claimed:
+                break
+        else:  # pragma: no cover - needs pathological eviction churn
+            raise RuntimeError(
+                f"tenant {tenant!r}: could not claim a live batcher "
+                "(eviction churn; raise max_live_engines)")
+        try:
+            with self._pending_lock:
+                over = (t.max_pending is not None
+                        and t.pending >= t.max_pending)
+                if not over:
+                    t.pending += 1
+            if over:
+                # over quota: shed NOW, at zero queue age — the point of a
+                # quota is that the request never consumes batcher capacity
+                obs_count("guard/shed", reason="quota", tenant=t.name)
+                fut = SlimFuture()
+                fut.set_result(Rejection(reason="quota", queued_s=0.0,
+                                         deadline_s=deadline_s))
+                return fut
+            try:
+                fut = batcher.submit(date_idx, states, prices,
+                                     deadline_s=deadline_s)
+            except BaseException:
+                self._request_done(t)  # the slot was reserved, never used
+                raise
+            fut.add_done_callback(lambda _f, _t=t: self._request_done(_t))
+            return fut
+        finally:
+            with self._lock:
+                t.in_submit -= 1
+
+    def _request_done(self, t: _Tenant) -> None:
+        with self._pending_lock:
+            t.pending -= 1
+
+    def evaluate(self, tenant: str, date_idx: int, states, prices=None):
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(tenant, date_idx, states, prices).result()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-tenant serving state: live/pending/activations plus the
+        metrics summary of everything served so far."""
+        with self._lock:
+            return {
+                t.name: {
+                    "live": t.engine is not None,
+                    "pending": t.pending,
+                    "activations": t.activations,
+                    "max_pending": t.max_pending,
+                    **({"summary": t.metrics.summary()}
+                       if t.metrics is not None else {}),
+                }
+                for t in self._tenants.values()
+            }
+
+    def slo_report(self, default: SloPolicy | None = None) -> dict:
+        """Per-tenant SLO burn rates off the registry latency histograms
+        (``serve_request_latency_seconds{tenant=...}``). A tenant uses its
+        own ``slo`` from ``add_tenant``, else ``default``; tenants with
+        neither are skipped. ``burning`` flags rates > 1 — the budget is
+        being consumed faster than it accrues."""
+        out = {}
+        with self._lock:
+            tenants = list(self._tenants.values())
+        for t in tenants:
+            slo = t.slo if t.slo is not None else default
+            if slo is None:
+                continue
+            hist = self.registry.histogram(LATENCY_HISTOGRAM,
+                                           {"tenant": t.name})
+            rate = burn_rate(hist, slo)
+            out[t.name] = {
+                "latency_slo_ms": slo.latency_slo_ms,
+                "error_budget": slo.error_budget,
+                "violation_fraction": round(
+                    hist.fraction_over(slo.latency_slo_ms / 1e3), 6),
+                "burn_rate": round(rate, 4),
+                "burning": rate > 1.0,
+                # the same bounded window the fraction is computed over —
+                # NOT the lifetime count (hist.count): the pair must
+                # describe one window or violation estimates built from
+                # them are fiction
+                "window_requests": int(hist.snapshot().size),
+                "lifetime_requests": int(hist.count),
+            }
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain every live tenant's batcher and release all engines."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            batchers = [t.batcher for t in self._tenants.values()
+                        if t.batcher is not None]
+            for t in self._tenants.values():
+                t.batcher = None
+                t.engine = None
+        for b in batchers:
+            # outside the lock: the drain runs client done-callbacks
+            b.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -205,9 +205,13 @@ def test_serve_bench_on_aot_bundle_is_compile_free(aot_bundle):
     """The serving cold-start headline: a fresh engine over an --aot bundle
     runs the whole bench — batcher coalescing included — with ZERO XLA
     compiles."""
+    # the sweep stays inside the fixture's reduced bucket set (the CLI
+    # default --aot-buckets covers the default sweep's 1024-row batches;
+    # this fixture ships only 8..64 for speed)
     rec = serve_bench(load_bundle(aot_bundle), n_requests=12,
                       batch_sizes=(1, 7, 64), batcher_requests=8,
-                      prewarm=True)
+                      prewarm=True, sweep_concurrency=(2,),
+                      sweep_requests=64, sweep_max_batch=64)
     assert rec["xla_compiles"] == 0
     assert rec["aot_buckets"] == list(AOT_BUCKETS)
     assert rec["cache_misses_after_warmup"] == 0

@@ -8,6 +8,27 @@ import pytest
 from orp_tpu import cli
 
 
+def test_train_config_conflicts_map_to_flagspeak():
+    """Config-conflict validation has ONE source of truth
+    (TrainConfig.__post_init__, mirroring BackwardConfig); the CLI catches
+    the ValueError and rephrases config fields as flags instead of
+    duplicating the rule."""
+    from orp_tpu.cli import _train_cfg, build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(["euro", "--fused", "--checkpoint-dir", "ck"])
+    with pytest.raises(SystemExit) as exc:
+        _train_cfg(args, "mse_only")
+    msg = str(exc.value)
+    assert msg.startswith("error: ")
+    assert "--fused" in msg and "--checkpoint-dir/--resume" in msg
+    assert "fused=True" not in msg and "checkpoint_dir" not in msg
+    args = parser.parse_args(["euro", "--fused", "--nan-guard"])
+    with pytest.raises(SystemExit, match="NaN sentinel") as exc:
+        _train_cfg(args, "mse_only")
+    assert "--fused" in str(exc.value)
+
+
 def test_euro_json(capsys):
     cli.main([
         "euro", "--paths", "512", "--steps", "4", "--rebalance-every", "2",

@@ -7,6 +7,7 @@ transient-dispatch retry. The injector is seed-driven and the suite keeps
 every synthetic sleep under 50ms, so the whole file rides in tier-1."""
 
 import pathlib
+import threading
 import time
 
 import jax.numpy as jnp
@@ -16,7 +17,7 @@ import pytest
 from orp_tpu import guard, obs
 from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
 from orp_tpu.guard import (CircuitBreaker, FaultInjector, FaultPlan,
-                           GuardPolicy, is_rejection)
+                           GuardPolicy, TransientDispatchError, is_rejection)
 from orp_tpu.models import HedgeMLP
 from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
 from orp_tpu.serve import HedgeEngine, MicroBatcher, export_bundle, load_bundle
@@ -398,6 +399,51 @@ def test_batcher_retry_recovers_transient_dispatch(trained):
                        {"site": "serve/dispatch", "attempt": "1"}).value == 1
 
 
+def test_batcher_retry_recovers_block_time_transient(trained):
+    """An async runtime can surface a transient at BLOCK time, not
+    submission; the resolve stage re-dispatches the group under the same
+    bounded retry policy and still serves bitwise-correct answers
+    (guard/retry{site=\"serve/block\"})."""
+    engine = HedgeEngine(trained)
+    engine.prewarm([1])
+    feats = _rows(1, trained.model.n_features)
+    ref_phi, _, _ = engine.evaluate(0, feats)
+
+    class FlakyBlockEngine:
+        """Delegates to the real engine; the FIRST pending result raises a
+        TransientDispatchError at block time."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.trips = 1
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def evaluate_async(self, date_idx, states, prices=None):
+            pending = self.inner.evaluate_async(date_idx, states, prices)
+            outer = self
+
+            class _Handle:
+                def result(self):
+                    if outer.trips:
+                        outer.trips -= 1
+                        raise TransientDispatchError("late fault")
+                    return pending.result()
+
+            return _Handle()
+
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with MicroBatcher(FlakyBlockEngine(engine), max_wait_us=200.0,
+                          policy=GuardPolicy(max_retries=1,
+                                             backoff_ms=1.0)) as mb:
+            phi, psi, value = mb.evaluate(0, feats)
+    np.testing.assert_array_equal(phi, ref_phi)
+    assert reg.counter("guard/retry",
+                       {"site": "serve/block", "attempt": "1"}).value == 1
+
+
 def test_batcher_retry_budget_exhausted_propagates(trained):
     engine = HedgeEngine(trained)
     engine.prewarm([1])
@@ -427,6 +473,178 @@ def test_batcher_without_policy_is_clean_path(trained):
     assert [e for e in sink.events
             if e.get("name", "").startswith("guard/")] == []
     assert guard.inject.active() is None  # no injector outside chaos scopes
+
+
+# -- async continuous-batching tier under CONCURRENT submit -------------------
+#
+# The PR-7 acceptance bar: the guard semantics proven above for the
+# synchronous worker must survive the async dispatch loop with many client
+# threads submitting at once — sheds are still structured Rejections
+# through the future, the served queue-age histogram still pins p99 inside
+# the deadline, and the breaker still demotes to jit with bitwise-equal
+# answers. No test sleeps longer than 50ms.
+
+
+def _threaded(n_threads, fn):
+    """Run ``fn(tid)`` on n_threads, re-raising the first worker error."""
+    errors = []
+
+    def wrap(tid):
+        try:
+            fn(tid)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            errors.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(t,)) for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def test_async_deadline_sheds_under_concurrent_submit(trained):
+    """4 client threads race doomed (5ms budget) and fine (1s budget)
+    submits behind a 40ms head-of-line dispatch: every doomed request is
+    shed with a structured deadline Rejection, every fine one is served,
+    and the SERVED queue-age p99 stays inside the deadline."""
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1, 8])
+    doomed, fine = [], []
+    lock = threading.Lock()
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with MicroBatcher(engine, max_batch=8, max_wait_us=200.0,
+                              policy=GuardPolicy(deadline_ms=200.0)) as mb:
+                slow = mb.submit(0, _rows(1, nf))
+                time.sleep(0.005)  # worker now inside the slow dispatch
+
+                def client(tid):
+                    d = [mb.submit(0, _rows(1, nf), deadline_s=0.005)
+                         for _ in range(3)]
+                    f = [mb.submit(0, _rows(1, nf), deadline_s=1.0)
+                         for _ in range(3)]
+                    with lock:
+                        doomed.extend(x.result(timeout=30) for x in d)
+                        fine.extend(x.result(timeout=30) for x in f)
+
+                _threaded(4, client)
+    assert not is_rejection(slow.result(timeout=30))
+    assert len(doomed) == 12 and len(fine) == 12
+    for r in doomed:
+        assert is_rejection(r) and r.reason == "deadline"
+        assert r.queued_s >= 0.005
+    assert all(not is_rejection(r) for r in fine)
+    served = reg.histogram("serve/queue_age_seconds", {"outcome": "served"})
+    assert served.count >= 13  # slow + the 12 survivors
+    assert served.percentiles([99])[0] <= 1.0  # pinned by the deadline
+    assert reg.counter("guard/shed", {"reason": "deadline"}).value == 12
+
+
+def test_async_watermark_admission_under_concurrent_submit(trained):
+    """12 concurrent no-deadline submits against watermark 4 behind a
+    blocked worker: the pending queue never exceeds the watermark, every
+    response is either served or a structured watermark Rejection, and
+    serves + sheds account for every request."""
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1, 8])
+    results = []
+    lock = threading.Lock()
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with MicroBatcher(engine, max_batch=8, max_wait_us=200.0,
+                              policy=GuardPolicy(queue_watermark=4)) as mb:
+                blocker = mb.submit(0, _rows(1, nf))
+                time.sleep(0.005)  # worker now inside the slow dispatch
+
+                def client(tid):
+                    futs = [mb.submit(0, _rows(1, nf)) for _ in range(3)]
+                    with lock:
+                        results.extend(f.result(timeout=30) for f in futs)
+
+                _threaded(4, client)
+    assert not is_rejection(blocker.result(timeout=30))
+    assert len(results) == 12
+    shed = [r for r in results if is_rejection(r)]
+    served = [r for r in results if not is_rejection(r)]
+    assert all(r.reason == "watermark" for r in shed)
+    # admission control held the line: the submit storm (~2ms) lands while
+    # the worker is blocked (~40ms), so at most `watermark` requests could
+    # stay queued — with slack for a storm straggler landing after the
+    # worker freed
+    assert len(shed) >= 6 and len(served) >= 1
+    assert (reg.counter("guard/shed", {"reason": "watermark"}).value
+            == len(shed))
+
+
+def test_async_breaker_demotes_under_concurrent_submit(aot_bundle):
+    """Three sequential WAVES of concurrent submits (waves force separate
+    dispatches; concurrent submits inside a wave coalesce) against an AOT
+    executable injected to fail twice: the breaker opens, the bucket
+    demotes to jit for the process, and EVERY response — during and after
+    the failures — is bitwise-equal to the pure-jit engine."""
+    jit_engine = HedgeEngine(aot_bundle, use_aot=False)
+    engine = HedgeEngine(aot_bundle, aot_failure_threshold=2)
+    assert engine.cache_info()["aot_buckets"] == [8]
+    nf = aot_bundle.model.n_features
+    feats = _rows(2, nf)
+    ref_phi, ref_psi, _ = jit_engine.evaluate(0, feats)
+    outs = []
+    lock = threading.Lock()
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(fail={"serve/aot_dispatch": 2})) as inj:
+            with MicroBatcher(engine, max_wait_us=200.0) as mb:
+                for _wave in range(3):
+                    def client(tid):
+                        r = mb.submit(0, feats).result(timeout=30)
+                        with lock:
+                            outs.append(r)
+
+                    _threaded(2, client)
+    assert [site for site, _ in inj.log] == ["serve/aot_dispatch"] * 2
+    assert len(outs) == 6
+    for phi, psi, _ in outs:  # every response bitwise-equal to pure jit
+        np.testing.assert_array_equal(phi, ref_phi)
+        np.testing.assert_array_equal(psi, ref_psi)
+    ci = engine.cache_info()
+    assert ci["aot_circuit_open"] == [8]
+    assert ci["aot_buckets"] == []  # demoted for the process lifetime
+    assert reg.counter("guard/circuit_open", {"aot_bucket": "8"}).value == 1
+
+
+def test_host_quota_sheds_structured_rejection(trained):
+    """Multi-tenant quota backpressure composes with the guard shapes: over
+    ``max_pending`` in-flight requests a submit resolves IMMEDIATELY to a
+    Rejection(reason="quota") — one tenant's burst can't occupy another's
+    batcher — and capacity freed by resolution re-admits."""
+    from orp_tpu.serve import ServeHost
+
+    nf = trained.model.n_features
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with ServeHost(registry=reg) as host:
+                host.add_tenant("q", trained, max_pending=2)
+                blocker = host.submit("q", 0, _rows(1, nf))
+                time.sleep(0.005)  # tenant's worker inside the slow dispatch
+                second = host.submit("q", 0, _rows(1, nf))
+                overq = [host.submit("q", 0, _rows(1, nf)) for _ in range(3)]
+                for f in overq:  # resolved without touching the batcher
+                    r = f.result(timeout=1)
+                    assert is_rejection(r) and r.reason == "quota"
+                assert not is_rejection(blocker.result(timeout=30))
+                assert not is_rejection(second.result(timeout=30))
+                # in-flight slots freed: the tenant admits again
+                again = host.submit("q", 0, _rows(1, nf))
+                assert not is_rejection(again.result(timeout=30))
+    assert reg.counter("guard/shed",
+                       {"reason": "quota", "tenant": "q"}).value == 3
 
 
 def test_guard_policy_validation():

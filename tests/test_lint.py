@@ -24,7 +24,7 @@ def codes(src, **kw):
 
 
 def test_rule_registry_complete():
-    assert set(RULES) == {f"ORP00{i}" for i in range(1, 10)}
+    assert set(RULES) == {f"ORP00{i}" for i in range(1, 10)} | {"ORP010"}
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -594,6 +594,81 @@ def test_orp009_noqa_suppresses():
                 return None
     """
     assert codes(src) == []
+
+
+# -- ORP010: blocking calls in serve dispatch-loop code -----------------------
+
+ORP010_POS = """
+    import time
+    import jax
+
+    def _run(queue, inflight):
+        while True:
+            req = queue.pop()
+            time.sleep(0.001)               # naps the whole queue
+            out = req.future.result()       # unbounded block
+            jax.block_until_ready(out)      # host sync before resolve
+
+    def admit_requests(pending):
+        return pending.result()
+"""
+
+ORP010_NEG = """
+    import jax
+
+    def _run(self):
+        while True:
+            batch = self._admit(block=True)
+            if batch:
+                self._dispatch(batch)
+
+    def _admit(self, block):
+        with self._cv:
+            self._cv.wait(timeout=0.0002)   # interruptible, bounded
+        return []
+
+    def _dispatch(self, batch):
+        return self.engine.evaluate_async(0, batch)
+
+    def _resolve(self, pending):
+        # the resolve stage's JOB is to block: out of rule scope by name
+        out = pending.result()
+        return jax.block_until_ready(out)
+
+    def gather(futures):
+        # bounded blocks are fine even in loop scope
+        return [f.result(timeout=30) for f in futures]
+"""
+
+
+def test_orp010_flags_blocking_dispatch_loop_calls():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP010_POS),
+                                       path="orp_tpu/serve/batcher.py")]
+    # sleep + bare result + block_until_ready in _run, bare result in admit
+    assert got.count("ORP010") == 4
+
+
+def test_orp010_scopes_to_serve_paths_only():
+    # the identical code outside a serve package is none of this rule's
+    # business (training loops may legitimately sleep/block)
+    assert lint_source(textwrap.dedent(ORP010_POS),
+                       path="orp_tpu/train/backward.py") == []
+
+
+def test_orp010_clean_negative():
+    assert lint_source(textwrap.dedent(ORP010_NEG),
+                       path="orp_tpu/serve/batcher.py") == []
+
+
+def test_orp010_noqa_suppresses():
+    src = """
+        import time
+
+        def _dispatch(batch):
+            time.sleep(0.001)  # orp: noqa[ORP010] -- test harness pacing, not production
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/bench.py") == []
 
 
 # -- suppressions ------------------------------------------------------------
