@@ -282,7 +282,7 @@ def main():
     except Exception as e:  # orp: noqa[ORP009] -- the error is captured into the record's rqmc_error field
         record.update(rqmc_error=f"{type(e).__name__}: {e}"[:200])
 
-    record["platform"] = jax.devices()[0].platform
+    record["platform"] = jax.default_backend()
     compile_mon.__exit__(None, None, None)
     record.update(compile_mon.split(time.perf_counter() - t_run))
 

@@ -1,21 +1,39 @@
-"""Serialized serving executables inside policy bundles: zero cold compiles.
+"""Serialized serving executables inside policy bundles: zero cold compiles,
+per *topology*.
 
 A policy bundle (``orp_tpu/serve/bundle.py``) ships params + metadata; the
 first serve process to load it still paid one XLA compile per shape bucket
 (the ``serve/engine.py`` bucket-miss design). This module adds the missing
-artifact — the compiled executables themselves::
+artifact — the compiled executables themselves — keyed by the TOPOLOGY they
+were compiled for, so a single-chip box and an 8-chip mesh cold-start from
+the same bundle with zero XLA compiles each::
 
-    <bundle>/aot/aot.json          manifest: device fingerprint + per-bucket
-                                   kept-input indices, compile walls, FLOPs
-    <bundle>/aot/bucket_<b>.exec   PJRT-serialized ``_eval_core`` executable
-                                   for bucket size <b>
+    <bundle>/aot/aot.json              index: format + the topology set
+    <bundle>/aot/<topo>/aot.json       per-topology manifest: device/runtime
+                                       fingerprint, mesh shape + device kind,
+                                       per-bucket codec/kept-inputs/compile
+                                       walls/FLOPs
+    <bundle>/aot/<topo>/bucket_<b>.exec
+
+``<topo>`` is ``parallel.mesh.topology_fingerprint`` —
+``<platform>-<device_kind>-n<mesh size>``.
+
+Two codecs, chosen by topology:
+
+- ``pjrt`` (single device): the raw PJRT-serialized executable plus the
+  kept-input indices — the engine calls ``execute`` on pre-flattened
+  buffers, the fastest possible dispatch;
+- ``pickle`` (mesh topologies): jax's pickle-based executable serialization
+  (``jax.experimental.serialize_executable``), whose loaded object is a
+  sharding-aware ``jax.stages.Compiled`` — raw ``execute`` only accepts
+  single-device buffer lists, so mesh programs need the wrapper.
 
 ``export_aot`` compiles ``serve/engine.py::_eval_core`` per requested
-bucket FROM AVALS (no requests evaluated) and serializes each executable;
-``load_aot`` verifies the device fingerprint (platform, device kind,
-topology, jax/jaxlib versions) and the policy fingerprint, then
-deserializes every bucket — a ``HedgeEngine`` constructed from such a
-bundle serves every bucket with zero XLA compiles.
+(bucket, topology) FROM AVALS (no requests evaluated) and serializes each
+executable; ``load_aot`` resolves the caller's topology in the index,
+verifies the device/runtime fingerprint and the policy fingerprint, then
+deserializes that topology's buckets — a ``HedgeEngine`` constructed from
+such a bundle serves every bucket with zero XLA compiles.
 
 Fallback contract: ANY mismatch or deserialization failure logs one
 warning (``warnings.warn`` + an ``aot/fingerprint_mismatch`` obs counter
@@ -31,14 +49,15 @@ import pathlib
 import warnings
 
 from orp_tpu.aot.compile import (AotUnsupported, aot_compile,
-                                 deserialize_executable, device_fingerprint,
-                                 serialize_compiled)
+                                 deserialize_executable, deserialize_pickled,
+                                 device_fingerprint, serialize_compiled,
+                                 serialize_compiled_pickled)
 from orp_tpu.obs import count as obs_count
 from orp_tpu.utils.atomic import atomic_write_bytes, atomic_write_text
 
 AOT_SUBDIR = "aot"
 AOT_META = "aot.json"
-AOT_FORMAT = "orp-aot-v1"
+AOT_FORMAT = "orp-aot-v2"  # v2: per-topology executable sets (aot/<topo>/…)
 
 # every power-of-two bucket up to the serve-bench schedule's 1000-row max:
 # the batcher coalesces timing-dependent intermediate sizes, so shipping
@@ -47,9 +66,9 @@ DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 class AotExecutable:
-    """One deserialized bucket executable plus its calling convention: the
-    sorted flat-input indices XLA kept (pruned inputs must be dropped from
-    the flattened argument list before ``execute``)."""
+    """One deserialized ``pjrt``-codec bucket executable plus its calling
+    convention: the sorted flat-input indices XLA kept (pruned inputs must
+    be dropped from the flattened argument list before ``execute``)."""
 
     __slots__ = ("executable", "kept", "bucket")
 
@@ -64,24 +83,130 @@ class AotExecutable:
         return self.executable.execute([flat_args[i] for i in self.kept])
 
 
+class AotCompiled:
+    """One deserialized ``pickle``-codec bucket executable: a callable
+    ``jax.stages.Compiled`` taking ``_eval_core``'s dynamic arguments
+    (params trees, date index, padded features/prices, cost of capital) —
+    the sharding-aware dispatch a mesh topology needs."""
+
+    __slots__ = ("compiled", "bucket")
+
+    def __init__(self, compiled, bucket: int):
+        self.compiled = compiled
+        self.bucket = int(bucket)
+
+
 def _bucket_file(bucket: int) -> str:
     return f"bucket_{bucket}.exec"
 
 
-def export_aot(directory: str | pathlib.Path, policy, *,
-               buckets=DEFAULT_BUCKETS) -> dict:
-    """Compile + serialize the serving executables for ``policy`` into
-    ``<directory>/aot/``; returns the written manifest.
+def _topo_entry(mesh) -> dict:
+    """The index row naming one exported topology (mesh shape + device
+    kind — the provenance the manifest gained in v2)."""
+    from orp_tpu.parallel.mesh import spec_of, topology_fingerprint
 
-    ``directory`` is the policy's bundle dir (``export_bundle`` output —
-    the executables are only meaningful next to the params they close
-    over). ``buckets`` are request sizes; each is rounded up to its
-    power-of-two bucket exactly like a live request would be.
-    """
+    spec = spec_of(mesh)
+    if spec is None:
+        import jax
+
+        dev = jax.devices()[0]  # orp: noqa[ORP011] -- topology introspection: names the single-device topology being exported
+        desc = {"axis": None, "n_devices": 1, "mesh_shape": [1],
+                "platform": dev.platform, "device_kind": dev.device_kind}
+    else:
+        desc = spec.describe()
+    return {"dir": topology_fingerprint(mesh), **desc}
+
+
+def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
+                         policy_fingerprint) -> dict:
+    """Compile + serialize every bucket executable for ONE topology into
+    ``adir`` and return its manifest."""
     import jax
     import jax.numpy as jnp
 
-    from orp_tpu.serve.engine import HedgeEngine, _eval_core
+    from orp_tpu.serve.engine import _eval_core
+
+    adir.mkdir(parents=True, exist_ok=True)
+    sds = jax.ShapeDtypeStruct
+    dt = jnp.dtype(engine.model.dtype)
+    if mesh is None:
+        aval = lambda x: sds(x.shape, x.dtype)
+        row_aval = lambda shape: sds(shape, dt)
+        scalar = lambda dtype: sds((), dtype)
+        codec = "pjrt"
+    else:
+        from orp_tpu.parallel.mesh import path_sharding, replicated_sharding
+
+        rep = replicated_sharding(mesh)
+        rows = path_sharding(mesh, 2)
+        aval = lambda x: sds(x.shape, x.dtype, sharding=rep)
+        row_aval = lambda shape: sds(shape, dt, sharding=rows)
+        scalar = lambda dtype: sds((), dtype, sharding=rep)
+        codec = "pickle"
+    entries = {}
+    for n in sorted({int(b) for b in buckets}):
+        b = engine.bucket_for(n, mesh=mesh)
+        if str(b) in entries:
+            continue
+        compiled, meta = aot_compile(
+            _eval_core,
+            engine.model,
+            jax.tree.map(aval, engine._p1),
+            jax.tree.map(aval, engine._p2),
+            scalar(jnp.int32),                        # date_idx (traced)
+            row_aval((b, engine.model.n_features)),   # padded features
+            row_aval((b, engine.n_instruments)),      # padded prices
+            scalar(dt),                               # cost_of_capital
+            label=f"eval_core/{b}",
+            dual_mode=engine.dual_mode,
+            holdings_combine=engine.holdings_combine,
+        )
+        # AotUnsupported propagates from either codec: an export that cannot
+        # ship executables should fail loudly, not write a bundle that
+        # silently lacks its advertised artifact
+        if codec == "pjrt":
+            blob, kept = serialize_compiled(compiled)
+        else:
+            blob, kept = serialize_compiled_pickled(compiled), None
+        atomic_write_bytes(adir / _bucket_file(b), blob)
+        entries[str(b)] = {
+            "file": _bucket_file(b),
+            "codec": codec,
+            "kept": kept,
+            "serialized_bytes": len(blob),
+            **{k: v for k, v in meta.items() if k != "fn"},
+        }
+    manifest = {
+        "format": AOT_FORMAT,
+        "fingerprint": device_fingerprint(),
+        "topology": _topo_entry(mesh),
+        "policy_fingerprint": policy_fingerprint,
+        "buckets": entries,
+    }
+    # atomic, and written LAST: the manifest is the load-side source of
+    # truth, so it must never name a blob that didn't finish writing
+    atomic_write_text(adir / AOT_META,
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def export_aot(directory: str | pathlib.Path, policy, *,
+               buckets=DEFAULT_BUCKETS, meshes=(None,)) -> dict:
+    """Compile + serialize the serving executables for ``policy`` into
+    ``<directory>/aot/<topo>/`` for every topology in ``meshes``; returns
+    the written index manifest with the per-topology manifests inlined
+    under ``"topologies"``.
+
+    ``directory`` is the policy's bundle dir (``export_bundle`` output —
+    the executables are only meaningful next to the params they close
+    over). ``buckets`` are request sizes; each is rounded up exactly like a
+    live request would be (power-of-two, then shard-divisible for mesh
+    topologies). ``meshes`` entries may be ``None`` (single device), ints,
+    ``MeshSpec``s or built ``Mesh``es; exporting for a mesh requires that
+    many devices visible in THIS process (the compile is real).
+    """
+    from orp_tpu.parallel.mesh import as_mesh, topology_fingerprint
+    from orp_tpu.serve.engine import HedgeEngine
 
     # the engine IS the calling convention: device-resident param trees,
     # resolved statics and the bucket rounding all come from the same code
@@ -93,45 +218,51 @@ def export_aot(directory: str | pathlib.Path, policy, *,
     d = pathlib.Path(directory)
     adir = d / AOT_SUBDIR
     adir.mkdir(parents=True, exist_ok=True)
-    sds = jax.ShapeDtypeStruct
-    aval = lambda x: sds(x.shape, x.dtype)
-    dt = jnp.dtype(engine.model.dtype)
-    entries = {}
-    for b in sorted({engine.bucket_for(int(n)) for n in buckets}):
-        compiled, meta = aot_compile(
-            _eval_core,
-            engine.model,
-            jax.tree.map(aval, engine._p1),
-            jax.tree.map(aval, engine._p2),
-            sds((), jnp.int32),                       # date_idx (traced)
-            sds((b, engine.model.n_features), dt),    # padded features
-            sds((b, engine.n_instruments), dt),       # padded prices
-            sds((), dt),                              # cost_of_capital
-            label=f"eval_core/{b}",
-            dual_mode=engine.dual_mode,
-            holdings_combine=engine.holdings_combine,
-        )
-        blob, kept = serialize_compiled(compiled)  # AotUnsupported propagates:
-        # an export that cannot ship executables should fail loudly, not
-        # write a bundle that silently lacks its advertised artifact
-        atomic_write_bytes(adir / _bucket_file(b), blob)
-        entries[str(b)] = {
-            "file": _bucket_file(b),
-            "kept": kept,
-            "serialized_bytes": len(blob),
-            **{k: v for k, v in meta.items() if k != "fn"},
-        }
-    manifest = {
-        "format": AOT_FORMAT,
-        "fingerprint": device_fingerprint(),
-        "policy_fingerprint": getattr(policy, "fingerprint", None),
-        "buckets": entries,
-    }
-    # atomic, and written LAST: the manifest is the load-side source of
-    # truth, so it must never name a blob that didn't finish writing
-    atomic_write_text(adir / AOT_META,
-                      json.dumps(manifest, indent=1, sort_keys=True))
-    return manifest
+    pf = getattr(policy, "fingerprint", None)
+    index_f = adir / AOT_META
+    index = {"format": AOT_FORMAT, "topologies": {}}
+    if index_f.exists():
+        # additive re-export: `orp export --aot-mesh 8` over a bundle that
+        # already ships the single-device set keeps the existing topologies'
+        # rows — but only those whose executables were built for THIS
+        # policy. A retrain-then-re-export must not leave the index
+        # advertising a topology whose stale set would only ever hit the
+        # policy-fingerprint fallback at load.
+        try:
+            prev = json.loads(index_f.read_text())
+            if prev.get("format") == AOT_FORMAT:
+                for key, row in prev.get("topologies", {}).items():
+                    tdir = adir / row.get("dir", key)
+                    try:
+                        old = json.loads((tdir / AOT_META).read_text())
+                    except (OSError, json.JSONDecodeError):
+                        old = {}
+                    if old.get("policy_fingerprint") == pf:
+                        index["topologies"][key] = row
+                    else:
+                        # stale (different policy) or torn set: drop the row
+                        # AND its blobs — executables are the bundle's
+                        # largest artifact and no loader would ever read
+                        # these again
+                        import shutil
+
+                        shutil.rmtree(tdir, ignore_errors=True)
+        except (OSError, json.JSONDecodeError):
+            pass  # a torn index is rebuilt from this export's topologies
+    out = {"format": AOT_FORMAT, "topologies": {}}
+    for m in meshes:
+        mesh = as_mesh(m)
+        if mesh is not None and mesh.devices.size == 1:
+            # a 1-device mesh IS the single-device topology (same
+            # fingerprint key) — normalise so it ships the raw-PJRT codec,
+            # the fastest dispatch, whichever way the caller spelled it
+            mesh = None
+        key = topology_fingerprint(mesh)
+        manifest = _export_one_topology(adir / key, engine, mesh, buckets, pf)
+        index["topologies"][key] = manifest["topology"]
+        out["topologies"][key] = manifest
+    atomic_write_text(index_f, json.dumps(index, indent=1, sort_keys=True))
+    return out
 
 
 def _fallback(directory, reason: str) -> dict:
@@ -148,23 +279,50 @@ def _fallback(directory, reason: str) -> dict:
 
 
 def load_aot(directory: str | pathlib.Path, *,
-             policy_fingerprint: str | None = None
-             ) -> dict[int, AotExecutable] | None:
-    """Deserialize the bucket executables under ``<directory>/aot/``.
+             policy_fingerprint: str | None = None,
+             mesh=None) -> dict | None:
+    """Deserialize the bucket executables for THIS process's topology from
+    ``<directory>/aot/``.
 
-    Returns None when the bundle ships no AOT artifacts at all (nothing to
-    say), ``{}`` after emitting ONE warning when they exist but cannot be
-    used here (wrong device/topology/jaxlib, tampered manifest, undeserializable
-    blob), else ``{bucket: AotExecutable}``.
+    ``mesh`` selects the topology (None = single device — the key
+    ``parallel.mesh.topology_fingerprint`` computes either way). Returns
+    None when the bundle ships no AOT artifacts at all (nothing to say),
+    ``{}`` after emitting ONE warning when they exist but cannot be used
+    here (topology not exported, wrong device/jaxlib, tampered manifest,
+    undeserializable blob), else ``{bucket: AotExecutable | AotCompiled}``.
     """
+    from orp_tpu.parallel.mesh import as_mesh, topology_fingerprint
+
     adir = pathlib.Path(directory) / AOT_SUBDIR
-    meta_f = adir / AOT_META
-    if not meta_f.exists():
+    index_f = adir / AOT_META
+    if not index_f.exists():
         return None
+    try:
+        index = json.loads(index_f.read_text())
+    except json.JSONDecodeError as e:
+        return _fallback(directory, f"unreadable {AOT_META}: {e}")
+    if index.get("format") != AOT_FORMAT:
+        return _fallback(
+            directory,
+            f"format {index.get('format')!r} != {AOT_FORMAT} (a pre-topology "
+            "v1 artifact refuses here — re-export with --aot)")
+    mesh = as_mesh(mesh)
+    key = topology_fingerprint(mesh)
+    topos = index.get("topologies", {})
+    if key not in topos:
+        return _fallback(
+            directory,
+            f"no executables for topology {key!r} "
+            f"(bundle ships: {sorted(topos)})")
+    tdir = adir / topos[key].get("dir", key)
+    meta_f = tdir / AOT_META
+    if not meta_f.exists():
+        return _fallback(directory, f"topology {key!r} listed but its "
+                         f"manifest {meta_f.name} is missing")
     try:
         manifest = json.loads(meta_f.read_text())
     except json.JSONDecodeError as e:
-        return _fallback(directory, f"unreadable {AOT_META}: {e}")
+        return _fallback(directory, f"unreadable {key}/{AOT_META}: {e}")
     if manifest.get("format") != AOT_FORMAT:
         return _fallback(
             directory,
@@ -176,16 +334,25 @@ def load_aot(directory: str | pathlib.Path, *,
     if diffs:
         return _fallback(directory, "device/runtime fingerprint mismatch — "
                          + "; ".join(diffs))
+    want_n = 1 if mesh is None else int(mesh.devices.size)
+    got_n = (manifest.get("topology") or {}).get("n_devices")
+    if got_n != want_n:
+        return _fallback(directory, f"topology mesh size mismatch: bundle "
+                         f"n_devices={got_n} here={want_n}")
     if (policy_fingerprint is not None
             and manifest.get("policy_fingerprint") != policy_fingerprint):
         return _fallback(directory, "policy fingerprint mismatch (executables "
                          "were exported for a different policy)")
-    out: dict[int, AotExecutable] = {}
+    out: dict = {}
     try:
         for b_str, entry in manifest.get("buckets", {}).items():
-            blob = (adir / entry["file"]).read_bytes()
-            out[int(b_str)] = AotExecutable(
-                deserialize_executable(blob), entry["kept"], int(b_str))
+            blob = (tdir / entry["file"]).read_bytes()
+            if entry.get("codec") == "pickle":
+                out[int(b_str)] = AotCompiled(deserialize_pickled(blob),
+                                              int(b_str))
+            else:
+                out[int(b_str)] = AotExecutable(
+                    deserialize_executable(blob), entry["kept"], int(b_str))
     except Exception as e:  # orp: noqa[ORP009] -- _fallback warns + emits aot/fingerprint_mismatch; any failure mode here has the same answer: jit
         return _fallback(directory, f"deserialization failed: {e}")
     return out

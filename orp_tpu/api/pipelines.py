@@ -223,7 +223,7 @@ def _check_policy_compat(name, trained, model, n_dates):
     return model if trained_model is None else trained_model
 
 
-def _bind_run_manifest(pipeline: str, *configs) -> None:
+def _bind_run_manifest(pipeline: str, *configs, mesh=None) -> None:
     """Bind this run's identity to the active telemetry session (no-op when
     telemetry is off): the manifest a ``--telemetry DIR`` run writes records
     the CONFIG FINGERPRINT of the pipeline that actually executed, so the
@@ -231,9 +231,21 @@ def _bind_run_manifest(pipeline: str, *configs) -> None:
     (acceptance contract pinned in tests/test_obs.py). ``configs`` must
     include EVERY run-shaping argument — the config objects plus the bare
     keyword knobs (``quantile_method``, the basket ``instruments`` mode) —
-    or two materially different runs would fingerprint identically."""
-    bind_manifest(pipeline=pipeline,
-                  run_fingerprint=config_fingerprint(*configs))
+    or two materially different runs would fingerprint identically.
+
+    ``mesh`` additionally records the TOPOLOGY the run executed over
+    (mesh shape + device kind, ``parallel.mesh.MeshSpec.describe``) —
+    sharded numbers without their fleet shape are unreviewable, the same
+    argument the manifest already makes for platform."""
+    fields = {"pipeline": pipeline,
+              "run_fingerprint": config_fingerprint(*configs)}
+    if mesh is not None:
+        from orp_tpu.parallel.mesh import spec_of
+
+        spec = spec_of(mesh)  # None for the int-0 "no mesh" spelling
+        if spec is not None:
+            fields["mesh"] = spec.describe()
+    bind_manifest(**fields)
 
 
 def _maybe_export(result: "PipelineResult", export_dir) -> "PipelineResult":
@@ -332,7 +344,7 @@ def european_hedge(
     """
     _check_quantile_method(quantile_method)
     _bind_run_manifest("european_hedge", euro, sim, train,
-                       f"quantile_method={quantile_method}")
+                       f"quantile_method={quantile_method}", mesh=mesh)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     with obs_span("pipeline/simulate") as sp:
@@ -358,6 +370,7 @@ def european_hedge(
         b / s0,
         payoff / s0,
         _backward_cfg(train),
+        mesh=mesh,
         bias_init=bias,
     )
     times = np.asarray(coarse.times())
@@ -478,7 +491,7 @@ def heston_hedge(
     _check_quantile_method(quantile_method)
     h = heston or HestonConfig()
     _bind_run_manifest("heston_hedge", h, sim, train,
-                       f"quantile_method={quantile_method}")
+                       f"quantile_method={quantile_method}", mesh=mesh)
     dtype = jnp.dtype(sim.dtype)
     grid = TimeGrid(sim.T, sim.n_steps)
     with obs_span("pipeline/simulate") as sp:
@@ -496,6 +509,7 @@ def heston_hedge(
     res = backward_induction(
         model, features, s / s0, b / s0, payoff / s0,
         _backward_cfg(train),
+        mesh=mesh,
         bias_init=(e_payoff_n, 0.0),
     )
     times = np.asarray(coarse.times())
@@ -670,7 +684,7 @@ def basket_hedge(
     _check_quantile_method(quantile_method)
     _bind_run_manifest("basket_hedge", basket, sim, train,
                        f"instruments={instruments}",
-                       f"quantile_method={quantile_method}")
+                       f"quantile_method={quantile_method}", mesh=mesh)
     with obs_span("pipeline/simulate") as sp:
         (dtype, A, s, w, bkt, coarse, b, payoff, norm, vector, model,
          hedge_prices) = _basket_setup(basket, sim, mesh, instruments,
@@ -692,6 +706,7 @@ def basket_hedge(
         b / norm,
         payoff / norm,
         _backward_cfg(train),
+        mesh=mesh,
         bias_init=bias,
     )
     with obs_span("pipeline/report"):
@@ -809,7 +824,7 @@ def pension_hedge(
     _check_quantile_method(quantile_method)
     m, a, s = cfg.market, cfg.actuarial, cfg.sim
     _bind_run_manifest("pension_hedge", cfg,
-                       f"quantile_method={quantile_method}")
+                       f"quantile_method={quantile_method}", mesh=mesh)
     dtype = jnp.dtype(s.dtype)
     grid = TimeGrid(s.T, s.n_steps)
 
@@ -830,6 +845,7 @@ def pension_hedge(
     res = backward_induction(
         model, features, y, b, terminal,
         _backward_cfg(cfg.train),
+        mesh=mesh,
         bias_init=(1.0 - otm, otm),  # moneyness warm start (RP.py:150, :160)
     )
     adjustment = a.n0 * a.premium

@@ -42,14 +42,20 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 pipeline/shape WITHOUT simulating or training, so the next
                 real run skips the 60-90s whole-walk compile (``orp_tpu/aot``)
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP010 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP011 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
-                blocking calls in serve dispatch-loop code); exits non-zero
+                blocking calls in serve dispatch-loop code, single-device
+                assumptions in mesh-reachable code); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
-Training commands take ``--checkpoint-dir DIR`` (persist per-date state) /
+Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
+path-sharded simulation + training with first-class NamedShardings —
+``orp_tpu/parallel``; N must divide ``--paths``), and ``serve-bench`` takes
+``--mesh`` / ``--mesh-sweep`` for batch-sharded serving and the
+rows/s-by-topology table. Training commands take ``--checkpoint-dir DIR``
+(persist per-date state) /
 ``--resume DIR`` (continue an interrupted walk, bitwise-equal to an
 uninterrupted run) and ``--nan-guard`` (per-date NaN sentinel with the
 adam->gauss_newton->final_solve degradation ladder) — the ``orp_tpu/guard``
@@ -199,6 +205,40 @@ def _add_telemetry_flag(p):
                         "(spans, counters, run provenance; off = zero-cost)")
 
 
+def _add_mesh_flag(p):
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="run over an N-device ('paths',) mesh: path-sharded "
+                        "simulation + training with explicit NamedShardings "
+                        "(orp_tpu/parallel); N must divide --paths and not "
+                        "exceed the visible device count")
+
+
+def _build_mesh(args, n_paths: int):
+    """The CLI's mesh gate: resolve ``--mesh N`` to a MeshSpec, failing in
+    FLAG-speak before any simulation spend — the runtime layers would raise
+    the same facts later (parallel/mesh.py hard-errors on non-divisible
+    paths), but deep in a stack trace that never names the flag to fix."""
+    if getattr(args, "mesh", None) is None:
+        return None
+    from orp_tpu.parallel.mesh import MeshSpec, pad_to_mesh
+
+    spec = MeshSpec.from_flag(args.mesh)
+    if spec is None:
+        return None
+    try:
+        mesh = spec.build()
+    except ValueError as e:
+        raise SystemExit(f"error: --mesh {args.mesh}: {e}") from None
+    if n_paths % mesh.devices.size:
+        raise SystemExit(
+            f"error: --paths {n_paths} is not divisible by --mesh "
+            f"{args.mesh}; every shard must hold the same path count — "
+            f"use --paths {pad_to_mesh(n_paths, mesh)} (the next multiple) "
+            "or a mesh size that divides it"
+        )
+    return spec
+
+
 def _add_export_flag(p):
     p.add_argument("--export-dir", default=None,
                    help="after training, export the policy as a serve "
@@ -276,14 +316,16 @@ def cmd_euro(args):
         rebalance_every=args.rebalance_every, engine=args.engine,
     )
     train = _train_cfg(args, "mse_only")
+    mesh = _build_mesh(args, args.paths)
     _check_oos_seed(args, sim.seed_fund, "seed_fund")
-    res = european_hedge(euro, sim, train, quantile_method=args.quantile_method,
+    res = european_hedge(euro, sim, train, mesh=mesh,
+                         quantile_method=args.quantile_method,
                          export_dir=args.export_dir)
     _emit(args, res.report)
     if args.oos_seed is not None:
         oos = european_oos(
             res, euro, dataclasses.replace(sim, seed_fund=args.oos_seed),
-            train, quantile_method=args.quantile_method,
+            train, mesh=mesh, quantile_method=args.quantile_method,
         )
         _emit_oos(args, oos.report)
 
@@ -302,8 +344,10 @@ def cmd_heston(args):
         rebalance_every=args.rebalance_every, engine=args.engine,
     )
     train = _train_cfg(args, "mse_only")
+    mesh = _build_mesh(args, args.paths)
     _check_oos_seed(args, sim.seed_fund, "seed_fund")
-    res = heston_hedge(h, sim, train, quantile_method=args.quantile_method,
+    res = heston_hedge(h, sim, train, mesh=mesh,
+                       quantile_method=args.quantile_method,
                        export_dir=args.export_dir)
     pricer = heston_call if h.option_type == "call" else heston_put
     oracle = pricer(h.s0, h.strike, h.r, args.T, v0=h.v0, kappa=h.kappa,
@@ -317,7 +361,7 @@ def cmd_heston(args):
 
         oos = heston_oos(
             res, h, dataclasses.replace(sim, seed_fund=args.oos_seed),
-            train, quantile_method=args.quantile_method,
+            train, mesh=mesh, quantile_method=args.quantile_method,
         )
         _emit_oos(args, oos.report)
 
@@ -341,8 +385,9 @@ def cmd_pension(args):
         ),
         train=_train_cfg(args, "separate"),
     )
+    mesh = _build_mesh(args, args.paths)
     _check_oos_seed(args, cfg.sim.seed, "seed")
-    res = pension_hedge(cfg, quantile_method=args.quantile_method,
+    res = pension_hedge(cfg, mesh=mesh, quantile_method=args.quantile_method,
                         export_dir=args.export_dir)
     _emit(args, res.report)
     if args.oos_seed is not None:
@@ -351,7 +396,8 @@ def cmd_pension(args):
         oos_cfg = dataclasses.replace(
             cfg, sim=dataclasses.replace(cfg.sim, seed=args.oos_seed)
         )
-        oos = pension_oos(res, oos_cfg, quantile_method=args.quantile_method)
+        oos = pension_oos(res, oos_cfg, mesh=mesh,
+                          quantile_method=args.quantile_method)
         _emit_oos(args, oos.report)
 
 
@@ -368,6 +414,7 @@ def cmd_sweep(args):
             ),
             train=_train_cfg(args, "separate"),
         ),
+        mesh=_build_mesh(args, args.paths),
     )
     if args.json:
         print(json.dumps(rows))
@@ -391,9 +438,10 @@ def cmd_basket(args):
         rebalance_every=args.rebalance_every,
     )
     train = _train_cfg(args, "mse_only")
+    mesh = _build_mesh(args, args.paths)
     _check_oos_seed(args, sim.seed_fund, "seed_fund")
     res = basket_hedge(
-        bcfg, sim, train,
+        bcfg, sim, train, mesh=mesh,
         quantile_method=args.quantile_method,
         instruments=args.instruments,
         export_dir=args.export_dir,
@@ -412,7 +460,7 @@ def cmd_basket(args):
 
         oos = basket_oos(
             res, bcfg, dataclasses.replace(sim, seed_fund=args.oos_seed),
-            train, quantile_method=args.quantile_method,
+            train, mesh=mesh, quantile_method=args.quantile_method,
             instruments=args.instruments,
         )
         _emit_oos(args, oos.report)
@@ -608,11 +656,15 @@ def cmd_export(args):
     aot_manifest = None
     if args.aot:
         from orp_tpu.aot import export_aot
+        from orp_tpu.parallel.mesh import MeshSpec
 
         # the LOADED bundle (not the in-memory result) is what the serve
         # process will construct from — its fingerprint keys the executables
         buckets = tuple(int(x) for x in args.aot_buckets.split(","))
-        aot_manifest = export_aot(args.out, bundle, buckets=buckets)
+        meshes = tuple(MeshSpec.from_flag(int(x))
+                       for x in args.aot_mesh.split(","))
+        aot_manifest = export_aot(args.out, bundle, buckets=buckets,
+                                  meshes=meshes)
     out = {
         "out": args.out,
         "pipeline": args.pipeline,
@@ -621,13 +673,18 @@ def cmd_export(args):
         "fingerprint": bundle.fingerprint,
     }
     if aot_manifest is not None:
-        out["aot_buckets"] = sorted(int(b) for b in aot_manifest["buckets"])
+        topos = aot_manifest["topologies"]
+        out["aot_topologies"] = sorted(topos)
+        out["aot_buckets"] = sorted(
+            {int(b) for t in topos.values() for b in t["buckets"]})
         out["aot_compile_wall_s"] = round(sum(
-            e["compile_wall_s"] for e in aot_manifest["buckets"].values()), 3)
+            e["compile_wall_s"] for t in topos.values()
+            for e in t["buckets"].values()), 3)
     if args.json:
         print(json.dumps(out))
     else:
-        aot_note = (f" + {len(out['aot_buckets'])} AOT bucket executables"
+        aot_note = (f" + {len(out['aot_buckets'])} AOT bucket executables "
+                    f"x {len(out['aot_topologies'])} topologies"
                     if aot_manifest is not None else "")
         print(f"exported {args.pipeline} policy ({bundle.n_dates} dates, "
               f"v0={res.v0:,.4f}){aot_note} -> {args.out}")
@@ -636,7 +693,27 @@ def cmd_export(args):
 def cmd_serve_bench(args):
     import pathlib
 
+    from orp_tpu.parallel.mesh import MeshSpec
     from orp_tpu.serve import load_bundle, serve_bench, write_bench_record
+
+    sweep = (tuple(int(x) for x in args.sweep_concurrency.split(","))
+             if args.sweep_concurrency else ())
+    mesh_sweep = (tuple(int(x) for x in args.mesh_sweep.split(","))
+                  if args.mesh_sweep else ())
+    # validate every requested topology in flag-speak BEFORE the bundle
+    # load or any bench spend — the same courtesy _build_mesh gives the
+    # hedge commands (an oversized N otherwise surfaces as a raw make_mesh
+    # traceback from inside engine construction)
+    for flag, ns in (("--mesh", [args.mesh] if args.mesh else []),
+                     ("--mesh-sweep", [n for n in mesh_sweep if n > 1])):
+        for n in ns:
+            spec = MeshSpec.from_flag(n)
+            if spec is None:
+                continue
+            try:
+                spec.build()
+            except ValueError as e:
+                raise SystemExit(f"error: {flag} {n}: {e}") from None
 
     bundle = load_bundle(args.bundle)
     # the existing record (if any) is the before: its batcher numbers ride
@@ -649,8 +726,6 @@ def cmd_serve_bench(args):
         except (OSError, json.JSONDecodeError) as e:
             print(f"warning: ignoring unreadable previous record "
                   f"{args.out}: {e}", file=sys.stderr)
-    sweep = (tuple(int(x) for x in args.sweep_concurrency.split(","))
-             if args.sweep_concurrency else ())
     record = serve_bench(
         bundle,
         n_requests=args.requests,
@@ -660,6 +735,9 @@ def cmd_serve_bench(args):
         prewarm=args.prewarm,
         sweep_concurrency=sweep,
         sweep_requests=args.sweep_requests,
+        mesh=MeshSpec.from_flag(args.mesh),
+        mesh_sweep=mesh_sweep,
+        mesh_sweep_rows=args.mesh_sweep_rows,
         previous=previous,
     )
     if args.out:
@@ -766,6 +844,7 @@ def build_parser():
     pe.add_argument("--engine", choices=["scan", "pallas"], default="scan",
                     help="path simulator: XLA scan or fused Pallas kernel")
     _add_train_flags(pe)
+    _add_mesh_flag(pe)
     _add_oos_flag(pe)
     _add_quantile_flag(pe)
     _add_export_flag(pe)
@@ -792,6 +871,7 @@ def build_parser():
                     "accurate; default) or full-truncation Euler — both "
                     "available on both engines")
     _add_train_flags(ph)
+    _add_mesh_flag(ph)
     _add_oos_flag(ph)
     _add_quantile_flag(ph)
     _add_export_flag(ph)
@@ -812,6 +892,7 @@ def build_parser():
                     help="path simulator: XLA scan (exact binomial) or fused "
                          "Pallas kernel (normal-approx binomial)")
     _add_train_flags(pp)
+    _add_mesh_flag(pp)
     _add_oos_flag(pp)
     _add_quantile_flag(pp)
     _add_export_flag(pp)
@@ -827,6 +908,7 @@ def build_parser():
                     help="path simulator: XLA scan (exact binomial) or fused "
                          "Pallas kernel (normal-approx binomial)")
     _add_train_flags(ps)
+    _add_mesh_flag(ps)
     ps.set_defaults(fn=cmd_sweep)
 
     pb = sub.add_parser("basket", help="multi-asset basket-call hedge")
@@ -844,6 +926,7 @@ def build_parser():
                     help="hedge with the tradeable basket + bond, or a VECTOR "
                          "hedge (one phi per asset + bond; lower CV variance)")
     _add_train_flags(pb)
+    _add_mesh_flag(pb)
     _add_oos_flag(pb)
     _add_quantile_flag(pb)
     _add_export_flag(pb)
@@ -983,6 +1066,11 @@ def build_parser():
                          "(each rounds up to its power-of-two bucket; the "
                          "default covers every bucket the serve-bench "
                          "schedule and its batcher bursts can reach)")
+    px.add_argument("--aot-mesh", default="1", metavar="N[,M…]",
+                    help="with --aot: mesh sizes (topologies) to ship "
+                         "executable sets for — one aot/<topo>/ set per "
+                         "size (1 = single device); every size must be "
+                         "buildable in THIS process (the compile is real)")
     _add_train_flags(px)
     px.set_defaults(fn=cmd_export)
 
@@ -1031,6 +1119,16 @@ def build_parser():
     psb.add_argument("--out", default="BENCH_serve.json",
                      help="record file to write ('' skips the file; the "
                           "record always prints as one JSON line)")
+    psb.add_argument("--mesh", type=int, default=None, metavar="N",
+                     help="serve every phase on an N-device batch-sharded "
+                          "engine (rows sharded over a ('paths',) mesh; "
+                          "AOT bundles resolve their N-device topology)")
+    psb.add_argument("--mesh-sweep", default="", metavar="N,M…",
+                     help="after the main phases, measure big-batch engine "
+                          "rows/s at each mesh size and pin the served bits "
+                          "equal across topologies ('' skips)")
+    psb.add_argument("--mesh-sweep-rows", type=int, default=1 << 15,
+                     help="batch rows per mesh-sweep evaluation")
     psb.add_argument("--prewarm", action="store_true",
                      help="assert the warmup contract: fail loudly if any "
                           "measured request paid a first-touch bucket "
@@ -1044,8 +1142,9 @@ def build_parser():
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
-             "drift, key reuse, silent excepts, blocking dispatch loops — "
-             "rules ORP001-ORP010); non-zero exit on findings",
+             "drift, key reuse, silent excepts, blocking dispatch loops, "
+             "single-device assumptions — rules ORP001-ORP011); non-zero "
+             "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: the orp_tpu "

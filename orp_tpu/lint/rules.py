@@ -46,6 +46,17 @@ ORP010  blocking calls in serve dispatch-loop code: the continuous
         tier's 19ms-p99-vs-0.68ms-engine pathology, BENCH_serve.json).
         Resolution is the one stage whose JOB is to block, so ``*resolve*``
         functions are out of scope by name.
+ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
+        (and any devices()/local_devices() subscript) silently pins work to
+        one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
+        device/sharding argument commits to the default device (breaking
+        the mesh placement every sharded caller relies on), and
+        ``.addressable_data(0)`` reads one shard as if it were the whole
+        array. The mesh round made NamedSharding first-class end to end
+        (``parallel/mesh.py`` owns placement); code that genuinely means
+        device 0 — topology introspection, PJRT client handles — says so
+        with a noqa. ``.addressable_data`` is legitimate inside
+        ``parallel/`` (the layer whose job is shard bookkeeping).
 """
 
 from __future__ import annotations
@@ -445,6 +456,7 @@ _BLOCKING_HINTS = ("block_until_ready", "device_get")
 _DISPATCH_EXEMPT_PREFIXES = (
     "jax.block_until_ready", "jax.device_get", "jax.profiler", "jax.debug",
     "jax.config", "jax.random.key", "jax.random.PRNGKey", "jax.devices",
+    "jax.default_backend",  # platform introspection, nothing dispatched
     "jax.tree", "jax.monitoring", "jax.jit",  # a jit WRAP is not a dispatch
 )
 
@@ -660,6 +672,52 @@ def check_dispatch_loop_blocking(ctx: FileContext) -> Iterator[Finding]:
                     f"host sync ({d or node.func.attr}) in dispatch-loop "
                     f"{fdef.name!r} — blocks the loop on the device; defer "
                     "device reads to the resolve stage",
+                )
+
+
+# -- ORP011 ------------------------------------------------------------------
+
+_DEVICE_LIST_CALLS = {"jax.devices", "jax.local_devices"}
+# the shard-bookkeeping layer: reading one addressable shard is its job
+_ADDRESSABLE_ALLOWED_DIR = "parallel/"
+
+
+@rule("ORP011", "single-device assumption in mesh-reachable code")
+def check_single_device_assumptions(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    in_parallel = ("/" + _ADDRESSABLE_ALLOWED_DIR in path
+                   or path.startswith(_ADDRESSABLE_ALLOWED_DIR))
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Call)
+                and dotted(node.value.func) in _DEVICE_LIST_CALLS):
+            yield ctx.finding(
+                node, "ORP011",
+                f"{dotted(node.value.func)}()[…] pins work to one device of "
+                "the fleet — build placements from parallel.mesh (make_mesh/"
+                "path_sharding), or noqa with why device 0 is really meant "
+                "(topology introspection, PJRT client handle)",
+            )
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if (d == "jax.device_put"
+                    and len(node.args) < 2
+                    and not any(kw.arg == "device" for kw in node.keywords)):
+                yield ctx.finding(
+                    node, "ORP011",
+                    "jax.device_put without an explicit sharding/device "
+                    "commits to the DEFAULT device — mesh-reachable code "
+                    "must place arrays via parallel.mesh shardings "
+                    "(path_sharding/replicated_sharding)",
+                )
+            elif (not in_parallel
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "addressable_data"):
+                yield ctx.finding(
+                    node, "ORP011",
+                    ".addressable_data(…) reads ONE shard as if it were the "
+                    "whole array — outside parallel/ use np.asarray (a "
+                    "cross-shard gather) or keep the sharded array",
                 )
 
 

@@ -121,13 +121,19 @@ class CompileAudit:
 
 
 def watch_backward_walk(audit: CompileAudit, *, fit_budget: int | None = 2,
-                        outputs_budget: int | None = 1) -> CompileAudit:
+                        outputs_budget: int | None = 1,
+                        mesh=None) -> CompileAudit:
     """Register the backward walk's jitted pieces on ``audit``.
 
     Budgets encode the walk's shape-stability contract: the Adam fit
     compiles once per fit config (first-date epochs + warm epochs = 2),
     the fused per-date outputs program once — all regardless of date
     count. GN walks compile their own two fit programs.
+
+    ``mesh``: a mesh run dispatches the per-mesh jit wrapper
+    (``fused_walk_on_mesh``), a DIFFERENT jit object from the
+    single-device ``_fused_walk`` — pass the run's mesh so its compiles
+    land in the audit instead of silently bypassing it.
     """
     from orp_tpu.train import backward as bw
     from orp_tpu.train.fit import fit
@@ -138,6 +144,11 @@ def watch_backward_walk(audit: CompileAudit, *, fit_budget: int | None = 2,
     audit.watch("date_outputs", bw._date_outputs, budget=outputs_budget)
     audit.watch("value", bw._value, budget=outputs_budget)
     audit.watch("fused_walk", bw._fused_walk)  # count-only: one per walk shape
+    audit.watch("walk_keys", bw._walk_keys)    # count-only: one per date count
+    if mesh is not None:
+        # creating the wrapper here is cheap and idempotent (lru-cached per
+        # mesh); the walk will dispatch this exact object
+        audit.watch("fused_walk_mesh", bw.fused_walk_on_mesh(mesh))
     return audit
 
 

@@ -8,21 +8,31 @@ quantiles (handled by ``orp_tpu.parallel.quantiles``).
 """
 
 from orp_tpu.parallel.mesh import (
+    MeshSpec,
+    as_mesh,
     make_mesh,
+    pad_to_mesh,
     path_indices,
     path_sharding,
     replicated_sharding,
     shard_paths,
+    spec_of,
+    topology_fingerprint,
 )
 from orp_tpu.parallel.quantiles import histogram_quantile, quantile
 from orp_tpu.parallel.multihost import initialize_multihost
 
 __all__ = [
+    "MeshSpec",
+    "as_mesh",
     "make_mesh",
+    "pad_to_mesh",
     "path_indices",
     "path_sharding",
     "replicated_sharding",
     "shard_paths",
+    "spec_of",
+    "topology_fingerprint",
     "histogram_quantile",
     "quantile",
     "initialize_multihost",

@@ -9,6 +9,14 @@ path-sharded ``jnp.arange`` of global point indices makes every device generate
 exactly its own contiguous index range with zero communication — the QMC
 analogue of a sharded data loader.
 
+``MeshSpec`` is the ONE value that names a topology across the stack: the CLI
+``--mesh N`` flag builds one, the pipelines thread it into the training walk
+(explicit ``in_shardings``/``out_shardings`` on the fused program,
+``train/backward.py``), the serving engine buckets and shards request rows
+with it (``serve/engine.py``), and the AOT exporter keys per-topology
+executable sets by its fingerprint (``aot/bundle_exec.py``). It is frozen and
+hashable so per-topology jit wrappers and executables can be cached on it.
+
 Multi-host: the same code runs under ``jax.distributed`` — ``make_mesh`` uses
 all visible devices (ICI within a slice, DCN across hosts handled by the
 runtime); nothing else changes.
@@ -16,10 +24,90 @@ runtime); nothing else changes.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A topology by *shape*, not by device handles: ``n_devices`` over a 1-D
+    ``axis`` mesh (None = all visible devices). Hashable — jit wrappers,
+    executable caches and AOT manifests key on it — and buildable anywhere
+    the same device count is visible, which is what lets one exported bundle
+    name the topologies it ships executables for."""
+
+    n_devices: int | None = None
+    axis: str = "paths"
+
+    def __post_init__(self):
+        if self.n_devices is not None and self.n_devices < 1:
+            raise ValueError(f"MeshSpec.n_devices={self.n_devices}: need >= 1")
+
+    @classmethod
+    def from_flag(cls, value) -> "MeshSpec | None":
+        """The CLI contract: ``None``/0 -> no mesh (single-device semantics),
+        an int/str N -> an N-device ``("paths",)`` mesh."""
+        if value is None:
+            return None
+        n = int(value)
+        return None if n == 0 else cls(n_devices=n)
+
+    def build(self) -> Mesh:
+        return make_mesh(self.n_devices, axis=self.axis)
+
+    def describe(self) -> dict:
+        """JSON-able provenance for manifests/bench records: the resolved
+        mesh shape plus the device kind it was built over."""
+        mesh = self.build()
+        dev = mesh.devices.flat[0]
+        return {
+            "axis": self.axis,
+            "n_devices": int(mesh.devices.size),
+            "mesh_shape": [int(s) for s in mesh.devices.shape],
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+        }
+
+
+def spec_of(mesh) -> "MeshSpec | None":
+    """Normalise any mesh-ish value — ``None``, int device count, ``MeshSpec``
+    or a built ``Mesh`` — to a ``MeshSpec`` (or None). The single adapter
+    every layer uses, so callers may pass whichever form they hold."""
+    if mesh is None or isinstance(mesh, MeshSpec):
+        return mesh
+    if isinstance(mesh, int):
+        return MeshSpec.from_flag(mesh)
+    if isinstance(mesh, Mesh):
+        return MeshSpec(n_devices=int(mesh.devices.size),
+                        axis=mesh.axis_names[0])
+    raise TypeError(f"expected None, int, MeshSpec or Mesh; got {type(mesh)}")
+
+
+def as_mesh(mesh) -> Mesh | None:
+    """The built-``Mesh`` counterpart of :func:`spec_of` (None passes
+    through, as does the int-0 "no mesh" spelling)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, Mesh):
+        return mesh
+    spec = spec_of(mesh)
+    return None if spec is None else spec.build()
+
+
+def topology_fingerprint(mesh=None) -> str:
+    """Filesystem-safe key naming the topology an executable is compiled for:
+    ``<platform>-<device_kind>-n<mesh size>`` (mesh None = single device).
+    This is the directory name under ``<bundle>/aot/`` that
+    ``aot/bundle_exec.py`` serializes each topology's executable set into."""
+    m = as_mesh(mesh)
+    dev = jax.devices()[0] if m is None else m.devices.flat[0]  # orp: noqa[ORP011] -- topology introspection: device 0 names the platform/kind shared by the whole fleet
+    n = 1 if m is None else int(m.devices.size)
+    safe = lambda s: "".join(c if c.isalnum() else "_" for c in str(s))
+    return f"{safe(dev.platform)}-{safe(dev.device_kind)}-n{n}"
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "paths") -> Mesh:
@@ -43,25 +131,56 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pad_to_mesh(n: int, mesh) -> int:
+    """Smallest multiple of the mesh size >= ``n`` — the count to pad a
+    path/row axis to so every shard is equal (``n`` itself when it already
+    divides, or when there is no mesh)."""
+    m = as_mesh(mesh)
+    if m is None:
+        return int(n)
+    d = int(m.devices.size)
+    return ((int(n) + d - 1) // d) * d
+
+
+def _check_divisible(n: int, mesh: Mesh, what: str) -> None:
+    d = int(mesh.devices.size)
+    if n % d:
+        raise ValueError(
+            f"{what}={n} must be divisible by the mesh size {d} "
+            f"(pad to {pad_to_mesh(n, mesh)} — parallel.mesh.pad_to_mesh)"
+        )
+
+
 def path_indices(n_paths: int, mesh: Mesh | None = None, dtype=jnp.uint32) -> jax.Array:
     """Global Sobol point indices ``0..n_paths-1``, path-sharded over ``mesh``.
 
     Each device materialises only its own contiguous block; feeding this to the
     index-addressed Sobol/SDE kernels gives communication-free shard-local path
-    generation (the contract of ``orp_tpu.sde.kernels``).
+    generation (the contract of ``orp_tpu.sde.kernels``). ``n_paths`` must
+    divide by the mesh size — a ragged last shard would silently change every
+    collective's reduction shape; callers pad with :func:`pad_to_mesh` first.
     """
+    mesh = as_mesh(mesh)
     idx = jnp.arange(n_paths, dtype=dtype)
     if mesh is not None:
-        if n_paths % mesh.devices.size != 0:
-            raise ValueError(
-                f"n_paths={n_paths} must be divisible by mesh size {mesh.devices.size}"
-            )
+        _check_divisible(n_paths, mesh, "n_paths")
         idx = jax.device_put(idx, path_sharding(mesh))
     return idx
 
 
-def shard_paths(tree, mesh: Mesh):
-    """Device-put every array leaf with its leading axis sharded over ``mesh``."""
-    return jax.tree.map(
-        lambda x: jax.device_put(x, path_sharding(mesh, ndim=jnp.ndim(x))), tree
-    )
+def shard_paths(tree, mesh):
+    """Device-put every array leaf with its leading axis sharded over ``mesh``.
+
+    ``mesh=None`` (the ubiquitous "no mesh" value) returns the tree
+    unchanged — the same contract as :func:`path_indices`. Hard-errors
+    (naming the offending leaf count and the padded size) when a leaf's
+    leading axis does not divide by the mesh, surfaced here instead of as
+    an XLA layout error deep inside the first collective."""
+    mesh = as_mesh(mesh)
+    if mesh is None:
+        return tree
+    def put(x):
+        n = int(jnp.shape(x)[0]) if jnp.ndim(x) else 0
+        _check_divisible(n, mesh, "leading (path) axis")
+        return jax.device_put(x, path_sharding(mesh, ndim=jnp.ndim(x)))
+    return jax.tree.map(put, tree)

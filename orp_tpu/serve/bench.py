@@ -142,6 +142,54 @@ def _sweep_level(engine, *, concurrency: int, n_requests: int,
     }
 
 
+def _mesh_sweep_phase(policy, mesh_sizes, *, rows: int, repeats: int,
+                      seed: int) -> list[dict]:
+    """Throughput-by-topology: one engine per mesh size over the SAME
+    policy, each prewarmed then timed on ``repeats`` big-batch evaluations
+    (the shape where sharding pays — single rows are dispatch-bound).
+    Results are checked BITWISE against the first (smallest-mesh) engine:
+    the serve forward has no cross-row reductions, so any topology that
+    changes a bit is a broken sharding, not noise."""
+    from orp_tpu.parallel.mesh import make_mesh, pad_to_mesh
+
+    out = []
+    ref = None
+    for n_dev in mesh_sizes:
+        mesh = None if n_dev <= 1 else make_mesh(int(n_dev))
+        engine = HedgeEngine(policy, max_bucket=1 << 22, mesh=mesh)
+        n = pad_to_mesh(rows, mesh)
+        # a FRESH rng per level: every topology must evaluate the identical
+        # request, or the bitwise pin below compares apples to oranges
+        rng = np.random.default_rng(seed)
+        feats = (1.0 + 0.1 * rng.standard_normal(
+            (n, engine.model.n_features))).astype(np.float32)
+        engine.prewarm([n])
+        t0 = time.perf_counter()
+        for r in range(repeats):
+            phi, psi, _ = engine.evaluate(r % engine.n_dates, feats)
+        wall = time.perf_counter() - t0
+        if ref is None:
+            ref = (phi, psi)
+            bitwise = True
+        else:
+            # rows may pad differently on odd mesh sizes; the shared prefix
+            # saw identical features, so it must carry identical bits
+            m = min(len(phi), len(ref[0]))
+            bitwise = bool((phi[:m] == ref[0][:m]).all()
+                           and (psi[:m] == ref[1][:m]).all())
+        info = engine.cache_info()
+        out.append({
+            "n_devices": int(n_dev),
+            "rows": int(n),
+            "repeats": int(repeats),
+            "rows_per_s": round(repeats * n / wall, 1),
+            "bitwise_equal_to_first": bitwise,
+            "aot_buckets": info["aot_buckets"],
+            "xla_compiles": info["xla_compiles"],
+        })
+    return out
+
+
 def serve_bench(
     policy,
     *,
@@ -154,6 +202,10 @@ def serve_bench(
     sweep_concurrency: tuple[int, ...] = DEFAULT_SWEEP_CONCURRENCY,
     sweep_requests: int = 2048,
     sweep_max_batch: int = 1024,
+    mesh=None,
+    mesh_sweep: tuple[int, ...] = (),
+    mesh_sweep_rows: int = 1 << 15,
+    mesh_sweep_repeats: int = 8,
     previous: dict | None = None,
 ) -> dict:
     """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
@@ -164,9 +216,13 @@ def serve_bench(
     if any measured request paid a first-touch compile.
 
     ``sweep_concurrency=()`` skips the sweep (quick smoke runs).
+    ``mesh`` runs every phase on a batch-sharded engine (CLI ``--mesh``);
+    ``mesh_sweep`` (CLI ``--mesh-sweep``) appends the rows/s-by-mesh-size
+    table — big-batch engine throughput per topology, served bits pinned
+    equal across topologies.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
     synchronous-tier baseline forward as ``batcher_before``."""
-    engine = HedgeEngine(policy)
+    engine = HedgeEngine(policy, mesh=mesh)
     n_features = engine.model.n_features
     rng = np.random.default_rng(seed)
 
@@ -248,6 +304,11 @@ def serve_bench(
         "batcher_p50_ms": batcher_summary["p50_ms"],
         "batcher_p99_ms": batcher_summary["p99_ms"],
     }
+    record["mesh_devices"] = cache["mesh_devices"]
+    if mesh_sweep:
+        record["mesh_sweep"] = _mesh_sweep_phase(
+            policy, mesh_sweep, rows=mesh_sweep_rows,
+            repeats=mesh_sweep_repeats, seed=seed)
     if sweep:
         record["sweep"] = sweep
         record["batcher_sustained_requests_per_s"] = best["requests_per_s"]
@@ -275,7 +336,7 @@ def serve_bench(
                     best["requests_per_s"] / prev_rps, 2)
     import jax
 
-    record["platform"] = jax.devices()[0].platform
+    record["platform"] = jax.default_backend()
     if prewarm and record["cache_misses_after_warmup"] != 0:
         raise RuntimeError(
             "--prewarm contract violated: "

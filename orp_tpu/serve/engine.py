@@ -131,10 +131,21 @@ class HedgeEngine:
     directly — zero XLA compiles on a cold process. Any fingerprint or
     deserialization mismatch warns once and keeps the jit path
     (``use_aot=False`` opts out entirely, e.g. for A/B timing).
+
+    **Mesh serving**: ``mesh`` (a ``("paths",)`` device mesh, an int device
+    count, or a ``parallel.mesh.MeshSpec``) turns every evaluation into a
+    batch-sharded program — request rows sharded over the mesh, params
+    replicated, padding rounded up so every shard is equal. The forward is
+    per-row (no cross-row reductions), so sharded results are BITWISE the
+    single-device ones (pinned in tests/test_mesh_native.py); the jit cache
+    keys on input shardings, so executables are per (bucket, topology) with
+    no extra bookkeeping, and AOT bundles resolve the matching
+    per-topology executable set (``aot/<topo>/``).
     """
 
     def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20,
-                 use_aot: bool = True, aot_failure_threshold: int = 3):
+                 use_aot: bool = True, aot_failure_threshold: int = 3,
+                 mesh=None):
         model = getattr(policy, "model", None)
         if model is None:
             raise ValueError(
@@ -150,12 +161,26 @@ class HedgeEngine:
         self.cost_of_capital = float(policy.cost_of_capital)
         self.min_bucket = min_bucket
         self.max_bucket = max_bucket
+        from orp_tpu.parallel.mesh import (as_mesh, path_sharding,
+                                           replicated_sharding)
+
+        self.mesh = as_mesh(mesh)
+        if self.mesh is not None:
+            self._rows = path_sharding(self.mesh, 2)
+            self._rep = replicated_sharding(self.mesh)
+        else:
+            self._rows = self._rep = None
+        put = (
+            (lambda x: jnp.asarray(x, model.dtype)) if self.mesh is None
+            # replicate the per-date params across the mesh ONCE here — the
+            # sharded eval program reads them collective-free on every shard
+            else (lambda x: jax.device_put(jnp.asarray(x, model.dtype),
+                                           self._rep))
+        )
         # device-resident once; every request indexes into these
-        self._p1 = jax.tree.map(lambda x: jnp.asarray(x, model.dtype),
-                                bw.params1_by_date)
+        self._p1 = jax.tree.map(put, bw.params1_by_date)
         p2 = bw.params2_by_date
-        self._p2 = self._p1 if p2 is None else jax.tree.map(
-            lambda x: jnp.asarray(x, model.dtype), p2)
+        self._p2 = self._p1 if p2 is None else jax.tree.map(put, p2)
         self.n_dates = int(jax.tree.leaves(self._p1)[0].shape[0])
         # price legs per request row (risky legs then bond) — the one
         # definition evaluate() and the AOT exporter both shape against
@@ -181,8 +206,12 @@ class HedgeEngine:
         if use_aot and aot_dir is not None:
             from orp_tpu.aot.bundle_exec import load_aot
 
+            # per-topology resolution: the mesh names which executable set
+            # under <bundle>/aot/<topo>/ fits this engine (aot/bundle_exec.py)
             self._aot = load_aot(
-                aot_dir, policy_fingerprint=getattr(policy, "fingerprint", None)
+                aot_dir,
+                policy_fingerprint=getattr(policy, "fingerprint", None),
+                mesh=self.mesh,
             ) or {}
         # constants of the AOT calling convention, hoisted off the hot path:
         # the flat (p1, p2) leaves (tuple flatten = concatenated child
@@ -190,6 +219,8 @@ class HedgeEngine:
         # jit argument order) and the cost-of-capital scalar
         self._flat_params = jax.tree.leaves((self._p1, self._p2))
         self._coc = jnp.asarray(self.cost_of_capital, model.dtype)
+        if self.mesh is not None:
+            self._coc = jax.device_put(self._coc, self._rep)
         # XLA-compile baseline for THIS engine: `_eval_core`'s executable
         # cache is process-wide, so per-engine counts are deltas from here.
         # The counter rides a private jax attribute (_cache_size) — if a jax
@@ -222,6 +253,7 @@ class HedgeEngine:
         return {
             "hits": self.hits,
             "misses": self.misses,
+            "mesh_devices": 1 if self.mesh is None else int(self.mesh.devices.size),
             "buckets": sorted(self._buckets),
             "aot_buckets": sorted(self._aot),
             "aot_hits": self.aot_hits,
@@ -234,8 +266,19 @@ class HedgeEngine:
 
     # -- evaluation ----------------------------------------------------------
 
-    def bucket_for(self, n_rows: int) -> int:
-        b = next_bucket(n_rows, min_bucket=self.min_bucket)
+    def bucket_for(self, n_rows: int, mesh="engine") -> int:
+        """The padded size requests of ``n_rows`` dispatch at: next
+        power-of-two (floored at ``min_bucket``), then rounded up to a
+        multiple of the mesh size so every shard is equal — a no-op for the
+        power-of-two meshes real pods are, load-bearing for odd submeshes.
+        ``mesh`` defaults to the engine's own; the AOT exporter passes each
+        topology explicitly so bucket rounding cannot drift between export
+        and serve."""
+        from orp_tpu.parallel.mesh import pad_to_mesh
+
+        if mesh == "engine":
+            mesh = self.mesh
+        b = pad_to_mesh(next_bucket(n_rows, min_bucket=self.min_bucket), mesh)
         if b > self.max_bucket:
             raise ValueError(
                 f"batch of {n_rows} rows exceeds max_bucket={self.max_bucket}; "
@@ -309,6 +352,12 @@ class HedgeEngine:
             pr = np.zeros((b, k), dt)
             if has_prices:
                 pr[:n] = prices
+            if self.mesh is not None:
+                # commit the padded rows shard-equal over the mesh here, so
+                # the jit and AOT paths dispatch identical placements (and
+                # the jit cache keys the topology into the executable)
+                feats = jax.device_put(feats, self._rows)
+                pr = jax.device_put(pr, self._rows)
         inj = _inject.active()
         with span("serve/dispatch", attrs={"bucket": b,
                                            "aot": aot_ex is not None}):
@@ -362,12 +411,21 @@ class HedgeEngine:
         try:
             if inj is not None:
                 inj.fire("serve/aot_dispatch", bucket=b)
-            # exact jit argument order (pre-flattened params + the
-            # per-request arrays), pruned to the inputs XLA kept — the
-            # same program the jit path would compile, minus the compile
-            flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
-                    jnp.asarray(feats), jnp.asarray(pr), self._coc]
-            out = aot_ex.call_flat(flat)
+            if hasattr(aot_ex, "call_flat"):
+                # pjrt codec: exact jit argument order (pre-flattened params
+                # + the per-request arrays), pruned to the inputs XLA kept —
+                # the same program the jit path would compile, minus the
+                # compile
+                flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
+                        jnp.asarray(feats), jnp.asarray(pr), self._coc]
+                out = aot_ex.call_flat(flat)
+            else:
+                # pickle codec (mesh topologies): a sharding-aware Compiled
+                # taking the dynamic jit arguments structured, exactly as
+                # _jit_eval would pass them
+                out = aot_ex.compiled(
+                    self._p1, self._p2, jnp.asarray(idx, jnp.int32),
+                    jnp.asarray(feats), jnp.asarray(pr), self._coc)
         except Exception as e:  # noqa: BLE001 — counted, breakered, fallen back
             obs_count("guard/aot_exec_failure", bucket=str(b))
             if self._breaker.record_failure(b):
@@ -393,6 +451,13 @@ class HedgeEngine:
         — after a prewarm covering the traffic's sizes, ``misses`` stops
         moving for good."""
         dt = np.dtype(jnp.dtype(self.model.dtype).name)
-        for b in sorted({self.bucket_for(int(n)) for n in sizes}):
-            self.evaluate(0, np.ones((b, self.model.n_features), dt))
+        # dedupe by TARGET bucket but evaluate the requested row count: on a
+        # non-power-of-two mesh the padded bucket is itself not a bucket
+        # boundary (bucket_for(18) == 33 on a 3-mesh), so evaluating b rows
+        # would warm the wrong executable and leave the live size cold
+        by_bucket = {}
+        for n in sizes:
+            by_bucket.setdefault(self.bucket_for(int(n)), int(n))
+        for _, n in sorted(by_bucket.items()):
+            self.evaluate(0, np.ones((n, self.model.n_features), dt))
         return self.cache_info()

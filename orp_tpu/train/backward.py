@@ -456,13 +456,57 @@ class BackwardResult:
         )
 
 
+@functools.partial(jax.jit, static_argnames=("n_dates",))  # orp: noqa[ORP005] -- inputs are one 16-byte PRNG key; nothing worth donating
+def _walk_keys(kfit, *, n_dates: int):
+    """The walk's per-date ``(ka, kb)`` key arrays as ONE device program.
+
+    Bitwise-identical to the host chain ``kfit, ka, kb = split(kfit, 3)``
+    repeated per date (pinned in tests/test_mesh_native.py): ``lax.scan``
+    applies exactly that split sequence, so the stream is unchanged — only
+    the ~3 x n_dates tiny host dispatches the Python loop paid before the
+    single fused dispatch collapse into one."""
+    def body(k, _):
+        k, ka, kb = jax.random.split(k, 3)
+        return k, (ka, kb)
+
+    _, (kas, kbs) = jax.lax.scan(body, kfit, None, length=n_dates)
+    return kas, kbs
+
+
+_FUSED_STATICS = ("model", "cfg")
+_FUSED_DONATE = (5,)  # prices_all — see the jit wrap below
+
+
+@functools.lru_cache(maxsize=None)
+def fused_walk_on_mesh(mesh):
+    """The fused walk jitted with FIRST-CLASS shardings for ``mesh``: path
+    axis sharded (features/prices/terminal in; values/holdings/VaR ledgers
+    out), params/keys/metrics replicated. Under these constraints the GN
+    Gram/rhs matmul pair and every loss mean lower to per-shard partials +
+    ``psum`` (SCALING.md §2) while Sobol-simulated inputs arrive already
+    shard-local — simulation stays communication-free. One wrapper is
+    cached per mesh, so each topology compiles exactly one program."""
+    from orp_tpu.parallel.mesh import path_sharding, replicated_sharding
+
+    rows = path_sharding(mesh)  # PartitionSpec prefix: shards axis 0, any ndim
+    rep = replicated_sharding(mesh)
+    return jax.jit(
+        _fused_walk_core,
+        static_argnames=_FUSED_STATICS,
+        donate_argnums=_FUSED_DONATE,
+        # dynamic args: params1, params2, features, prices_all, terminal, kas, kbs
+        in_shardings=(rep, rep, rows, rows, rows, rep, rep),
+        # values/phi/psi/var ledgers path-sharded; metrics + params replicated
+        out_shardings=(rows, rows, rows, rows, rep, rep, rep, rep, rep),
+    )
+
+
 # prices_all (argnum 5) is donated: it is built inside backward_induction
 # (never caller-visible) and read only by this walk — at 1M paths x 520 knots
 # that returns ~4GB of HBM to the working set. features/terminal stay
 # undonated (caller-owned; pipelines re-read them), params1/params2 too
 # (aliased in shared mode — donating both would double-donate one buffer)
-@functools.partial(jax.jit, static_argnames=("model", "cfg"), donate_argnums=(5,))
-def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, kas, kbs):
+def _fused_walk_core(model, cfg, params1, params2, features, prices_all, terminal, kas, kbs):
     """The whole backward walk as ONE XLA program: the first (latest-time)
     date's fit, then ``lax.scan`` over the remaining dates.
 
@@ -599,6 +643,13 @@ def _fused_walk(model, cfg, params1, params2, features, prices_all, terminal, ka
     )
 
 
+# the single-device jit of the fused walk (no mesh constraints): the shape
+# `orp warm` / aot.warm_fused_walk pre-compile and the default `fused=True`
+# path dispatches; mesh runs go through fused_walk_on_mesh(mesh) instead
+_fused_walk = jax.jit(_fused_walk_core, static_argnames=("model", "cfg"),
+                      donate_argnums=(5,))
+
+
 def backward_induction(
     model: HedgeMLP,
     features: jax.Array,   # (n_paths, n_dates+1, n_features) per rebalance knot
@@ -608,11 +659,20 @@ def backward_induction(
     terminal_values: jax.Array,  # (n_paths,) normalised terminal condition
     cfg: BackwardConfig,
     *,
+    mesh=None,
     bias_init: tuple[float, ...] | None = None,
     compile_audit=None,
 ) -> BackwardResult:
     """Run the backward hedge-training walk. All arrays may be device-sharded over
     the path axis; parameters stay replicated.
+
+    ``mesh``: a ``("paths",)`` device mesh (or an int device count, or a
+    ``parallel.mesh.MeshSpec``). With ``cfg.fused`` the walk dispatches the
+    per-mesh jit wrapper (``fused_walk_on_mesh``) whose explicit
+    ``in_shardings``/``out_shardings`` pin the path axis sharded and the
+    params replicated — the supported multi-chip training path (SCALING §2).
+    On the host-loop path the mesh rides in with the (already path-sharded)
+    inputs; passing it here additionally records the topology in telemetry.
 
     ``compile_audit``: optional ``orp_tpu.lint.CompileAudit`` — registers the
     walk's jitted pieces so the caller's audit region can enforce the walk's
@@ -625,26 +685,30 @@ def backward_induction(
     and per-callable ``train/xla_compiles`` counters from a count-only
     ``CompileAudit`` region. With telemetry off (the default) none of this
     runs — the walk is byte-for-byte the uninstrumented code path."""
+    from orp_tpu.parallel.mesh import as_mesh
+
+    mesh = as_mesh(mesh)
     if compile_audit is not None:
         from orp_tpu.lint.trace_audit import watch_backward_walk
 
-        watch_backward_walk(compile_audit)
+        watch_backward_walk(compile_audit, mesh=mesh)
     args = (model, features, y_prices, b_prices, terminal_values, cfg)
     if not obs_enabled():
-        return _walk_impl(*args, bias_init=bias_init)
+        return _walk_impl(*args, mesh=mesh, bias_init=bias_init)
     from orp_tpu.lint.trace_audit import CompileAudit, watch_backward_walk
 
     # count-only audit (no budgets): telemetry OBSERVES compiles, the
     # budget-enforcing path stays the caller's explicit compile_audit
     audit = watch_backward_walk(
-        CompileAudit(), fit_budget=None, outputs_budget=None)
+        CompileAudit(), fit_budget=None, outputs_budget=None, mesh=mesh)
     with obs_span("train/walk", attrs={
         "n_paths": int(y_prices.shape[0]),
         "n_dates": int(y_prices.shape[1]) - 1,
         "fused": cfg.fused, "optimizer": cfg.optimizer,
         "dual_mode": cfg.dual_mode,
+        "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
     }) as sp, audit:
-        res = _walk_impl(*args, bias_init=bias_init)
+        res = _walk_impl(*args, mesh=mesh, bias_init=bias_init)
         sp.set_result(res.values)
     for name, delta in audit.deltas().items():
         obs_count("train/xla_compiles", delta, fn=name)
@@ -659,6 +723,7 @@ def _walk_impl(
     terminal_values: jax.Array,
     cfg: BackwardConfig,
     *,
+    mesh=None,
     bias_init: tuple[float, ...] | None = None,
 ) -> BackwardResult:
     n_paths, n_knots = y_prices.shape[:2]
@@ -681,23 +746,20 @@ def _walk_impl(
 
     if cfg.fused:
         # (fused + checkpoint_dir is rejected at BackwardConfig construction)
-        # identical key stream to the host loop below: each date consumes one
-        # (kfit, ka, kb) split in walk order
-        kas, kbs = [], []
-        for _ in range(n_dates):
-            kfit, ka, kb = jax.random.split(kfit, 3)
-            kas.append(ka)
-            kbs.append(kb)
+        # identical key stream to the host loop below — each date consumes one
+        # (kfit, ka, kb) split in walk order — generated as ONE device program
+        # (_walk_keys) instead of ~3 x n_dates host dispatches
+        kas, kbs = _walk_keys(kfit, n_dates=n_dates)
         # features pass through uncast, exactly like the host loop — the model
         # casts to its dtype internally (HedgeMLP.holdings), so both walks see
         # identical numerics
         # seed is consumed above into the key arrays; normalise it out of the
         # static cfg so multi-seed runs reuse one compiled walk
+        walk_fn = _fused_walk if mesh is None else fused_walk_on_mesh(mesh)
         (values, phi, psi, var, metrics, params1, params2,
-         params1_by_date, params2_by_date) = _fused_walk(
+         params1_by_date, params2_by_date) = walk_fn(
             model, dataclasses.replace(cfg, seed=0), params1, params2,
-            jnp.asarray(features), prices_all, terminal_values,
-            jnp.stack(kas), jnp.stack(kbs),
+            jnp.asarray(features), prices_all, terminal_values, kas, kbs,
         )
         tl, tmae, tmape, eps_ran = (np.asarray(jax.device_get(m)) for m in metrics)
         return BackwardResult(

@@ -122,10 +122,18 @@ def test_aot_dual_policy_roundtrip(tmp_path):
 # -- fingerprint guard + jit fallback ----------------------------------------
 
 
+def _topo_key():
+    from orp_tpu.parallel.mesh import topology_fingerprint
+
+    return topology_fingerprint(None)
+
+
 def _tampered_copy(aot_bundle, tmp_path, mutate):
     d = tmp_path / "tampered"
     shutil.copytree(aot_bundle, d)
-    meta_f = d / "aot" / "aot.json"
+    # v2 layout: the per-TOPOLOGY manifest is the trust root the loader
+    # verifies (aot/<topo>/aot.json); the top-level aot.json only indexes
+    meta_f = d / "aot" / _topo_key() / "aot.json"
     manifest = json.loads(meta_f.read_text())
     mutate(manifest)
     meta_f.write_text(json.dumps(manifest))
@@ -168,14 +176,24 @@ def test_foreign_format_and_policy_mismatch_fall_back(aot_bundle, tmp_path):
 
 
 def test_aot_manifest_records_device_and_cost(aot_bundle):
-    manifest = json.loads((aot_bundle / "aot" / "aot.json").read_text())
-    assert manifest["format"] == "orp-aot-v1"
+    key = _topo_key()
+    index = json.loads((aot_bundle / "aot" / "aot.json").read_text())
+    assert index["format"] == "orp-aot-v2"
+    # the v2 index names each shipped topology's mesh shape + device kind
+    assert index["topologies"][key]["n_devices"] == 1
+    assert index["topologies"][key]["mesh_shape"] == [1]
+    assert index["topologies"][key]["device_kind"]
+    manifest = json.loads(
+        (aot_bundle / "aot" / key / "aot.json").read_text())
+    assert manifest["format"] == "orp-aot-v2"
     assert manifest["fingerprint"] == device_fingerprint()
+    assert manifest["topology"]["n_devices"] == 1
     assert manifest["policy_fingerprint"].startswith("orp-policy-v1")
     assert sorted(int(b) for b in manifest["buckets"]) == list(AOT_BUCKETS)
     for b, entry in manifest["buckets"].items():
-        blob = aot_bundle / "aot" / entry["file"]
+        blob = aot_bundle / "aot" / key / entry["file"]
         assert blob.stat().st_size == entry["serialized_bytes"] > 0
+        assert entry["codec"] == "pjrt"  # single-device: raw-PJRT codec
         assert entry["kept"] == sorted(entry["kept"])
         assert entry["compile_wall_s"] >= 0
         assert entry["flops"] > 0  # cost_analysis rode into the manifest

@@ -24,7 +24,8 @@ def codes(src, **kw):
 
 
 def test_rule_registry_complete():
-    assert set(RULES) == {f"ORP00{i}" for i in range(1, 10)} | {"ORP010"}
+    assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
+                          | {"ORP010", "ORP011"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -669,6 +670,61 @@ def test_orp010_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/bench.py") == []
+
+
+# -- ORP011: single-device assumptions in mesh-reachable code -----------------
+
+ORP011_POS = """
+    import jax
+
+    def run(x, data):
+        dev = jax.devices()[0]
+        y = jax.device_put(x)
+        z = jax.device_put(data, device=jax.local_devices()[1])
+        shard = y.addressable_data(0)
+        return dev, z, shard
+"""
+
+ORP011_NEG = """
+    import jax
+    from orp_tpu.parallel.mesh import make_mesh, path_sharding
+
+    def run(x, data):
+        mesh = make_mesh()
+        y = jax.device_put(x, path_sharding(mesh))
+        n = len(jax.devices())            # counting devices is fine
+        z = jax.device_put(data, device=y.sharding)
+        return y, n, z
+"""
+
+
+def test_orp011_flags_single_device_assumptions():
+    got = codes(ORP011_POS)
+    # devices()[0], bare device_put, local_devices()[1], addressable_data
+    assert got.count("ORP011") == 4
+
+
+def test_orp011_allows_addressable_data_in_parallel():
+    src = """
+        def first_shard(x):
+            return x.addressable_data(0)
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/parallel/quantiles.py") == []
+    assert [f.rule for f in lint_source(
+        textwrap.dedent(src), path="orp_tpu/serve/engine.py")] == ["ORP011"]
+
+
+def test_orp011_clean_negative():
+    assert codes(ORP011_NEG) == []
+
+
+def test_orp011_noqa_suppresses():
+    src = """
+        import jax
+        DEV = jax.devices()[0]  # orp: noqa[ORP011] -- topology introspection
+    """
+    assert codes(src) == []
 
 
 # -- suppressions ------------------------------------------------------------

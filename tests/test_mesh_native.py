@@ -1,0 +1,320 @@
+"""Mesh-native end-to-end pins (the PR-8 tentpole), on the 8-device virtual
+CPU mesh conftest.py provisions (``XLA_FLAGS=--xla_force_host_platform_
+device_count=8``) — the fleet shape without a pod.
+
+What "supported path" means, pinned:
+
+- the fused training walk under explicit ``in_shardings``/``out_shardings``
+  (``train/backward.py::fused_walk_on_mesh``) returns PATH-SHARDED ledgers
+  and a hedged-CV price inside the reduction-order band of the single-device
+  walk, for both optimizers (SCALING.md §2);
+- the batched per-date key split (``_walk_keys``) reproduces the host
+  loop's ``split(kfit, 3)`` chain BITWISE;
+- batch-sharded serving (``HedgeEngine(mesh=...)``) is BITWISE the
+  single-device engine per bucket — the forward has no cross-row
+  reductions, so any flipped bit is a broken sharding, not noise;
+- one ``--aot`` bundle ships per-TOPOLOGY executable sets and a cold
+  engine on EITHER topology serves every bucket with zero XLA compiles
+  (``lint.trace_audit.compile_count``), bits equal across topologies;
+- the CLI names the flag to fix when ``--paths`` doesn't shard evenly.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from orp_tpu.aot import export_aot
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.lint.trace_audit import compile_count
+from orp_tpu.parallel.mesh import (MeshSpec, make_mesh, path_sharding,
+                                   topology_fingerprint)
+from orp_tpu.serve import HedgeEngine, export_bundle, load_bundle, serve_bench
+from orp_tpu.serve.engine import _eval_core
+from orp_tpu.train.backward import _walk_keys
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+MESH_BUCKETS = (8, 64)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(scope="module")
+def topo_bundle(tmp_path_factory, trained):
+    """One bundle shipping executable sets for BOTH topologies: the
+    single-device set (pjrt codec) and the 8-device mesh set (pickle
+    codec) — the acceptance artifact's shape."""
+    d = tmp_path_factory.mktemp("mesh_aot") / "bundle"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=MESH_BUCKETS,
+               meshes=(None, MeshSpec(8)))
+    return d
+
+
+def _requests(engine, sizes=(1, 7, 8, 33, 64)):
+    rng = np.random.default_rng(11)
+    for n in sizes:
+        for t in range(engine.n_dates):
+            states = (1.0 + 0.05 * rng.standard_normal((n, 1))).astype(np.float32)
+            prices = np.stack(
+                [states[:, 0], np.full(n, 0.97, np.float32)], axis=1)
+            yield t, states, prices
+
+
+# -- key stream ---------------------------------------------------------------
+
+
+def test_walk_keys_bitwise_match_host_stream():
+    """The fused walk's one-dispatch key split IS the host loop's chain:
+    every (ka, kb) pair bit-for-bit, any date count."""
+    for n_dates in (1, 4, 52):
+        kas, kbs = _walk_keys(jax.random.key(1234), n_dates=n_dates)
+        k = jax.random.key(1234)
+        for t in range(n_dates):
+            k, ka, kb = jax.random.split(k, 3)
+            np.testing.assert_array_equal(
+                np.asarray(jax.random.key_data(kas)[t]),
+                np.asarray(jax.random.key_data(ka)))
+            np.testing.assert_array_equal(
+                np.asarray(jax.random.key_data(kbs)[t]),
+                np.asarray(jax.random.key_data(kb)))
+
+
+# -- sharded fused walk -------------------------------------------------------
+
+
+@pytest.mark.parametrize("optimizer", ["adam", "gauss_newton"])
+def test_fused_walk_on_mesh_cv_price_invariant(optimizer):
+    """The explicitly-sharded fused walk (first-class in/out NamedShardings)
+    against the single-device program, both optimizers: ledgers come out
+    PATH-SHARDED (the out_shardings contract) and the hedged-CV price — the
+    mesh-invariant statistic of SCALING §2 — agrees to the reduction-order
+    band. The learned network v0 gets a loose band (LM/early-stop branches
+    on float compares, so trajectories legitimately drift; a wrong psum
+    axis still lands far outside)."""
+    euro = EuropeanConfig(constrain_self_financing=False)
+    sim = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)
+    train = TrainConfig(
+        dual_mode="separate", optimizer=optimizer,
+        epochs_first=12, epochs_warm=6, batch_size=512,
+        gn_iters_first=6, gn_iters_warm=3, lr=1e-3,
+        fused=True, shuffle="blocks",
+    )
+    res_1 = european_hedge(euro, sim, train)
+    mesh = make_mesh(8)
+    res_8 = european_hedge(euro, sim, train, mesh=mesh)
+    # out_shardings pin: the per-path ledgers really are sharded over the mesh
+    assert res_8.backward.values.sharding.is_equivalent_to(
+        path_sharding(mesh, 2), 2)
+    assert res_8.backward.phi.sharding.is_equivalent_to(
+        path_sharding(mesh, 2), 2)
+    np.testing.assert_allclose(
+        res_8.report.v0_cv, res_1.report.v0_cv, rtol=1e-5)
+    np.testing.assert_allclose(res_8.v0, res_1.v0, rtol=0.10)
+    assert np.isfinite(np.asarray(res_8.backward.values)).all()
+
+
+# -- batch-sharded serving ----------------------------------------------------
+
+
+def test_sharded_engine_bitwise_per_bucket(trained):
+    """THE serve-sharding oracle: an 8-device engine returns bit-identical
+    (phi, psi, value) to the single-device engine for every bucket the size
+    schedule reaches — and says which topology it is."""
+    eng_1 = HedgeEngine(trained)
+    eng_8 = HedgeEngine(trained, mesh=make_mesh(8))
+    assert eng_1.cache_info()["mesh_devices"] == 1
+    assert eng_8.cache_info()["mesh_devices"] == 8
+    for t, states, prices in _requests(eng_8):
+        p1, s1, v1 = eng_1.evaluate(t, states, prices)
+        p8, s8, v8 = eng_8.evaluate(t, states, prices)
+        np.testing.assert_array_equal(p8, p1)
+        np.testing.assert_array_equal(s8, s1)
+        np.testing.assert_array_equal(v8, v1)
+    # same bucket set: power-of-two buckets >= the mesh size are already
+    # shard-divisible, so the mesh changes placement, not shapes
+    assert eng_8.cache_info()["buckets"] == eng_1.cache_info()["buckets"]
+
+
+def test_bucket_rounding_is_shard_divisible(trained):
+    """Padding is mesh-aware: power-of-two first, then up to a multiple of
+    the mesh size — a no-op on power-of-two meshes, load-bearing on odd
+    submeshes (3 devices: bucket 16 -> 18)."""
+    eng = HedgeEngine(trained, mesh=make_mesh(8))
+    assert eng.bucket_for(3) == 8 and eng.bucket_for(9) == 16
+    eng3 = HedgeEngine(trained, mesh=make_mesh(3))
+    assert eng3.bucket_for(9) == 18  # 16 rounded up to a multiple of 3
+    phi, psi, _ = eng3.evaluate(1, np.ones((9, 1), np.float32))
+    assert phi.shape == (9,)
+    # prewarm must warm the bucket live requests of that SIZE hit — on a
+    # non-pow2 mesh the padded bucket is not a bucket boundary itself, so
+    # warming "18 rows" as an 18-row evaluate (bucket 18), not a request
+    # of 18 (which would round again to 33)
+    eng3b = HedgeEngine(trained, mesh=make_mesh(3))
+    info = eng3b.prewarm([9])
+    misses_after_warm = info["misses"]
+    eng3b.evaluate(0, np.ones((9, 1), np.float32))
+    assert eng3b.misses == misses_after_warm  # the live size was warmed
+
+
+# -- per-topology AOT ---------------------------------------------------------
+
+
+def test_one_bundle_serves_both_topologies_with_zero_compiles(topo_bundle):
+    """The acceptance pin: ONE exported bundle, a 1-device and an 8-device
+    engine in the same process type, zero XLA compiles on either AOT path,
+    bits equal across topologies."""
+    bundle = load_bundle(topo_bundle)
+    before = compile_count(_eval_core)
+    eng_1 = HedgeEngine(bundle)
+    eng_8 = HedgeEngine(bundle, mesh=make_mesh(8))
+    assert eng_1.cache_info()["aot_buckets"] == list(MESH_BUCKETS)
+    assert eng_8.cache_info()["aot_buckets"] == list(MESH_BUCKETS)
+    for t, states, prices in _requests(eng_8):
+        p1, s1, v1 = eng_1.evaluate(t, states, prices)
+        p8, s8, v8 = eng_8.evaluate(t, states, prices)
+        np.testing.assert_array_equal(p8, p1)
+        np.testing.assert_array_equal(s8, s1)
+        np.testing.assert_array_equal(v8, v1)
+    assert compile_count(_eval_core) == before  # zero XLA compiles, anywhere
+    for eng in (eng_1, eng_8):
+        info = eng.cache_info()
+        assert info["xla_compiles"] == 0
+        assert info["misses"] == 0
+        assert info["aot_hits"] > 0
+
+
+def test_aot_missing_topology_warns_once_and_serves_via_jit(tmp_path, trained):
+    """A bundle exported for the single-device topology only: an 8-device
+    engine warns ONCE (naming the missing topology), then serves correct
+    bits on its jit path."""
+    d = tmp_path / "single_topo"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=(8,))  # meshes=(None,) default
+    with pytest.warns(UserWarning, match="no executables for topology"):
+        eng_8 = HedgeEngine(load_bundle(d), mesh=make_mesh(8))
+    assert eng_8.cache_info()["aot_buckets"] == []
+    states = np.ones((5, 1), np.float32)
+    ref = HedgeEngine(load_bundle(d), use_aot=False)
+    np.testing.assert_array_equal(
+        eng_8.evaluate(0, states)[0], ref.evaluate(0, states)[0])
+
+
+def test_reexport_prunes_stale_topology_sets(tmp_path, trained):
+    """A re-export drops BOTH the index row and the on-disk blobs of a
+    topology whose set was built for a different policy — bundles must not
+    grow dead executables across retrain cycles."""
+    d = tmp_path / "prune"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=(8,), meshes=(None, MeshSpec(8)))
+    key8 = topology_fingerprint(make_mesh(8))
+    # simulate a stale set: its manifest names another policy
+    mf = d / "aot" / key8 / "aot.json"
+    m = json.loads(mf.read_text())
+    m["policy_fingerprint"] = "some-other-policy"
+    mf.write_text(json.dumps(m))
+    export_aot(d, load_bundle(d), buckets=(8,))  # re-export n1 only
+    index = json.loads((d / "aot" / "aot.json").read_text())
+    assert set(index["topologies"]) == {topology_fingerprint(None)}
+    assert not (d / "aot" / key8).exists()  # blobs pruned, not just the row
+
+
+def test_topology_index_names_both_meshes(topo_bundle):
+    index = json.loads((topo_bundle / "aot" / "aot.json").read_text())
+    keys = {topology_fingerprint(None), topology_fingerprint(make_mesh(8))}
+    assert set(index["topologies"]) == keys
+    n_by_key = {k: v["n_devices"] for k, v in index["topologies"].items()}
+    assert sorted(n_by_key.values()) == [1, 8]
+    # the mesh topology ships the sharding-aware codec
+    mesh_key = topology_fingerprint(make_mesh(8))
+    manifest = json.loads(
+        (topo_bundle / "aot" / mesh_key / "aot.json").read_text())
+    assert all(e["codec"] == "pickle" for e in manifest["buckets"].values())
+    assert manifest["topology"]["n_devices"] == 8
+
+
+# -- serve bench + CLI --------------------------------------------------------
+
+
+def test_serve_bench_mesh_sweep_records_rows_per_s(trained):
+    rec = serve_bench(trained, n_requests=6, batch_sizes=(1, 7),
+                      batcher_requests=4, sweep_concurrency=(),
+                      mesh_sweep=(1, 8), mesh_sweep_rows=64,
+                      mesh_sweep_repeats=2)
+    assert rec["mesh_devices"] == 1
+    rows = rec["mesh_sweep"]
+    assert [r["n_devices"] for r in rows] == [1, 8]
+    assert all(r["rows_per_s"] > 0 for r in rows)
+    assert all(r["bitwise_equal_to_first"] for r in rows)
+
+
+def test_fused_walk_mesh_compiles_land_in_the_audit():
+    """The audit/telemetry gap pin: a mesh run dispatches a DIFFERENT jit
+    object (fused_walk_on_mesh) — watch_backward_walk(mesh=…) must see its
+    compiles, or budgets could never catch a mesh recompile leak."""
+    import jax.numpy as jnp
+
+    from orp_tpu.lint.trace_audit import CompileAudit, watch_backward_walk
+    from orp_tpu.models.mlp import HedgeMLP
+    from orp_tpu.train.backward import BackwardConfig, backward_induction
+
+    mesh = make_mesh(8)
+    audit = watch_backward_walk(CompileAudit(), fit_budget=None,
+                                outputs_budget=None, mesh=mesh)
+    n, k = 64, 3  # 2 dates
+    rng = np.random.default_rng(0)
+    s = jnp.asarray(1.0 + 0.05 * rng.standard_normal((n, k)).cumsum(axis=1),
+                    jnp.float32)
+    model = HedgeMLP(n_features=1)
+    cfg = BackwardConfig(epochs_first=4, epochs_warm=2, batch_size=n,
+                         fused=True, shuffle="blocks")
+    with audit:
+        backward_induction(model, s[:, :, None], s,
+                           jnp.ones((k,), jnp.float32), s[:, -1], cfg,
+                           mesh=mesh)
+    deltas = audit.deltas()
+    assert deltas["fused_walk_mesh"] >= 1   # the mesh program was audited
+    assert deltas["fused_walk"] == 0        # and it was NOT the 1-dev jit
+
+
+def test_cli_serve_bench_mesh_validation_names_the_flag():
+    from orp_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["serve-bench", "--bundle", "/nonexistent",
+                  "--mesh", "16"])
+    assert "--mesh 16" in str(exc.value)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["serve-bench", "--bundle", "/nonexistent",
+                  "--mesh-sweep", "1,16"])
+    assert "--mesh-sweep 16" in str(exc.value)
+
+
+def test_cli_mesh_divisibility_error_names_the_flags(capsys):
+    from orp_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["euro", "--paths", "1001", "--steps", "8",
+                  "--rebalance-every", "2", "--mesh", "8"])
+    msg = str(exc.value)
+    assert "--paths 1001" in msg and "--mesh" in msg
+    assert "1008" in msg  # pad_to_mesh names the next multiple
+
+
+def test_cli_euro_mesh_smoke(capsys):
+    """`orp euro --mesh 8 --fused` end to end — the supported multi-chip
+    training entry point."""
+    from orp_tpu import cli
+
+    cli.main(["euro", "--paths", "256", "--steps", "8",
+              "--rebalance-every", "2", "--epochs-first", "6",
+              "--epochs-warm", "3", "--batch-size", "256",
+              "--fused", "--mesh", "8", "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(out["v0"]) and np.isfinite(out["v0_cv"])

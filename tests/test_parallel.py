@@ -10,12 +10,16 @@ import numpy as np
 import pytest
 
 from orp_tpu.parallel import (
+    MeshSpec,
     histogram_quantile,
     make_mesh,
+    pad_to_mesh,
     path_indices,
     path_sharding,
     quantile,
     shard_paths,
+    spec_of,
+    topology_fingerprint,
 )
 from orp_tpu.qmc import sobol_normal
 from orp_tpu.sde import TimeGrid, simulate_gbm_log
@@ -60,6 +64,67 @@ def test_shard_paths_tree():
     out = shard_paths(tree, mesh)
     assert out["a"].sharding.is_equivalent_to(path_sharding(mesh, 2), 2)
     assert out["b"].sharding.is_equivalent_to(path_sharding(mesh, 1), 1)
+
+
+def test_pad_to_mesh():
+    mesh = make_mesh()  # 8 devices
+    assert pad_to_mesh(1001, mesh) == 1008
+    assert pad_to_mesh(1000, mesh) == 1000  # already divisible
+    assert pad_to_mesh(1, mesh) == 8
+    assert pad_to_mesh(1000, None) == 1000  # no mesh, no padding
+    assert pad_to_mesh(10, make_mesh(3)) == 12
+
+
+def test_path_indices_nondivisible_hard_errors():
+    with pytest.raises(ValueError, match=r"divisible by the mesh size 8"):
+        path_indices(1001, make_mesh())
+    # the message hands the caller the fix: the padded size
+    with pytest.raises(ValueError, match="1008"):
+        path_indices(1001, make_mesh())
+
+
+def test_shard_paths_nondivisible_hard_errors():
+    # the ragged leaf is refused up front, not as an XLA layout error
+    # inside the first collective
+    with pytest.raises(ValueError, match=r"divisible by the mesh size 8"):
+        shard_paths({"a": jnp.ones((63, 2))}, make_mesh())
+
+
+def test_shard_paths_none_mesh_is_identity():
+    # the ubiquitous "no mesh" value passes through, like path_indices
+    tree = {"a": jnp.ones((63, 2))}
+    assert shard_paths(tree, None) is tree
+
+
+def test_mesh_spec_round_trips():
+    spec = MeshSpec(8)
+    mesh = spec.build()
+    assert mesh.devices.size == 8 and mesh.axis_names == ("paths",)
+    assert spec_of(mesh) == spec        # Mesh -> spec
+    assert spec_of(8) == spec           # int -> spec
+    assert spec_of(spec) is spec        # identity
+    assert spec_of(None) is None
+    assert MeshSpec.from_flag(None) is None
+    assert MeshSpec.from_flag(0) is None  # 0 = "no mesh" (CLI contract)
+    from orp_tpu.parallel import as_mesh
+
+    assert as_mesh(0) is None           # the int-0 spelling, everywhere
+    assert as_mesh(None) is None
+    d = spec.describe()
+    assert d["n_devices"] == 8 and d["mesh_shape"] == [8]
+    assert d["platform"] == "cpu"
+    with pytest.raises(ValueError, match="n_devices"):
+        MeshSpec(-1)
+
+
+def test_topology_fingerprint_is_filesystem_safe_and_distinct():
+    k1 = topology_fingerprint(None)
+    k8 = topology_fingerprint(make_mesh(8))
+    assert k1 != k8 and k1.endswith("-n1") and k8.endswith("-n8")
+    for k in (k1, k8):
+        assert all(c.isalnum() or c in "-_" for c in k)
+    # mesh of 1 and "no mesh" are the SAME topology (one device either way)
+    assert topology_fingerprint(make_mesh(1)) == k1
 
 
 def test_histogram_quantile_matches_sort():

@@ -41,8 +41,8 @@ def env_payload():
     import jax
 
     return {
-        "platform": jax.devices()[0].platform,
-        "device": str(jax.devices()[0]),
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),  # orp: noqa[ORP011] -- provenance stamp: device 0 names the chip model for the record
         "time": time.strftime("%Y-%m-%d %H:%M:%S"),
     }
 

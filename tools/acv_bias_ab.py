@@ -52,7 +52,7 @@ def main(argv=None):
     grid = TimeGrid(T, 364)
     times = np.asarray(grid.reduced(7).times())
     idx = jnp.arange(1 << args.paths_log2, dtype=jnp.uint32)
-    platform = jax.devices()[0].platform
+    platform = jax.default_backend()
 
     for seed in (int(s) for s in args.seeds.split(",")):
         s = simulate_gbm_log(idx, grid, S0, r, sigma, seed=seed, store_every=7)

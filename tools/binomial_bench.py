@@ -64,7 +64,7 @@ def main(argv=None):
                 "cold_s": round(cold, 2), "warm_s": round(warm, 3),
                 "path_steps_per_s": round(n * args.steps / warm),
                 "mean_N_T": round(mean_nt, 1),  # oracle ~8615 at these params
-                "platform": jax.devices()[0].platform,
+                "platform": jax.default_backend(),
             }
             rows.append(row)
             print(json.dumps(row), flush=True)

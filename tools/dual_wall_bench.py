@@ -60,7 +60,7 @@ def main(argv=None):
             "cv_std": round(float(res.report.cv_std), 4),
             "var99": round(float(
                 res.report.var_overall[res.report.var_qs.index(0.99)]), 4),
-            "platform": jax.devices()[0].platform,
+            "platform": jax.default_backend(),
         }
         out.write(json.dumps(rec) + "\n")
         out.flush()

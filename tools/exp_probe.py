@@ -26,7 +26,7 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    platform = jax.devices()[0].platform
+    platform = jax.default_backend()
     expf = jax.jit(lambda x: jnp.exp(x))  # orp: noqa[ORP003] -- probe jit, built once per run
 
     out = {"platform": platform}

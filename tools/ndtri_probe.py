@@ -29,7 +29,7 @@ def main():
     import numpy as np
     from scipy.special import ndtri as ndtri64
 
-    platform = jax.devices()[0].platform
+    platform = jax.default_backend()
     a = 0.15 / np.sqrt(364.0)  # sigma*sqrt(dt), north-star config
     bits = 23
     n = 1 << bits

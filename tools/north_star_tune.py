@@ -72,7 +72,7 @@ def main(argv=None):
         except Exception as e:  # orp: noqa[ORP009] -- the error is captured into the emitted JSONL record's error field
             rec = {**base, "error": f"{type(e).__name__}: {e}"[:200]}
         rec["total_s"] = round(time.perf_counter() - t0, 1)
-        rec["platform"] = jax.devices()[0].platform
+        rec["platform"] = jax.default_backend()
         out.write(json.dumps(rec) + "\n")
         out.flush()
         print(json.dumps(rec), flush=True)

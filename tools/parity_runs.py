@@ -231,5 +231,5 @@ if __name__ == "__main__":
         out["wall_s"] = round(time.perf_counter() - t0, 1)
         import jax
 
-        out["platform"] = jax.devices()[0].platform
+        out["platform"] = jax.default_backend()
         print(json.dumps(out), flush=True)

@@ -35,7 +35,7 @@ def dump(out_dir):
     from orp_tpu.qmc.sobol import sobol_normal, sobol_uniform
     from orp_tpu.sde import TimeGrid, simulate_gbm_log
 
-    platform = jax.devices()[0].platform
+    platform = jax.default_backend()
     out = pathlib.Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     idx = jnp.arange(N_PATHS, dtype=jnp.uint32)

@@ -242,7 +242,7 @@ def _main_profiled(n_log2, compile_mon):
         k: round(v, 3) if isinstance(v, float) else v for k, v in stamps.items()
     }
     stamps["n_paths"] = n_paths
-    stamps["platform"] = jax.devices()[0].platform
+    stamps["platform"] = jax.default_backend()
 
     # telemetry: per-stage gauges into the registry + the full record as one
     # sink event (obs/sink.py stamps schema/seq/ts), so an enabled run drops

@@ -83,7 +83,7 @@ def main(argv=None):
         "paths_per_scramble": 1 << args.paths_log2,
         "scrambles": args.scrambles,
         "wall_s": round(wall, 1),
-        "platform": jax.devices()[0].platform,
+        "platform": jax.default_backend(),
     }))
 
 

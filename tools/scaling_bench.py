@@ -15,10 +15,20 @@ Two experiments, one JSON line each:
              latency/dispatch-bound and more chips buy little for the fit
              stage (the sim stage stays embarrassingly parallel).
 
+The ``devices`` experiment grows a ``--serve`` mode (r6): train one tiny
+policy, then measure big-batch SERVE throughput per mesh size via the
+batch-sharded engine (``serve/bench.py::_mesh_sweep_phase``) — rows/s by
+topology with the served bits pinned equal across mesh sizes. One
+subprocess provisions the largest virtual mesh; submeshes are sliced
+in-process (a 1-device engine and an 8-device engine in the SAME process,
+the multi-tenant serve-host shape).
+
 Usage:
   python tools/scaling_bench.py devices [--paths 131072] [--devices 1,2,4,8]
+  python tools/scaling_bench.py devices --serve [--serve-rows 32768]
   python tools/scaling_bench.py paths   [--paths-list 65536,262144,1048576]
   python tools/scaling_bench.py child <n_devices> <n_paths>   (internal)
+  python tools/scaling_bench.py child-serve <sizes_csv> <rows>   (internal)
 """
 
 import argparse
@@ -77,23 +87,75 @@ def cmd_child(n_devices: int, n_paths: int):
     print(json.dumps({
         "n_devices": n_devices, "n_paths": n_paths,
         "cold_s": round(cold, 2), "warm_s": round(warm, 2),
-        "v0_cv": round(v0, 5), "platform": jax.devices()[0].platform,
+        "v0_cv": round(v0, 5), "platform": jax.default_backend(),
     }))
 
 
+def cmd_child_serve(sizes_csv: str, rows: int):
+    """Train one tiny policy, then the serve mesh sweep over every size in
+    ``sizes_csv`` (submeshes of this process's virtual mesh): big-batch
+    engine rows/s per topology, bits pinned equal across topologies."""
+    sys.path.insert(0, str(HERE))  # before ANY orp import: direct
+    # `python tools/scaling_bench.py child-serve …` runs have no PYTHONPATH
+
+    import jax
+
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+    from orp_tpu.api.pipelines import european_hedge
+    from orp_tpu.serve.bench import _mesh_sweep_phase
+
+    sizes = [int(x) for x in sizes_csv.split(",")]
+
+    policy = european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=2048, T=1.0, dt=1 / 16, rebalance_every=2),
+        TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10,
+                    batch_size=2048, lr=1e-3),
+    )
+    sweep = _mesh_sweep_phase(policy, sizes, rows=rows, repeats=4, seed=0)
+    print(json.dumps({
+        "experiment": "devices_serve",
+        "platform": jax.default_backend(),
+        "device_count": jax.local_device_count(),
+        "serve_rows": rows,
+        "rows": sweep,
+    }))
+
+
+def _child_env(n: int) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split() if "device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = str(HERE) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 def cmd_devices(args):
+    sizes = [int(x) for x in args.devices.split(",")]
+    if args.serve:
+        # ONE subprocess on the largest virtual mesh; submeshes slice
+        # in-process (the serve engine takes any submesh of the fleet)
+        out = subprocess.run(
+            [sys.executable, __file__, "child-serve", args.devices,
+             str(args.serve_rows)],
+            env=_child_env(max(sizes)), capture_output=True, text=True,
+            cwd=str(HERE),
+        )
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else None
+        if out.returncode != 0 or line is None:
+            print(json.dumps({"experiment": "devices_serve",
+                              "error": out.stderr[-500:]}))
+        else:
+            print(line)
+        return
     rows = []
-    for n in [int(x) for x in args.devices.split(",")]:
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = [f for f in env.get("XLA_FLAGS", "").split() if "device_count" not in f]
-        flags.append(f"--xla_force_host_platform_device_count={n}")
-        env["XLA_FLAGS"] = " ".join(flags)
-        env["PYTHONPATH"] = str(HERE) + os.pathsep + env.get("PYTHONPATH", "")
+    for n in sizes:
         out = subprocess.run(
             [sys.executable, __file__, "child", str(n), str(args.paths)],
-            env=env, capture_output=True, text=True, cwd=str(HERE),
+            env=_child_env(n), capture_output=True, text=True, cwd=str(HERE),
         )
         line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else None
         if out.returncode != 0 or line is None:
@@ -115,7 +177,7 @@ def cmd_paths(args):
         })
         print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
     print(json.dumps({
-        "experiment": "paths", "platform": jax.devices()[0].platform, "rows": rows,
+        "experiment": "paths", "platform": jax.default_backend(), "rows": rows,
     }))
 
 
@@ -125,14 +187,25 @@ if __name__ == "__main__":
     d = sub.add_parser("devices")
     d.add_argument("--paths", type=int, default=1 << 17)
     d.add_argument("--devices", default="1,2,4,8")
+    d.add_argument("--serve", action="store_true",
+                   help="measure big-batch SERVE rows/s per mesh size "
+                        "(batch-sharded HedgeEngine) instead of training "
+                        "walls; bits pinned equal across topologies")
+    d.add_argument("--serve-rows", type=int, default=1 << 15,
+                   help="--serve: batch rows per engine evaluation")
     p = sub.add_parser("paths")
     p.add_argument("--paths-list", default="65536,262144,1048576")
     c = sub.add_parser("child")
     c.add_argument("n_devices", type=int)
     c.add_argument("n_paths", type=int)
+    cs = sub.add_parser("child-serve")
+    cs.add_argument("sizes_csv")
+    cs.add_argument("rows", type=int)
     a = ap.parse_args()
     if a.cmd == "child":
         cmd_child(a.n_devices, a.n_paths)
+    elif a.cmd == "child-serve":
+        cmd_child_serve(a.sizes_csv, a.rows)
     elif a.cmd == "devices":
         cmd_devices(a)
     else:
