@@ -265,6 +265,62 @@ def export_aot(directory: str | pathlib.Path, policy, *,
     return out
 
 
+def aot_status(directory: str | pathlib.Path, *, mesh=None) -> dict:
+    """Non-loading AOT coverage probe for ``orp doctor``: does the bundle
+    ship a usable executable set for THIS process's topology?
+
+    Returns ``{"present": bool, "ok": bool, "detail": str, "topologies":
+    [...]}`` without deserializing any blob and without emitting the
+    load-path fallback warning — a diagnostic must be free to run
+    repeatedly on a broken pod without spamming the one-warning budget
+    the serving path keeps."""
+    from orp_tpu.parallel.mesh import as_mesh, topology_fingerprint
+
+    adir = pathlib.Path(directory) / AOT_SUBDIR
+    index_f = adir / AOT_META
+    out = {"present": False, "ok": True, "detail": "no AOT artifacts",
+           "topologies": []}
+    if not index_f.exists():
+        return out
+    out["present"] = True
+    try:
+        index = json.loads(index_f.read_text())
+    except json.JSONDecodeError as e:
+        return {**out, "ok": False, "detail": f"unreadable {AOT_META}: {e}"}
+    out["topologies"] = sorted(index.get("topologies", {}))
+    if index.get("format") != AOT_FORMAT:
+        return {**out, "ok": False,
+                "detail": f"format {index.get('format')!r} != {AOT_FORMAT} "
+                          "(pre-topology artifact)"}
+    key = topology_fingerprint(as_mesh(mesh))
+    if key not in index.get("topologies", {}):
+        return {**out, "ok": False,
+                "detail": f"no executable set for topology {key!r} "
+                          f"(ships: {out['topologies']})"}
+    tdir = adir / index["topologies"][key].get("dir", key)
+    try:
+        manifest = json.loads((tdir / AOT_META).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return {**out, "ok": False,
+                "detail": f"topology {key!r} manifest unreadable: {e}"}
+    saved = manifest.get("fingerprint") or {}
+    here = device_fingerprint()
+    diffs = [f"{k}: bundle={saved.get(k)!r} here={v!r}"
+             for k, v in here.items() if saved.get(k) != v]
+    if diffs:
+        return {**out, "ok": False,
+                "detail": "device/runtime fingerprint mismatch — "
+                          + "; ".join(diffs)}
+    missing = [e["file"] for e in manifest.get("buckets", {}).values()
+               if not (tdir / e["file"]).exists()]
+    if missing:
+        return {**out, "ok": False,
+                "detail": f"topology {key!r} blobs missing: {missing}"}
+    buckets = sorted(int(b) for b in manifest.get("buckets", {}))
+    return {**out, "detail": f"topology {key!r} covered "
+                             f"(buckets {buckets})"}
+
+
 def _fallback(directory, reason: str) -> dict:
     """The one warning a broken/foreign AOT artifact produces before the
     engine quietly keeps its jit path."""

@@ -41,13 +41,19 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
                 real run skips the 60-90s whole-walk compile (``orp_tpu/aot``)
+- ``doctor``    one-shot environment/bundle self-check: devices + topology
+                fingerprint, persistent-cache dir writable, bundle format/
+                digest/AOT-topology coverage, obs sink writable — every
+                failing check prints its fix in flag-speak; the first
+                thing to run on a broken pod
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP011 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP012 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
                 blocking calls in serve dispatch-loop code, single-device
-                assumptions in mesh-reachable code); exits non-zero
+                assumptions in mesh-reachable code, engine rebuild/swap
+                work under a lock); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -715,6 +721,13 @@ def cmd_serve_bench(args):
             except ValueError as e:
                 raise SystemExit(f"error: {flag} {n}: {e}") from None
 
+    if (args.degrade_at is not None
+            and not 0 <= args.degrade_at < args.degrade_requests):
+        raise SystemExit(
+            f"error: --degrade-at {args.degrade_at} is outside the drill "
+            f"stream [0, {args.degrade_requests}) — the loss would never "
+            "fire; raise --degrade-requests or lower --degrade-at")
+
     bundle = load_bundle(args.bundle)
     # the existing record (if any) is the before: its batcher numbers ride
     # into the new record as batcher_before, so BENCH_serve.json carries
@@ -738,6 +751,9 @@ def cmd_serve_bench(args):
         mesh=MeshSpec.from_flag(args.mesh),
         mesh_sweep=mesh_sweep,
         mesh_sweep_rows=args.mesh_sweep_rows,
+        degrade_at=args.degrade_at,
+        degrade_requests=args.degrade_requests,
+        degrade_survivors=args.degrade_survivors,
         previous=previous,
     )
     if args.out:
@@ -789,6 +805,27 @@ def cmd_warm(args):
     else:
         print(f"warmed {out['fn']} ({args.pipeline}) into {cache_dir}: "
               f"compile {out['compile_wall_s']}s, lower {out['lower_wall_s']}s")
+
+
+def cmd_doctor(args):
+    """One-shot environment/bundle self-check — the first thing to run on a
+    broken pod, before any simulation or compile spend. Every failing check
+    prints a fix in flag-speak; exit 1 when anything failed."""
+    from orp_tpu.serve.health import doctor_report
+
+    rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
+                        telemetry_dir=args.telemetry_dir)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        for c in rep["checks"]:
+            mark = "ok  " if c["ok"] else "FAIL"
+            print(f"{mark} {c['check']:<15} {c['detail']}")
+            if not c["ok"] and c.get("fix"):
+                print(f"     fix: {c['fix']}")
+        print("healthy" if rep["ok"] else "NOT healthy")
+    if not rep["ok"]:
+        raise SystemExit(1)
 
 
 def cmd_lint(args):
@@ -1129,6 +1166,18 @@ def build_parser():
                           "equal across topologies ('' skips)")
     psb.add_argument("--mesh-sweep-rows", type=int, default=1 << 15,
                      help="batch rows per mesh-sweep evaluation")
+    psb.add_argument("--degrade-at", type=int, default=None, metavar="N",
+                     help="topology-degradation drill: inject a device loss "
+                          "at request N of a single-row stream on the "
+                          "largest available mesh (or --mesh); records "
+                          "mttr_ms (drain→rebuild→replay wall), the failure "
+                          "count during the window and a post-recovery "
+                          "bits-equal pin vs the single-device engine")
+    psb.add_argument("--degrade-requests", type=int, default=64,
+                     help="stream length of the degradation drill")
+    psb.add_argument("--degrade-survivors", type=int, default=None,
+                     help="device count the injected loss reports alive "
+                          "(default: mesh size minus one)")
     psb.add_argument("--prewarm", action="store_true",
                      help="assert the warmup contract: fail loudly if any "
                           "measured request paid a first-touch bucket "
@@ -1139,11 +1188,34 @@ def build_parser():
     _add_telemetry_flag(psb)
     psb.set_defaults(fn=cmd_serve_bench)
 
+    pdoc = sub.add_parser(
+        "doctor",
+        help="one-shot environment/bundle self-check (devices + topology "
+             "fingerprint, compile-cache dir writable, bundle format/digest/"
+             "AOT-topology coverage, obs sink writable) with flag-speak "
+             "fixes — the first thing to run on a broken pod",
+    )
+    pdoc.add_argument("--bundle", default=None,
+                      help="policy bundle directory to verify (format, "
+                           "fingerprint, policy-step digest, AOT coverage)")
+    pdoc.add_argument("--mesh", type=int, default=None, metavar="N",
+                      help="check AOT topology coverage and device count "
+                           "for an N-device mesh (default: single device)")
+    pdoc.add_argument("--cache-dir", default=None,
+                      help="compile-cache dir to probe (default: the "
+                           "enable_persistent_cache resolution)")
+    pdoc.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                      help="probe DIR as an obs sink target (--telemetry "
+                           "runs stream events.jsonl there live)")
+    pdoc.add_argument("--json", action="store_true",
+                      help="machine-readable report")
+    pdoc.set_defaults(fn=cmd_doctor)
+
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
              "drift, key reuse, silent excepts, blocking dispatch loops, "
-             "single-device assumptions — rules ORP001-ORP011); non-zero "
+             "single-device assumptions — rules ORP001-ORP012); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

@@ -13,11 +13,19 @@ package is the layer that keeps it standing when something breaks mid-run:
                   bounded retry-with-backoff for transient dispatch
                   failures, and the :class:`CircuitBreaker` that demotes a
                   repeatedly-failing AOT bucket executable to the jit path;
+- ``degrade``   — topology degradation (``DegradeManager``): survive
+                  device loss by draining the batcher outside every lock,
+                  rebuilding the engine on the largest surviving
+                  shard-divisible submesh (zero XLA compiles when the
+                  bundle ships that topology's AOT set) and replaying the
+                  trapped requests — same bits, smaller mesh, MTTR
+                  recorded;
 - ``inject``    — the deterministic, seed-driven fault injector the chaos
                   suite (``tests/test_guard.py``) drives: NaN-poisoned fit
                   targets, synthetic process death between checkpointed
-                  dates, transient/slow dispatches, corrupted artifact
-                  blobs.
+                  dates, transient/slow dispatches, device loss with a
+                  declared survivor count, hung executes, corrupted
+                  artifact blobs, in-memory param corruption on reload.
 
 Training-side persistence hardening (atomic side files, per-date integrity
 digests, ``--resume DIR``) lives with the machinery it guards in
@@ -27,23 +35,30 @@ clean path pays one module-global load per hook site, the same discipline
 ``orp_tpu.obs`` proved.
 """
 
-from orp_tpu.guard.inject import (FaultInjector, FaultPlan, InjectedFault,
+from orp_tpu.guard.degrade import DegradeManager
+from orp_tpu.guard.inject import (FaultInjector, FaultPlan,
+                                  InjectedDeviceLoss, InjectedFault,
                                   WalkKilled, faults)
 from orp_tpu.guard.sentinel import (TRAINER_LADDER, all_finite,
                                     degradation_ladder, sanitize_target)
-from orp_tpu.guard.serve import (CircuitBreaker, GuardPolicy, Rejection,
-                                 TransientDispatchError, is_rejection)
+from orp_tpu.guard.serve import (CircuitBreaker, DeviceLostError, GuardPolicy,
+                                 Rejection, TransientDispatchError,
+                                 WatchdogTrip, is_rejection)
 
 __all__ = [
     "CircuitBreaker",
+    "DegradeManager",
+    "DeviceLostError",
     "FaultInjector",
     "FaultPlan",
     "GuardPolicy",
+    "InjectedDeviceLoss",
     "InjectedFault",
     "Rejection",
     "TRAINER_LADDER",
     "TransientDispatchError",
     "WalkKilled",
+    "WatchdogTrip",
     "all_finite",
     "degradation_ladder",
     "faults",

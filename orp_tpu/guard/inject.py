@@ -29,12 +29,25 @@ Fault kinds (mirroring the guard features they prove):
   and the AOT circuit breaker);
 - ``delay(site)``     — sleep a fixed, small duration for the first ``n``
   calls (proves deadlines/shedding; chaos tests keep every sleep < 50ms);
+- ``device_loss(site)`` — raise ``DeviceLostError`` (NOT transient:
+  structural, carries the surviving device count) for the first ``n``
+  calls (proves the topology-degradation manager's drain → rebuild on the
+  largest surviving submesh → replay, ``guard/degrade.py``);
 - ``corrupt_bytes``   — flip seeded bytes in a serialized blob (proves
-  bundle/AOT artifact tamper detection and fallback).
+  bundle/AOT artifact tamper detection and fallback);
+- ``corrupt_policy``  — perturb one param leaf of an already-LOADED policy
+  (bundle corruption mid-reload that slipped past the on-disk digests —
+  proves the hot-reload canary gate + rollback, ``serve/host.py``).
+
+A hung execute is ``delay`` at the ``serve/execute`` site (the block point,
+``serve/engine.py::PendingEval.result``) past a ``GuardPolicy.hard_wall_ms``
+— the watchdog's prey.
 
 Hook sites in production code (grep for ``inject.active()``):
 ``train/fit_target`` and the kill switch in ``train/backward.py``,
-``serve/dispatch`` and ``serve/aot_dispatch`` in ``serve/engine.py``.
+``serve/dispatch`` and ``serve/aot_dispatch`` in ``serve/engine.py``,
+``serve/execute`` in ``PendingEval.result``, ``serve/bundle_reload`` in
+``serve/host.py::ServeHost.reload_tenant``.
 """
 
 from __future__ import annotations
@@ -46,11 +59,16 @@ import time
 
 import numpy as np
 
-from orp_tpu.guard.serve import TransientDispatchError
+from orp_tpu.guard.serve import DeviceLostError, TransientDispatchError
 
 
 class InjectedFault(TransientDispatchError):
     """A synthetic transient failure (retryable by construction)."""
+
+
+class InjectedDeviceLoss(DeviceLostError):
+    """A synthetic device loss (structural: recovery means resharding, not
+    retrying)."""
 
 
 class WalkKilled(RuntimeError):
@@ -72,6 +90,14 @@ class FaultPlan:
     fail: dict[str, int] = dataclasses.field(default_factory=dict)
     delay: dict[str, tuple[int, float]] = dataclasses.field(
         default_factory=dict)  # site -> (n_calls, seconds)
+    # topology faults: site -> first n calls raise DeviceLostError reporting
+    # `survivors` devices alive (None -> the error carries no count and the
+    # degrade manager assumes the minimum loss, current minus one)
+    device_loss: dict[str, int] = dataclasses.field(default_factory=dict)
+    survivors: int | None = None
+    # first n corrupt_policy() calls perturb the loaded params (bundle
+    # corruption mid-reload that slipped past the on-disk digests)
+    corrupt_reload: int = 0
 
 
 class FaultInjector:
@@ -137,13 +163,24 @@ class FaultInjector:
         """One production call passed ``site``: raise/delay per the plan.
 
         Delay is applied before failure so a site planned with both
-        simulates a slow THEN failing dependency.
+        simulates a slow THEN failing dependency; device loss outranks a
+        plain transient failure (the catastrophic fault wins).
         """
         n_delay, secs = self.plan.delay.get(site, (0, 0.0))
         if n_delay and self._take(f"delay:{site}", n_delay) is not None:
             with self._lock:
                 self.log.append((site, f"delay {secs * 1e3:.0f}ms {attrs}"))
             time.sleep(secs)
+        n_lost = self.plan.device_loss.get(site, 0)
+        if n_lost and self._take(f"device_loss:{site}", n_lost) is not None:
+            with self._lock:
+                self.log.append(
+                    (site, f"device_loss survivors={self.plan.survivors} "
+                           f"{attrs}"))
+            raise InjectedDeviceLoss(
+                f"injected device loss at {site} {attrs}",
+                survivors=self.plan.survivors,
+            )
         n_fail = self.plan.fail.get(site, 0)
         if n_fail and self._take(f"fail:{site}", n_fail) is not None:
             with self._lock:
@@ -166,6 +203,42 @@ class FaultInjector:
         for p in pos:
             buf[p] ^= 0xFF
         return bytes(buf)
+
+    def corrupt_policy(self, policy):
+        """Perturb one params leaf of a LOADED policy for the first
+        ``plan.corrupt_reload`` calls; later calls (and an unplanned site)
+        return it untouched.
+
+        This models the corruption class the on-disk digests CANNOT catch:
+        the bytes were fine at load time, the in-memory object is wrong
+        (bad device transfer, a buggy transform between load and install).
+        The hot-reload canary gate (``serve/host.py``) is the only defence
+        left, which is exactly what this fault exists to prove. The
+        returned object is a dataclasses.replace copy — the caller's
+        original policy is never mutated (rollback must still have clean
+        bits to serve)."""
+        if not self.plan.corrupt_reload:
+            return policy
+        if self._take("corrupt_reload", self.plan.corrupt_reload) is None:
+            return policy
+        import jax
+        import jax.numpy as jnp
+
+        bw = policy.backward
+        leaves, treedef = jax.tree_util.tree_flatten(bw.params1_by_date)
+        with self._lock:
+            li = int(self._rng.integers(len(leaves)))
+            self.log.append(("serve/bundle_reload", f"leaf={li}"))
+        x = np.asarray(leaves[li])
+        flat = np.array(x, copy=True).reshape(-1)
+        # deterministic, bit-visible, finite perturbation: the canary's
+        # bitwise probe must catch it; a NaN would also trip mere finiteness
+        flat[0] = flat[0] * 1.25 + 0.25
+        leaves = list(leaves)
+        leaves[li] = jnp.asarray(flat.reshape(x.shape), x.dtype)
+        bad_bw = dataclasses.replace(
+            bw, params1_by_date=jax.tree_util.tree_unflatten(treedef, leaves))
+        return dataclasses.replace(policy, backward=bad_bw)
 
 
 _ACTIVE: FaultInjector | None = None

@@ -44,6 +44,31 @@ class TransientDispatchError(RuntimeError):
     deterministic error just repeats it with latency."""
 
 
+class DeviceLostError(RuntimeError):
+    """A device fell out of the topology mid-dispatch. NOT transient —
+    retrying on the same engine just re-dispatches onto a mesh that no
+    longer exists. The recovery is structural: drain, rebuild the engine on
+    the largest surviving submesh, replay (``orp_tpu/guard/degrade.py``).
+
+    ``survivors`` is the device count the runtime reported alive (None when
+    the failure carried no count — the degrade manager then assumes the
+    minimum loss, current minus one).
+    """
+
+    def __init__(self, msg: str = "device lost", survivors: int | None = None):
+        super().__init__(msg)
+        self.survivors = survivors
+
+
+class WatchdogTrip(TransientDispatchError):
+    """A stuck-dispatch watchdog force-failed a batch that exceeded its hard
+    wall (``GuardPolicy.hard_wall_ms``; ``serve/health.py``). Transient BY
+    DESIGN: the hang lives in one executable (typically a bucket's AOT
+    artifact — the trip feeds the engine's circuit breaker, which demotes
+    the bucket to jit), so the batcher's bounded block-time retry
+    re-dispatches the same rows through a path that can answer."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Rejection:
     """A structured shed decision delivered THROUGH a request's future (its
@@ -83,6 +108,14 @@ class GuardPolicy:
                           at ``backoff_cap_ms``. Kept small: the batcher
                           worker sleeps through it, so backoff IS added
                           latency for everything queued behind.
+    ``hard_wall_ms``    — stuck-dispatch watchdog (``serve/health.py``): a
+                          dispatched batch whose device block exceeds this
+                          wall is FORCE-FAILED with :class:`WatchdogTrip`
+                          (the waiter is abandoned — a truly hung
+                          executable never returns), the trip feeds the
+                          engine's AOT circuit breaker, and the batch gets
+                          one block-time retry when ``max_retries`` allows.
+                          None = no watchdog (the pre-degradation path).
     """
 
     deadline_ms: float | None = None
@@ -90,10 +123,13 @@ class GuardPolicy:
     max_retries: int = 0
     backoff_ms: float = 1.0
     backoff_cap_ms: float = 20.0
+    hard_wall_ms: float | None = None
 
     def __post_init__(self):
         if self.deadline_ms is not None and self.deadline_ms <= 0:
             raise ValueError(f"deadline_ms={self.deadline_ms} must be > 0")
+        if self.hard_wall_ms is not None and self.hard_wall_ms <= 0:
+            raise ValueError(f"hard_wall_ms={self.hard_wall_ms} must be > 0")
         if self.queue_watermark is not None and self.queue_watermark < 1:
             raise ValueError(
                 f"queue_watermark={self.queue_watermark} must be >= 1")
@@ -148,4 +184,6 @@ class CircuitBreaker:
     @property
     def open_keys(self) -> list:
         with self._lock:
-            return sorted(self._open)
+            # key=str: exec-failure keys are bucket ints, hang streaks are
+            # "hang:<bucket>" strings — a mixed set must still sort
+            return sorted(self._open, key=str)

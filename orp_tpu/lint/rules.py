@@ -46,6 +46,18 @@ ORP010  blocking calls in serve dispatch-loop code: the continuous
         tier's 19ms-p99-vs-0.68ms-engine pathology, BENCH_serve.json).
         Resolution is the one stage whose JOB is to block, so ``*resolve*``
         functions are out of scope by name.
+ORP012  engine rebuild/swap under a lock: the degradation round's whole
+        design is swap-the-pointer-under-the-lock, do-the-work-outside-it.
+        A ``HedgeEngine``/``MicroBatcher``/``load_bundle`` constructed while
+        holding a batcher or host lock head-of-line-blocks every submit for
+        the build's duration (seconds on a cold jit bundle), and a batcher
+        ``.close()``/``.drain()`` under a lock deadlocks the moment a
+        resolving future's done-callback re-enters the holder (the PR 6
+        lesson, now enforced instead of remembered). Scoped to the
+        rebuild/swap/reload/recover functions under ``serve/`` and
+        ``guard/`` where those operations live; locks whose name says
+        ``build`` are exempt — a build serializer exists precisely to hold
+        construction, and nothing drains under it.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -719,6 +731,84 @@ def check_single_device_assumptions(ctx: FileContext) -> Iterator[Finding]:
                     "whole array — outside parallel/ use np.asarray (a "
                     "cross-shard gather) or keep the sharded array",
                 )
+
+
+# -- ORP012 ------------------------------------------------------------------
+
+# the functions where topology rebuilds / engine swaps / bundle reloads live
+_ORP012_FN_RE = re.compile(r"rebuild|swap|reload|recover", re.IGNORECASE)
+# lock-ish context managers by terminal name: _lock, lock, _cv, cond, mutex.
+# (^|_) anchoring keeps "block"-style names out; "build" locks are exempt —
+# a build serializer exists to hold construction, nothing drains under it
+_ORP012_LOCK_RE = re.compile(r"(^|_)(lock|cv|cond|condition|mutex)$")
+_ORP012_BUILDERS = {"HedgeEngine", "MicroBatcher", "load_bundle"}
+_ORP012_DRAINS = {"close", "drain"}
+
+
+def _lockish_name(expr: ast.expr) -> str | None:
+    d = dotted(expr)
+    if d is None:
+        return None
+    comp = d.split(".")[-1]
+    if "build" in comp:
+        return None
+    return d if _ORP012_LOCK_RE.search(comp) else None
+
+
+def _walk_with_body(node: ast.AST):
+    """Descendants of a With block, pruning nested function/lambda bodies
+    (deferred code does not run while the lock is held)."""
+    stack = [s for item in getattr(node, "body", []) for s in [item]]
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+@rule("ORP012", "engine rebuild/swap work done while holding a lock")
+def check_rebuild_under_lock(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path and "guard/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _ORP012_FN_RE.search(fdef.name):
+            continue
+        for node in walk_scope(fdef):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [name for name in
+                     (_lockish_name(item.context_expr)
+                      for item in node.items) if name]
+            if not locks:
+                continue
+            for sub in _walk_with_body(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted(sub.func)
+                tail = d.split(".")[-1] if d is not None else None
+                if tail in _ORP012_BUILDERS:
+                    yield ctx.finding(
+                        sub, "ORP012",
+                        f"{tail} constructed while holding {locks[0]} in "
+                        f"{fdef.name!r} — a build (bundle load, AOT "
+                        "deserialize, possible compiles) head-of-line-"
+                        "blocks every submit queued on that lock; build "
+                        "outside, swap the pointer under the lock",
+                    )
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in _ORP012_DRAINS):
+                    yield ctx.finding(
+                        sub, "ORP012",
+                        f".{sub.func.attr}() while holding {locks[0]} in "
+                        f"{fdef.name!r} — a drain resolves futures whose "
+                        "done-callbacks may re-enter the lock holder "
+                        "(deadlock); unlink under the lock, drain outside "
+                        "every lock",
+                    )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

@@ -131,6 +131,26 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def largest_submesh(n_alive: int, axis: str = "paths") -> "MeshSpec | None":
+    """The biggest topology worth rebuilding on after device loss: the largest
+    power-of-two device count <= ``n_alive`` (None = single device).
+
+    Power-of-two because the serve buckets are powers of two floored at 8
+    (``serve/engine.py::next_bucket``): every such submesh divides every
+    bucket, so a degraded engine keeps the healthy bucket set unchanged —
+    and because AOT bundles ship per-topology executable sets keyed by
+    device count (``aot/<topo>/``), which are exported for the power-of-two
+    ladder, so the degraded topology is the one most likely to cold-start
+    with zero compiles. Losing 1 device of 8 therefore rebuilds on 4, not 7:
+    half the fleet beats a topology that re-pads every bucket and has no
+    shipped executables (``orp_tpu/guard/degrade.py`` is the consumer)."""
+    if n_alive < 1:
+        raise ValueError(f"largest_submesh: n_alive={n_alive} — no devices "
+                         "survive; nothing to rebuild on")
+    n = 1 << (int(n_alive).bit_length() - 1)
+    return None if n <= 1 else MeshSpec(n_devices=n, axis=axis)
+
+
 def pad_to_mesh(n: int, mesh) -> int:
     """Smallest multiple of the mesh size >= ``n`` — the count to pad a
     path/row axis to so every shard is equal (``n`` itself when it already

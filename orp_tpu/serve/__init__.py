@@ -18,7 +18,13 @@ that into production artifacts and serves them:
   deadlines, watermark load shedding and transient-dispatch retries;
 - ``host``    — multi-tenant serving: many policy bundles in one process
   under an LRU engine cap, per-tenant quotas (``Rejection``
-  ``reason="quota"``) and SLO burn-rate evaluation off the obs registry;
+  ``reason="quota"``), SLO burn-rate evaluation off the obs registry,
+  and canary-gated hot bundle reload (``reload_tenant``: the candidate
+  must reproduce pinned probe rows bitwise before taking traffic;
+  rejects roll back to the serving bundle);
+- ``health``  — the stuck-dispatch watchdog (``GuardPolicy.hard_wall_ms``:
+  hung batches force-fail, feed the engine's circuit breaker, retry on a
+  path that can answer) and the ``orp doctor`` environment/bundle probe;
 - ``metrics`` — p50/p95/p99 latency + throughput counters + dispatch-
   amortisation gauges (batch occupancy, dispatches per request);
 - ``bench``   — the ``serve-bench`` mode (mixed-size engine schedule,
@@ -29,10 +35,14 @@ from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.bench import serve_bench, write_bench_record
 from orp_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
 from orp_tpu.serve.engine import HedgeEngine, PendingEval
-from orp_tpu.serve.host import ServeHost, SloPolicy, burn_rate
+from orp_tpu.serve.health import DispatchWatchdog, doctor_report
+from orp_tpu.serve.host import (CanaryRejected, ServeHost, SloPolicy,
+                                burn_rate)
 from orp_tpu.serve.metrics import ServingMetrics
 
 __all__ = [
+    "CanaryRejected",
+    "DispatchWatchdog",
     "HedgeEngine",
     "MicroBatcher",
     "PendingEval",
@@ -41,6 +51,7 @@ __all__ = [
     "ServingMetrics",
     "SloPolicy",
     "burn_rate",
+    "doctor_report",
     "export_bundle",
     "load_bundle",
     "serve_bench",

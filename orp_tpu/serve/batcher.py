@@ -256,6 +256,18 @@ class MicroBatcher:
                          else int(min_fill))
         self.metrics = metrics
         self.policy = policy
+        # stuck-dispatch watchdog (serve/health.py), opt-in via the policy's
+        # hard_wall_ms: bounds the resolve-stage block and feeds the
+        # engine's circuit breaker on a trip; absent -> zero cost
+        self._watchdog = None
+        if policy is not None and policy.hard_wall_ms is not None:
+            from orp_tpu.serve.health import DispatchWatchdog
+
+            self._watchdog = DispatchWatchdog(
+                policy.hard_wall_ms,
+                on_trip=getattr(engine, "watchdog_trip", None),
+                on_ok=getattr(engine, "watchdog_ok", None),
+            )
         # one condition guards the deque + closed flag: submit needs to shed
         # arbitrary queued requests under the watermark policy, which a
         # SimpleQueue cannot express
@@ -333,6 +345,8 @@ class MicroBatcher:
             self._interrupt.set()
             self._cv.notify_all()
         self._worker.join(timeout)
+        if self._watchdog is not None:
+            self._watchdog.close()
 
     def __enter__(self):
         return self
@@ -491,24 +505,36 @@ class MicroBatcher:
                           attempt=str(attempt))
                 self._interrupt.wait(pol.backoff_s(attempt))
 
+    def _blocked(self, pending):
+        """The ONE block point on a dispatched batch: straight through
+        without a watchdog, hard-wall-bounded with one (a hang past
+        ``hard_wall_ms`` force-fails as a ``WatchdogTrip`` — transient, so
+        the block-time retry below applies; the trip already fed the
+        engine's breaker, which may have demoted the hanging bucket)."""
+        if self._watchdog is not None:
+            return self._watchdog.block(
+                pending.result, tag=getattr(pending, "bucket", None))
+        return pending.result()
+
     def _blocked_result(self, g: _Group):
         """Block on ``g``'s dispatched evaluation. A transient failure that
         only SURFACES here (XLA's async runtime raises at block time, not
-        submission) gets the same bounded retry policy a dispatch-time
-        failure got: the whole group re-dispatches through
-        ``_dispatch_engine`` (whose own retry loop then applies). Without a
-        retrying policy the error propagates as before — retrying is the
-        operator's call, never a silent default."""
+        submission — or the watchdog force-failed a hung batch) gets the
+        same bounded retry policy a dispatch-time failure got: the whole
+        group re-dispatches through ``_dispatch_engine`` (whose own retry
+        loop then applies). Without a retrying policy the error propagates
+        as before — retrying is the operator's call, never a silent
+        default."""
         try:
-            return g.pending.result()
+            return self._blocked(g.pending)
         except TransientDispatchError:
             pol = self.policy
             if pol is None or pol.max_retries < 1:
                 raise
             obs_count("guard/retry", site="serve/block", attempt="1")
             self._interrupt.wait(pol.backoff_s(1))
-            return self._dispatch_engine(g.date_idx, g.feats,
-                                         g.prices).result()
+            return self._blocked(
+                self._dispatch_engine(g.date_idx, g.feats, g.prices))
 
     def _resolve(self, groups: list[_Group]) -> None:
         """Block on the oldest in-flight batch and resolve every future in

@@ -190,6 +190,78 @@ def _mesh_sweep_phase(policy, mesh_sizes, *, rows: int, repeats: int,
     return out
 
 
+def _degrade_drill(policy, *, degrade_at: int, n_requests: int,
+                   survivors: int | None, mesh, seed: int) -> dict:
+    """Degradation drill (CLI ``--degrade-at``): stream single-row requests
+    through a :class:`~orp_tpu.guard.DegradeManager` on ``mesh`` and, at
+    request ``degrade_at``, inject a deterministic device loss at dispatch.
+    The record answers the three production questions: how long was the
+    drain→rebuild→replay wall (``mttr_ms``), how much traffic failed or was
+    shed during the window (``failed_during_window`` — the contract is
+    zero: doomed requests REPLAY, they don't error), and does the recovered
+    topology still serve the healthy single-device engine's exact bits
+    (``post_recovery_bitwise_equal``)."""
+    from orp_tpu import guard
+    from orp_tpu.guard import DegradeManager, FaultPlan
+    from orp_tpu.parallel.mesh import largest_submesh, spec_of
+
+    import jax
+
+    if not 0 <= int(degrade_at) < int(n_requests):
+        # an out-of-range drill would inject NOTHING and still emit a
+        # healthy-looking record — refuse instead of lying
+        raise ValueError(
+            f"degrade_at={degrade_at} is outside the request stream "
+            f"[0, {n_requests}) — the loss would never be injected; raise "
+            "--degrade-requests or lower --degrade-at")
+    spec = spec_of(mesh)
+    if spec is None:
+        spec = largest_submesh(len(jax.devices()))
+    n_dev = 1 if spec is None else spec.n_devices
+    ref = HedgeEngine(policy)  # the healthy single-device bit oracle
+    nf = ref.model.n_features
+    rng = np.random.default_rng(seed)
+    feats = [(1.0 + 0.1 * rng.standard_normal((1, nf))).astype(np.float32)
+             for _ in range(n_requests)]
+    probe = (1.0 + 0.05 * np.random.default_rng(seed + 1)
+             .standard_normal((8, nf))).astype(np.float32)
+    ref_phi, ref_psi, _ = ref.evaluate(0, probe)
+    failed = 0
+    with DegradeManager(policy, mesh=spec) as mgr:
+        futures = []
+        surv = (n_dev - 1 if survivors is None else int(survivors))
+        plan = FaultPlan(device_loss={"serve/dispatch": 1}, survivors=surv)
+        for i, f in enumerate(feats):
+            if i == degrade_at:
+                # install the loss exactly at request N: the in-flight
+                # window around it is what the drill measures
+                with guard.faults(plan):
+                    futures.append(mgr.submit(i % ref.n_dates, f))
+                    # the faulted dispatch must FIRE inside the plan scope
+                    futures[-1].exception(timeout=120)
+            else:
+                futures.append(mgr.submit(i % ref.n_dates, f))
+        for fut in futures:
+            if fut.exception(timeout=120) is not None:
+                failed += 1
+        phi, psi, _ = mgr.evaluate(0, probe)
+        st = mgr.stats()
+    bitwise = bool(np.array_equal(phi, ref_phi)
+                   and np.array_equal(psi, ref_psi))
+    rec = st["recoveries"][0] if st["recoveries"] else {}
+    return {
+        "degrade_at": int(degrade_at),
+        "requests": int(n_requests),
+        "devices_before": n_dev,
+        "devices_after": st["mesh_devices"],
+        "mttr_ms": st["mttr_ms"],
+        "replayed": rec.get("replayed"),
+        "failed_during_window": failed,
+        "rebuild_xla_compiles": rec.get("rebuild_xla_compiles"),
+        "post_recovery_bitwise_equal": bitwise,
+    }
+
+
 def serve_bench(
     policy,
     *,
@@ -206,6 +278,9 @@ def serve_bench(
     mesh_sweep: tuple[int, ...] = (),
     mesh_sweep_rows: int = 1 << 15,
     mesh_sweep_repeats: int = 8,
+    degrade_at: int | None = None,
+    degrade_requests: int = 64,
+    degrade_survivors: int | None = None,
     previous: dict | None = None,
 ) -> dict:
     """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
@@ -220,6 +295,13 @@ def serve_bench(
     ``mesh_sweep`` (CLI ``--mesh-sweep``) appends the rows/s-by-mesh-size
     table — big-batch engine throughput per topology, served bits pinned
     equal across topologies.
+    ``degrade_at`` (CLI ``--degrade-at N``) appends the topology-degradation
+    drill: device loss injected at request N of a ``degrade_requests``
+    stream on the largest available mesh (or ``mesh``), recording the
+    drain→rebuild→replay MTTR, the failure count during the window (the
+    contract is zero — trapped requests replay), and a post-recovery
+    bits-equal pin against the healthy single-device engine; ``mttr_ms``
+    becomes a first-class record field.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
     synchronous-tier baseline forward as ``batcher_before``."""
     engine = HedgeEngine(policy, mesh=mesh)
@@ -309,6 +391,14 @@ def serve_bench(
         record["mesh_sweep"] = _mesh_sweep_phase(
             policy, mesh_sweep, rows=mesh_sweep_rows,
             repeats=mesh_sweep_repeats, seed=seed)
+    if degrade_at is not None:
+        drill = _degrade_drill(policy, degrade_at=degrade_at,
+                               n_requests=degrade_requests,
+                               survivors=degrade_survivors, mesh=mesh,
+                               seed=seed)
+        record["degrade"] = drill
+        # the headline resilience number, first-class like p99
+        record["mttr_ms"] = drill["mttr_ms"]
     if sweep:
         record["sweep"] = sweep
         record["batcher_sustained_requests_per_s"] = best["requests_per_s"]

@@ -107,6 +107,13 @@ class PendingEval:
         ``(phi, psi, value)`` host numpy arrays of the requested rows
         (``value`` None when the request carried no prices)."""
         n = self._n
+        inj = _inject.active()
+        if inj is not None:
+            # chaos harness: the BLOCK-time fault site — a hung execute is
+            # delay here past GuardPolicy.hard_wall_ms (the watchdog's
+            # prey), a block-surfaced transient is fail, a loss discovered
+            # at completion is device_loss
+            inj.fire("serve/execute", bucket=self.bucket)
         phi, psi, v = jax.block_until_ready((self._phi, self._psi, self._v))
         with span("serve/unpad"):
             phi = np.asarray(phi)[:n]
@@ -441,6 +448,34 @@ class HedgeEngine:
         self.aot_hits += 1
         self._breaker.record_success(b)
         return out
+
+    def watchdog_trip(self, bucket) -> None:
+        """A stuck-dispatch watchdog (``serve/health.py``) force-failed a
+        hung batch in ``bucket``: count it against the SAME circuit breaker
+        an execution failure feeds — a bucket whose serialized executable
+        hangs repeatedly is as demoted as one that raises repeatedly
+        (``guard/circuit_open``; jit for the process lifetime). Hangs keep
+        their OWN streak key (``hang:<bucket>``): a hang surfaces at BLOCK
+        time after a successful dispatch, so the dispatch-time
+        ``record_success`` would otherwise wipe the streak between two
+        consecutive hangs and the circuit could never open. A hang on a
+        jit bucket still counts (honest telemetry) but there is nothing to
+        demote."""
+        obs_count("guard/aot_exec_failure", bucket=str(bucket), kind="hang")
+        if self._breaker.record_failure(f"hang:{bucket}"):
+            self._aot.pop(bucket, None)
+            warnings.warn(
+                f"bucket {bucket} exceeded the dispatch hard wall "
+                f"{self._breaker.threshold} consecutive times; circuit "
+                "opened — bucket demoted to the jit path for this process",
+                stacklevel=3,
+            )
+
+    def watchdog_ok(self, bucket) -> None:
+        """The watchdog saw this bucket's block complete inside the wall:
+        break any hang streak — flakes never accumulate into a demotion,
+        the same contract ``record_success`` gives execution failures."""
+        self._breaker.record_success(f"hang:{bucket}")
 
     def prewarm(self, sizes) -> dict:
         """Pre-touch every bucket the given request sizes land in, so no

@@ -1,0 +1,256 @@
+"""Serving health: the stuck-dispatch watchdog + the ``orp doctor`` probe.
+
+Two failure classes the guard layer could not reach before this module:
+
+- **the hang** — every handled serve fault so far RAISES (transient
+  dispatch errors, AOT execution failures, injected chaos). A wedged
+  executable raises nothing: ``block_until_ready`` simply never returns,
+  the resolve stage stops resolving, and every queued request ages out
+  behind it. :class:`DispatchWatchdog` bounds the block — a batch that
+  exceeds ``GuardPolicy.hard_wall_ms`` is FORCE-FAILED with
+  :class:`~orp_tpu.guard.WatchdogTrip` (``guard/watchdog_trip``), the trip
+  feeds the engine's AOT circuit breaker (a bucket that hangs repeatedly is
+  demoted to jit exactly like one that raises repeatedly), and the
+  batcher's bounded block-time retry re-dispatches the rows through a path
+  that can answer. The waiter thread that was blocked is ABANDONED: XLA
+  execution cannot be cancelled, so "force-fail" honestly means "stop
+  waiting, leak the waiter" — which is also why the watchdog is opt-in.
+
+- **the broken pod** — a serve process that will not come up has one of a
+  short list of causes (no devices / wrong topology, unwritable compile
+  cache, stale or foreign bundle artifacts, unwritable telemetry sink), and
+  each surfaces as a deep stack trace from whichever layer hit it first.
+  :func:`doctor_report` (CLI ``orp doctor``) runs the whole list up front
+  and reports every finding in flag-speak — the first thing to run on a
+  broken pod, before any simulation or compile spend.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import TimeoutError as _FutureTimeoutError
+
+from orp_tpu.guard.serve import WatchdogTrip
+from orp_tpu.obs import count as obs_count
+
+
+class _BlockWorker:
+    """One daemon thread running blocking reads on the watchdog's behalf.
+
+    The resolve stage hands it ``fn`` (a device block) and waits on the
+    returned future with the hard-wall timeout; an abandoned worker (its
+    current ``fn`` hung) finishes or leaks with the hang — either way it
+    never touches a live watchdog again."""
+
+    __slots__ = ("_q", "thread", "dead")
+
+    def __init__(self):
+        import queue
+
+        self._q = queue.SimpleQueue()
+        self.dead = False
+        self.thread = threading.Thread(
+            target=self._run, name="orp-serve-watchdog", daemon=True)
+        self.thread.start()
+
+    def submit(self, fn):
+        from orp_tpu.serve.batcher import SlimFuture
+
+        fut = SlimFuture()
+        self._q.put((fn, fut))
+        return fut
+
+    def abandon(self):
+        self.dead = True
+        self._q.put(None)  # wakes an idle worker; a hung one exits on return
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None or self.dead:
+                return
+            fn, fut = item
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered through the future
+                fut.set_exception(e)
+            if self.dead:
+                return
+
+
+class DispatchWatchdog:
+    """Bound the resolve-stage block on an in-flight batch by a hard wall.
+
+    ``block(fn, tag)`` runs ``fn()`` (the pending batch's blocking result
+    read) on a helper thread and waits at most ``hard_wall_ms``. Inside the
+    wall it is transparent — the result or exception propagates unchanged,
+    and ``on_ok(tag)`` resets any hang streak. Past the wall it force-fails:
+    emits ``guard/watchdog_trip``, feeds ``on_trip(tag)`` (the engine's
+    circuit-breaker hook — ``HedgeEngine.watchdog_trip`` demotes a
+    repeatedly-hanging AOT bucket to jit), abandons the stuck helper and
+    raises :class:`WatchdogTrip` (a ``TransientDispatchError``: the
+    batcher's block-time retry policy applies).
+
+    One watchdog serves one batcher — the resolve stage is sequential, so
+    a single helper thread is enough until a trip orphans it.
+    """
+
+    def __init__(self, hard_wall_ms: float, *, on_trip=None, on_ok=None):
+        if hard_wall_ms <= 0:
+            raise ValueError(f"hard_wall_ms={hard_wall_ms} must be > 0")
+        self.hard_wall_s = float(hard_wall_ms) / 1e3
+        self.on_trip = on_trip
+        self.on_ok = on_ok
+        self.trips = 0
+        self._lock = threading.Lock()
+        self._worker: _BlockWorker | None = None
+
+    def block(self, fn, tag=None):
+        with self._lock:
+            w = self._worker
+            if w is None or w.dead:
+                w = _BlockWorker()
+                self._worker = w
+        fut = w.submit(fn)
+        try:
+            out = fut.result(timeout=self.hard_wall_s)
+        except _FutureTimeoutError:
+            with self._lock:
+                self.trips += 1
+                if self._worker is w:
+                    self._worker = None
+            w.abandon()
+            obs_count("guard/watchdog_trip", key=str(tag))
+            if self.on_trip is not None:
+                self.on_trip(tag)
+            raise WatchdogTrip(
+                f"in-flight batch (tag={tag}) exceeded the "
+                f"{self.hard_wall_s * 1e3:.0f}ms dispatch hard wall; "
+                "force-failed (the stuck waiter is abandoned)"
+            ) from None
+        if self.on_ok is not None:
+            self.on_ok(tag)
+        return out
+
+    def close(self):
+        with self._lock:
+            w, self._worker = self._worker, None
+        if w is not None:
+            w.abandon()
+
+
+# -- orp doctor ---------------------------------------------------------------
+
+
+def _check(checks: list, name: str, ok: bool, detail: str,
+           fix: str | None = None) -> bool:
+    checks.append({"check": name, "ok": bool(ok), "detail": detail,
+                   **({"fix": fix} if fix and not ok else {})})
+    return bool(ok)
+
+
+def _dir_writable(d) -> tuple[bool, str]:
+    import os
+    import pathlib
+    import tempfile
+
+    p = pathlib.Path(d)
+    try:
+        p.mkdir(parents=True, exist_ok=True)
+        with tempfile.NamedTemporaryFile(dir=p, prefix=".orp_doctor_") as f:
+            f.write(b"ok")
+        return True, f"{p} is writable"
+    except OSError as e:
+        return False, f"{p}: {os.strerror(e.errno) if e.errno else e}"
+
+
+def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
+                  telemetry_dir=None) -> dict:
+    """One-shot environment/bundle self-check — the first thing to run on a
+    broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
+    row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
+    flag-speak (the CLI flag or command that repairs it).
+
+    ``bundle_dir``  — optionally verify a policy bundle: format/fingerprint/
+    policy-step digest (a full ``load_bundle``) plus its AOT topology
+    coverage for THIS process's topology (``mesh`` — None = single device).
+    ``cache_dir``   — persistent-compile-cache dir to probe (default: the
+    ``enable_persistent_cache`` resolution: env ``ORP_JAX_CACHE_DIR``, else
+    the repo ``.jax_cache``).
+    ``telemetry_dir`` — optionally probe the obs sink target for
+    ``--telemetry DIR`` runs.
+    """
+    checks: list[dict] = []
+    # 1) devices + topology fingerprint: everything downstream keys on this
+    try:
+        import jax
+
+        from orp_tpu.parallel.mesh import topology_fingerprint
+
+        devs = jax.devices()
+        n_want = None if mesh in (None, 0) else int(mesh)
+        ok = n_want is None or n_want <= len(devs)
+        # fingerprint the topology actually buildable HERE: an oversized
+        # --mesh is its own (flag-speak) failure, not a backend crash
+        topo = topology_fingerprint(None if (n_want in (None, 1) or not ok)
+                                    else n_want)
+        _check(checks, "devices", ok,
+               f"{len(devs)} x {devs[0].device_kind} ({devs[0].platform}); "
+               f"topology {topo}",
+               fix=(f"--mesh {n_want} exceeds the {len(devs)} visible "
+                    "devices — shrink --mesh or fix device visibility "
+                    "(JAX_PLATFORMS / plugin init)" if not ok else None))
+    except Exception as e:  # orp: noqa[ORP009] -- the report IS the emission: the probe failure becomes a failing check row the CLI prints
+        _check(checks, "devices", False, f"{type(e).__name__}: {e}",
+               fix="no JAX backend came up — check JAX_PLATFORMS and the "
+                   "accelerator plugin/tunnel before anything else")
+        topo = None
+    # 2) persistent compile cache: unwritable -> every cold start pays the
+    # full compile bill again (orp warm / --aot are no-ops)
+    from orp_tpu.aot.cache import resolve_cache_dir
+
+    cdir = resolve_cache_dir(cache_dir)
+    if cdir is None:
+        _check(checks, "compile_cache", True,
+               "disabled by ORP_TESTS_NO_COMPILE_CACHE (kill-switch)")
+    else:
+        ok, detail = _dir_writable(cdir)
+        _check(checks, "compile_cache", ok, detail,
+               fix="point ORP_JAX_CACHE_DIR (or orp warm --cache-dir) at a "
+                   "writable directory")
+    # 3) the bundle: format gate, fingerprint, policy-step integrity digest
+    if bundle_dir is not None:
+        from orp_tpu.serve.bundle import load_bundle
+
+        bundle = None
+        try:
+            bundle = load_bundle(bundle_dir)
+            _check(checks, "bundle", True,
+                   f"{bundle_dir}: {bundle.n_dates} dates, "
+                   f"fingerprint {bundle.fingerprint[:12]}…")
+        except (ValueError, OSError) as e:
+            _check(checks, "bundle", False, str(e),
+                   fix="re-export with `orp export --out DIR` (plus --aot "
+                       "for serialized executables)")
+        # 4) AOT coverage for THIS topology (only meaningful on a loadable
+        # bundle; a jit fallback is safe but pays cold compiles)
+        if bundle is not None:
+            from orp_tpu.aot.bundle_exec import aot_status
+
+            st = aot_status(bundle_dir, mesh=mesh)
+            if not st["present"]:
+                _check(checks, "bundle_aot", True,
+                       "no AOT artifacts (jit serving; cold starts compile)")
+            else:
+                _check(checks, "bundle_aot", st["ok"],
+                       st["detail"],
+                       fix="re-export the executables for this topology: "
+                           "`orp export --aot --aot-mesh "
+                           f"{1 if mesh in (None, 0) else int(mesh)}`")
+    # 5) obs sink target
+    if telemetry_dir is not None:
+        ok, detail = _dir_writable(telemetry_dir)
+        _check(checks, "telemetry_sink", ok, detail,
+               fix="--telemetry DIR must name a writable directory "
+                   "(events.jsonl streams live)")
+    return {"ok": all(c["ok"] for c in checks), "checks": checks}

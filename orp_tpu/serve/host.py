@@ -40,7 +40,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import warnings
 
+import numpy as np
+
+from orp_tpu.guard import inject as _inject
 from orp_tpu.guard.serve import GuardPolicy, Rejection
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import state as obs_state
@@ -79,12 +83,19 @@ def burn_rate(histogram, slo: SloPolicy) -> float:
     return histogram.fraction_over(slo.latency_slo_ms / 1e3) / slo.error_budget
 
 
+class CanaryRejected(RuntimeError):
+    """A hot bundle reload failed its canary gate: the candidate engine did
+    not reproduce the serving tenant's pinned probe rows (or went
+    non-finite). The tenant was NOT touched — it keeps serving the old
+    bundle's bits; the reject is the rollback."""
+
+
 class _Tenant:
     """One hosted policy: retained source + (while live) engine/batcher."""
 
     __slots__ = ("name", "source", "policy", "max_pending", "slo",
                  "engine", "batcher", "metrics", "pending", "activations",
-                 "last_used", "build_lock", "in_submit")
+                 "last_used", "build_lock", "in_submit", "version")
 
     def __init__(self, name, source, policy, max_pending, slo):
         self.name = name
@@ -100,6 +111,7 @@ class _Tenant:
         self.last_used = 0.0
         self.in_submit = 0            # submits between claim and enqueue —
         # eviction never unlinks a tenant mid-submit (host-lock guarded)
+        self.version = 1              # bumped by every canary-passed reload
         # serializes THIS tenant's engine build without the host lock: a
         # cold start (bundle load + engine construction + possible jit
         # compiles) must never head-of-line-block other tenants' submits
@@ -133,6 +145,10 @@ class ServeHost:
         self.engine_kwargs = dict(engine_kwargs or {})
         self.batcher_kwargs = dict(batcher_kwargs or {})
         self._lock = threading.RLock()
+        # rides the host lock: reload's atomic swap waits on it for a
+        # tenant's in-flight submit claims to clear (notified by submit's
+        # release path when a tenant's count hits zero)
+        self._swap_cv = threading.Condition(self._lock)
         # pending counts live under their OWN lock: future done-callbacks
         # fire on the batcher worker thread, and an eviction drains that
         # worker while holding the host lock — a callback that needed the
@@ -306,10 +322,182 @@ class ServeHost:
         finally:
             with self._lock:
                 t.in_submit -= 1
+                if t.in_submit == 0:
+                    # a reload swap may be parked on this count (notify on
+                    # the shared host lock: nanoseconds with no waiters)
+                    self._swap_cv.notify_all()
 
     def _request_done(self, t: _Tenant) -> None:
         with self._pending_lock:
             t.pending -= 1
+
+    # -- hot reload ----------------------------------------------------------
+
+    def reload_tenant(self, name: str, source=None, *, canary_rows: int = 8,
+                      require_same_bits: bool = True) -> dict:
+        """Versioned hot bundle swap with a canary gate; the tenant never
+        stops serving.
+
+        ``source`` — the candidate bundle dir / in-memory policy (None =
+        reload the tenant's CURRENT source: the artifact-refresh shape,
+        e.g. a re-export that added AOT sets). The candidate engine is
+        built OFF-TRAFFIC and must reproduce the serving engine's pinned
+        probe rows — ``canary_rows`` deterministic feature rows at the
+        first and last rebalance dates, BITWISE (the serve forward is
+        deterministic per policy, so any flipped bit is a wrong candidate:
+        corrupted params, foreign bundle, broken artifact) — before it
+        takes traffic. A candidate that fails raises
+        :class:`CanaryRejected` and emits ``guard/canary_reject``; the
+        tenant keeps serving the old bundle's bits untouched (the reject IS
+        the rollback — nothing was swapped).
+
+        ``require_same_bits=False`` relaxes the gate to finiteness only —
+        the knob for rolling a genuinely RETRAINED policy, where different
+        bits are the point.
+
+        On a pass: the new batcher is installed atomically (the swap waits
+        for in-flight submit claims, so no request lands on a dead
+        batcher), the old one drains OUTSIDE every lock — queued requests
+        still resolve through the old engine, shed policies still apply —
+        and the tenant's version bumps (``serve/bundle_swap``).
+        """
+        with self._lock:
+            if name not in self._tenants:
+                raise KeyError(f"unknown tenant {name!r}; registered: "
+                               f"{sorted(self._tenants)}")
+        # the OLD engine's bits are the canary pin: activate if cold, then
+        # CLAIM the tenant (in_submit, the same token a submit holds) so a
+        # concurrent activation's LRU sweep cannot evict it — and null
+        # t.engine — between the activation and the probe evaluations.
+        # Bounded like submit's claim loop: the only way to lose is an
+        # eviction slipping between the two locks.
+        for _ in range(16):
+            t, batcher_live, evicted = self._activate(name)
+            with self._lock:
+                claimed = t.batcher is batcher_live and t.engine is not None
+                if claimed:
+                    t.in_submit += 1
+                    old_engine = t.engine
+            for victim in evicted:
+                victim.close()  # outside every lock, as always
+            if claimed:
+                break
+        else:  # pragma: no cover - needs pathological eviction churn
+            raise RuntimeError(
+                f"tenant {name!r}: could not pin a live engine for the "
+                "canary (eviction churn; raise max_live_engines)")
+        try:
+            nf = old_engine.model.n_features
+            # deterministic probe rows near the training normalisation;
+            # first and last dates catch a torn per-date params axis at
+            # both ends
+            probe = (1.0 + 0.05 * np.random.default_rng(7)
+                     .standard_normal((int(canary_rows), nf))
+                     ).astype(np.float32)
+            dates = sorted({0, old_engine.n_dates - 1})
+            pinned = [old_engine.evaluate(d, probe) for d in dates]
+        finally:
+            # release BEFORE the candidate build + swap: the swap below
+            # waits for in_submit to clear, and holding our own claim
+            # across it would deadlock on ourselves
+            with self._lock:
+                t.in_submit -= 1
+                if t.in_submit == 0:
+                    self._swap_cv.notify_all()
+        # load + build the candidate OUTSIDE every host lock (a reload must
+        # never head-of-line-block serving; the ORP012 discipline)
+        new_source = t.source if source is None else source
+        policy = new_source
+        if (isinstance(policy, (str, bytes))
+                or hasattr(policy, "__fspath__")):
+            from orp_tpu.serve.bundle import load_bundle
+
+            try:
+                policy = load_bundle(policy)
+            except (ValueError, OSError) as e:
+                obs_count("guard/canary_reject", tenant=name, stage="load")
+                raise CanaryRejected(
+                    f"tenant {name!r}: candidate bundle failed to load "
+                    f"({e}); serving is untouched") from e
+        inj = _inject.active()
+        if inj is not None:
+            # chaos harness (guard/inject.py): bundle corruption mid-reload
+            # — the bytes passed every on-disk digest, the in-memory object
+            # is wrong; the canary below is the only gate left
+            policy = inj.corrupt_policy(policy)
+        with t.build_lock:  # orp: noqa[ORP012] -- build_lock is the per-tenant BUILD serializer (vs a racing activation), not a batcher/host lock; nothing drains or serves under it
+            engine = HedgeEngine(policy, **self.engine_kwargs)
+            for d, (pphi, ppsi, _pv) in zip(dates, pinned):
+                phi, psi, _v = engine.evaluate(d, probe)
+                if not (np.isfinite(phi).all() and np.isfinite(psi).all()):
+                    self._canary_reject(name, f"non-finite outputs at date "
+                                              f"{d}")
+                if require_same_bits and not (
+                        np.array_equal(phi, pphi)
+                        and np.array_equal(psi, ppsi)):
+                    self._canary_reject(
+                        name, f"probe bits diverged at date {d} "
+                              "(corrupted or foreign candidate)")
+            batcher = MicroBatcher(engine, metrics=t.metrics,
+                                   policy=t.policy, **self.batcher_kwargs)
+        stalled = False
+        evicted2: list = []
+        with self._lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                # atomic swap: wait out in-flight submit claims so none
+                # lands on the batcher being retired (bounded — a claim
+                # spans two lock acquisitions, not a request lifetime)
+                deadline = time.perf_counter() + 5.0
+                while t.in_submit and time.perf_counter() < deadline:
+                    self._swap_cv.wait(timeout=0.05)
+                if t.in_submit:
+                    # a claim outlived the whole wait (pathological stall):
+                    # swapping anyway would retire a batcher that claim is
+                    # about to enqueue on — refuse LOUDLY and keep serving
+                    # the old bundle; the reload is retryable
+                    stalled = True
+                else:
+                    old_batcher = t.batcher
+                    t.batcher = batcher
+                    t.engine = engine
+                    t.source = new_source
+                    t.version += 1
+                    version = t.version
+                    # the tenant may have been EVICTED between the canary
+                    # and this swap — installing counts as an activation,
+                    # so the cap sweep runs like one
+                    evicted2 = self._sweep_locked(t)
+        if closed or stalled:
+            batcher.close()
+            if closed:
+                raise RuntimeError("ServeHost is closed")
+            obs_count("guard/reload_stalled", tenant=name)
+            raise RuntimeError(
+                f"tenant {name!r}: an in-flight submit claim outlived the "
+                "5s swap window; reload aborted (the tenant keeps serving "
+                "the previous bundle — retry the reload)")
+        obs_count("serve/bundle_swap", tenant=name)
+        for victim in (*evicted2, *(() if old_batcher is None
+                                    else (old_batcher,))):
+            # drain OUTSIDE every lock: the old queue resolves through the
+            # old engine (guard sheds still apply), done-callbacks may
+            # re-enter the host
+            victim.close()
+        return {"tenant": name, "version": version, "swapped": True,
+                "canary_rows": int(canary_rows), "canary_dates": dates,
+                "require_same_bits": bool(require_same_bits)}
+
+    def _canary_reject(self, name: str, why: str):
+        obs_count("guard/canary_reject", tenant=name, stage="bits")
+        warnings.warn(
+            f"hot reload of tenant {name!r} REJECTED by the canary gate "
+            f"({why}); the tenant keeps serving the previous bundle",
+            stacklevel=3,
+        )
+        raise CanaryRejected(f"tenant {name!r}: {why}; serving is untouched")
 
     def evaluate(self, tenant: str, date_idx: int, states, prices=None):
         """Synchronous convenience: ``submit(...).result()``."""
@@ -327,6 +515,7 @@ class ServeHost:
                     "pending": t.pending,
                     "activations": t.activations,
                     "max_pending": t.max_pending,
+                    "version": t.version,
                     **({"summary": t.metrics.summary()}
                        if t.metrics is not None else {}),
                 }

@@ -778,7 +778,12 @@ def _walk_impl(
     p1_snaps, p2_snaps = [], []  # per-date trained params, walk order
 
     # resume from the last completed date if a checkpoint exists (SURVEY.md §5:
-    # the reference can only rerun by hand; here a preempted TPU job continues)
+    # the reference can only rerun by hand; here a preempted TPU job continues).
+    # The on-disk layout is TOPOLOGY-FREE (utils/checkpoint.py normalises
+    # leaves to host numpy), and mesh is deliberately NOT in the fingerprint:
+    # a walk checkpointed on an 8-device mesh resumes on whatever topology
+    # this process has — bitwise-equal ledgers for adam, reduction-order
+    # band for GN (tests/test_guard.py::test_resume_across_topology*)
     start_step = 0
     if cfg.checkpoint_dir is not None:
         from orp_tpu.utils import checkpoint as ckpt

@@ -81,8 +81,20 @@ def save_checkpoint(directory: str | pathlib.Path, step: int, state) -> None:
     """Persist ``state`` (any pytree of arrays/scalars) under ``step``,
     plus its integrity digest side file (written atomically AFTER orbax
     finalises the step: a digest must never exist for a payload that
-    didn't fully commit)."""
-    state = jax.tree.map(jax.numpy.asarray, state)
+    didn't fully commit).
+
+    Leaves are normalised to HOST numpy first, so the on-disk layout is
+    TOPOLOGY-FREE: a step saved from an 8-device path-sharded walk restores
+    identically on one device (orbax would otherwise persist the sharding
+    and warn — correctly — that restoring on a different topology is
+    unsafe). This is what lets a preempted pod slice ``--resume`` on
+    whatever hardware survives (pinned bitwise for adam in
+    ``tests/test_guard.py::test_resume_across_topology``); the gather costs
+    nothing new — the integrity digest below already reads every leaf's
+    host bytes."""
+    import numpy as np
+
+    state = jax.tree.map(np.asarray, state)
     with _manager(directory) as mgr:
         if step in mgr.all_steps():
             # redoing an existing step (e.g. a torn save whose digest never

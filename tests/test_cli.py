@@ -222,3 +222,18 @@ def test_lint_clean_tree_and_json_contract(tmp_path, capsys, monkeypatch):
         with pytest.raises(SystemExit) as e:
             cli.main(argv)
         assert e.value.code == 2
+
+
+def test_doctor_json_and_failure_exit(tmp_path, capsys):
+    # healthy environment: every check ok, exit 0
+    cli.main(["doctor", "--telemetry-dir", str(tmp_path / "obs"), "--json"])
+    rep = json.loads(capsys.readouterr().out.strip())
+    assert rep["ok"] and {c["check"] for c in rep["checks"]} >= {
+        "devices", "compile_cache", "telemetry_sink"}
+    # a directory that is not a bundle: flag-speak fix + exit 1
+    with pytest.raises(SystemExit) as e:
+        cli.main(["doctor", "--bundle", str(tmp_path / "nope"), "--json"])
+    assert e.value.code == 1
+    rep = json.loads(capsys.readouterr().out.strip())
+    bundle_row = next(c for c in rep["checks"] if c["check"] == "bundle")
+    assert not bundle_row["ok"] and "orp export" in bundle_row["fix"]

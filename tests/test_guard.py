@@ -16,9 +16,11 @@ import pytest
 
 from orp_tpu import guard, obs
 from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
-from orp_tpu.guard import (CircuitBreaker, FaultInjector, FaultPlan,
-                           GuardPolicy, TransientDispatchError, is_rejection)
+from orp_tpu.guard import (CircuitBreaker, DegradeManager, FaultInjector,
+                           FaultPlan, GuardPolicy, TransientDispatchError,
+                           is_rejection)
 from orp_tpu.models import HedgeMLP
+from orp_tpu.parallel.mesh import make_mesh, shard_paths
 from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
 from orp_tpu.serve import HedgeEngine, MicroBatcher, export_bundle, load_bundle
 from orp_tpu.train import BackwardConfig, backward_induction
@@ -657,6 +659,325 @@ def test_guard_policy_validation():
     p = GuardPolicy(backoff_ms=2.0, backoff_cap_ms=3.0)
     assert p.backoff_s(1) == pytest.approx(0.002)
     assert p.backoff_s(5) == pytest.approx(0.003)  # capped
+
+
+# -- topology degradation: device loss, watchdog, canary reload ---------------
+#
+# The PR-9 acceptance bar: every fault below is *topology-level* — lose a
+# device out of the mesh, hang an executable past its hard wall, swap a
+# corrupted bundle under load — and the system must degrade the way the AOT
+# layer degrades on fingerprint mismatch: detect, reshard/demote/rollback,
+# and keep answering THE SAME BITS. No test sleeps longer than 50ms.
+
+
+@pytest.fixture(scope="module")
+def topo_aot_bundle(tmp_path_factory, trained):
+    """A bundle shipping executable sets for the healthy 8-device mesh, the
+    degraded 4-device submesh AND single-device — the artifact a
+    degradation-tolerant fleet deploys (losing a device must not cost a
+    recompile)."""
+    from orp_tpu.aot import export_aot
+    from orp_tpu.parallel.mesh import MeshSpec
+
+    d = tmp_path_factory.mktemp("topo_bundle") / "bundle"
+    export_bundle(trained, d)
+    export_aot(d, load_bundle(d), buckets=(8,),
+               meshes=(None, MeshSpec(4), MeshSpec(8)))
+    return load_bundle(d)
+
+
+def test_largest_submesh_prefers_power_of_two():
+    from orp_tpu.parallel.mesh import largest_submesh
+
+    assert largest_submesh(8).n_devices == 8
+    assert largest_submesh(7).n_devices == 4  # lose 1 of 8 -> rebuild on 4
+    assert largest_submesh(2).n_devices == 2
+    assert largest_submesh(1) is None         # single device = no mesh
+    with pytest.raises(ValueError, match="survive"):
+        largest_submesh(0)
+
+
+def test_device_loss_rebuilds_on_surviving_submesh_bits_equal(topo_aot_bundle):
+    """Injected device loss on the 8-device mesh: the in-flight request is
+    TRAPPED and replayed (never errored), the engine rebuilds on the
+    4-device surviving submesh with ZERO XLA compiles (the bundle ships
+    that topology's AOT set), and every answer — healthy, replayed,
+    post-recovery — is bitwise the single-device engine's."""
+    ref = HedgeEngine(topo_aot_bundle, use_aot=False)
+    feats = _rows(4, topo_aot_bundle.model.n_features)
+    ref_phi, ref_psi, _ = ref.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with DegradeManager(topo_aot_bundle, mesh=8) as mgr:
+            healthy = mgr.evaluate(0, feats)
+            with guard.faults(FaultPlan(device_loss={"serve/dispatch": 1},
+                                        survivors=7)) as inj:
+                replayed = mgr.evaluate(0, feats)
+            recovered = mgr.evaluate(0, feats)
+            st = mgr.stats()
+    assert [site for site, _ in inj.log] == ["serve/dispatch"]
+    for phi, psi, _ in (healthy, replayed, recovered):
+        np.testing.assert_array_equal(phi, ref_phi)
+        np.testing.assert_array_equal(psi, ref_psi)
+    assert st["mesh_devices"] == 4  # largest shard-divisible survivor of 7
+    assert st["mttr_ms"] is not None and st["mttr_ms"] > 0
+    [rec] = st["recoveries"]
+    assert rec["from_devices"] == 8 and rec["to_devices"] == 4
+    assert rec["replayed"] == 1 and rec["replay_unresolved"] == 0
+    # the zero-compile claim: the degraded topology's executables shipped
+    assert rec["rebuild_xla_compiles"] == 0
+    assert reg.counter("guard/device_loss", {"survivors": "7"}).value == 1
+    assert reg.counter("guard/topology_rebuild",
+                       {"from_devices": "8", "to_devices": "4"}).value == 1
+
+
+def test_device_loss_without_mesh_rebuilds_single_device(topo_aot_bundle):
+    """The degenerate topology: a single-device manager survives a loss
+    report by rebuilding single-device (there is nothing smaller) and keeps
+    serving the same bits."""
+    ref = HedgeEngine(topo_aot_bundle, use_aot=False)
+    feats = _rows(2, topo_aot_bundle.model.n_features)
+    ref_phi, _, _ = ref.evaluate(0, feats)
+    with DegradeManager(topo_aot_bundle) as mgr:
+        with guard.faults(FaultPlan(device_loss={"serve/dispatch": 1},
+                                    survivors=1)):
+            phi, _, _ = mgr.evaluate(0, feats)
+        np.testing.assert_array_equal(phi, ref_phi)
+        assert mgr.stats()["mesh_devices"] == 1
+
+
+def test_watchdog_trips_feed_breaker_and_demote(aot_bundle, recwarn):
+    """Hung execute: two consecutive hangs past the 10ms hard wall trip the
+    watchdog twice (guard/watchdog_trip), open the hang circuit and demote
+    the bucket's AOT executable to jit — after which the next request is
+    served, bitwise the pure-jit engine's."""
+    jit_engine = HedgeEngine(aot_bundle, use_aot=False)
+    engine = HedgeEngine(aot_bundle, aot_failure_threshold=2)
+    assert engine.cache_info()["aot_buckets"] == [8]
+    feats = _rows(2, aot_bundle.model.n_features)
+    ref_phi, ref_psi, _ = jit_engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/execute": (2, 0.04)})) as inj:
+            with MicroBatcher(engine, max_wait_us=200.0,
+                              policy=GuardPolicy(max_retries=1,
+                                                 backoff_ms=1.0,
+                                                 hard_wall_ms=10.0)) as mb:
+                doomed = mb.submit(0, feats)
+                # hang #1 trips; the block-time retry re-dispatches; hang #2
+                # trips again (opening the circuit) and force-fails the
+                # request — a watchdog bounds latency, it cannot conjure
+                # the answer a hung executable never produced
+                with pytest.raises(guard.WatchdogTrip):
+                    doomed.result(timeout=30)
+                served = mb.evaluate(0, feats)  # post-demotion: jit path
+    assert len(inj.log) == 2  # both hangs fired at serve/execute
+    np.testing.assert_array_equal(served[0], ref_phi)
+    np.testing.assert_array_equal(served[1], ref_psi)
+    ci = engine.cache_info()
+    assert ci["aot_circuit_open"] == ["hang:8"]
+    assert ci["aot_buckets"] == []  # demoted for the process lifetime
+    assert reg.counter("guard/watchdog_trip", {"key": "8"}).value == 2
+    assert reg.counter("guard/circuit_open",
+                       {"aot_bucket": "hang:8"}).value == 1
+    assert any("hard wall" in str(w.message) for w in recwarn.list)
+
+
+def test_watchdog_recovers_transient_hang(trained):
+    """ONE hang then a healthy device: the trip force-fails the first block,
+    the bounded retry re-dispatches, the request is SERVED — and a single
+    flake never opens the circuit."""
+    engine = HedgeEngine(trained)
+    engine.prewarm([2])
+    feats = _rows(2, trained.model.n_features)
+    ref_phi, _, _ = engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/execute": (1, 0.04)})):
+            with MicroBatcher(engine, max_wait_us=200.0,
+                              policy=GuardPolicy(max_retries=1,
+                                                 backoff_ms=1.0,
+                                                 hard_wall_ms=10.0)) as mb:
+                phi, psi, _ = mb.evaluate(0, feats)
+    np.testing.assert_array_equal(phi, ref_phi)
+    assert reg.counter("guard/watchdog_trip", {"key": "8"}).value == 1
+    assert engine.cache_info()["aot_circuit_open"] == []
+
+
+def test_canary_reject_rolls_back_serving_old_bundle_bits(tmp_path, trained,
+                                                          recwarn):
+    """Bundle corruption mid-reload: the candidate passes every on-disk
+    digest (the corruption is in-memory, past the load), the canary gate
+    catches the diverged probe bits, the reload raises CanaryRejected +
+    guard/canary_reject — and the tenant keeps serving the OLD bundle's
+    bits throughout. A clean reload then passes and bumps the version."""
+    from orp_tpu.serve import CanaryRejected, ServeHost
+
+    d = tmp_path / "bundle"
+    export_bundle(trained, d)
+    feats = _rows(3, trained.model.n_features)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with ServeHost(registry=reg) as host:
+            host.add_tenant("t", d)
+            before = host.evaluate("t", 0, feats)
+            with guard.faults(FaultPlan(corrupt_reload=1)) as inj:
+                with pytest.raises(CanaryRejected, match="probe bits"):
+                    host.reload_tenant("t")
+            assert [s for s, _ in inj.log] == ["serve/bundle_reload"]
+            during = host.evaluate("t", 0, feats)  # rollback = untouched
+            assert host.stats()["t"]["version"] == 1
+            rep = host.reload_tenant("t")          # clean artifact passes
+            after = host.evaluate("t", 0, feats)
+    np.testing.assert_array_equal(before[0], during[0])
+    np.testing.assert_array_equal(before[0], after[0])
+    assert rep["swapped"] and rep["version"] == 2
+    assert host.stats()["t"]["version"] == 2
+    assert reg.counter("guard/canary_reject",
+                       {"tenant": "t", "stage": "bits"}).value == 1
+    assert reg.counter("serve/bundle_swap", {"tenant": "t"}).value == 1
+    assert any("REJECTED by the canary" in str(w.message)
+               for w in recwarn.list)
+
+
+def test_reload_unloadable_candidate_leaves_tenant_serving(tmp_path, trained):
+    """A candidate directory that is not even a bundle refuses at the load
+    stage (guard/canary_reject{stage=load}) — and the tenant still serves."""
+    from orp_tpu.serve import CanaryRejected, ServeHost
+
+    d = tmp_path / "bundle"
+    export_bundle(trained, d)
+    feats = _rows(2, trained.model.n_features)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with ServeHost(registry=reg) as host:
+            host.add_tenant("t", d)
+            before = host.evaluate("t", 0, feats)
+            with pytest.raises(CanaryRejected, match="failed to load"):
+                host.reload_tenant("t", tmp_path / "not_a_bundle")
+            after = host.evaluate("t", 0, feats)
+    np.testing.assert_array_equal(before[0], after[0])
+    assert reg.counter("guard/canary_reject",
+                       {"tenant": "t", "stage": "load"}).value == 1
+
+
+def test_degrade_persistent_loss_bounded_not_livelocked(topo_aot_bundle):
+    """A loss that PERSISTS through recovery (every replay re-traps) must
+    not live-lock the recovery loop: replay_timeout_s bounds the WHOLE
+    replay — resubmissions included — after which trapped requests FAIL
+    to their callers with a DeviceLostError and the manager stays usable."""
+    from orp_tpu.guard import DeviceLostError
+
+    ref = HedgeEngine(topo_aot_bundle, use_aot=False)
+    feats = _rows(2, topo_aot_bundle.model.n_features)
+    ref_phi, _, _ = ref.evaluate(0, feats)
+    with DegradeManager(topo_aot_bundle, mesh=8,
+                        replay_timeout_s=0.2) as mgr:
+        # a huge budget: the loss outlives the recovery window
+        with guard.faults(FaultPlan(device_loss={"serve/dispatch": 1000},
+                                    survivors=7)):
+            fut = mgr.submit(0, feats)
+            with pytest.raises(DeviceLostError, match="replay window"):
+                fut.result(timeout=30)
+        # the plan is gone: the manager answers again on the degraded mesh
+        phi, _, _ = mgr.evaluate(0, feats)
+        np.testing.assert_array_equal(phi, ref_phi)
+        st = mgr.stats()
+        assert not st["recovering"] and st["pending_replay"] == 0
+
+
+def test_degrade_clean_path_zero_guard_events(topo_aot_bundle):
+    """The degradation acceptance bar, same discipline as every guard
+    layer before it: manager + watchdog armed, NOTHING injected -> zero
+    guard events, no recovery, bits equal to the plain engine."""
+    ref = HedgeEngine(topo_aot_bundle, use_aot=False)
+    feats = _rows(3, topo_aot_bundle.model.n_features)
+    ref_phi, ref_psi, _ = ref.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with DegradeManager(
+                topo_aot_bundle, mesh=8,
+                guard_policy=GuardPolicy(hard_wall_ms=5000.0)) as mgr:
+            phi, psi, _ = mgr.evaluate(0, feats)
+            st = mgr.stats()
+    np.testing.assert_array_equal(phi, ref_phi)
+    np.testing.assert_array_equal(psi, ref_psi)
+    assert st["recoveries"] == [] and st["mesh_devices"] == 8
+    assert [e for e in sink.events
+            if e.get("name", "").startswith("guard/")] == []
+
+
+def test_serve_bench_degrade_drill_record(topo_aot_bundle):
+    """The drill mode the committed BENCH_serve.json record runs: device
+    loss at request N, MTTR recorded, zero failures in the window, bits
+    pinned post-recovery."""
+    from orp_tpu.serve.bench import _degrade_drill
+
+    drill = _degrade_drill(topo_aot_bundle, degrade_at=3, n_requests=8,
+                           survivors=None, mesh=8, seed=0)
+    assert drill["devices_before"] == 8 and drill["devices_after"] == 4
+    assert drill["mttr_ms"] > 0
+    assert drill["failed_during_window"] == 0  # trapped requests REPLAY
+    assert drill["replayed"] >= 1
+    assert drill["rebuild_xla_compiles"] == 0
+    assert drill["post_recovery_bitwise_equal"]
+
+
+# -- topology-independent resume: preempted pod slice, surviving hardware -----
+
+
+def test_resume_across_topology_bitwise(tmp_path):
+    """A walk checkpointed on the 8-device mesh, killed after date k, then
+    resumed SINGLE-DEVICE yields ledgers BITWISE-equal to an uninterrupted
+    single-device run (adam) — the on-disk layout is topology-free
+    (utils/checkpoint.py) and mesh is deliberately not in the resume
+    fingerprint, so a preempted pod slice resumes on whatever survives."""
+    args = _setup()
+    full = _walk(args)  # the single-device uninterrupted reference
+    model, feats, y, b, term = args
+    mesh = make_mesh(8)
+    sf, sy, st = shard_paths((feats, y, term), mesh)
+    ckdir = str(tmp_path / "topo_ck")
+    with guard.faults(FaultPlan(kill_after_step=1)):
+        with pytest.raises(guard.WalkKilled):
+            backward_induction(model, sf, sy, b, st,
+                               BackwardConfig(**BASE, checkpoint_dir=ckdir),
+                               mesh=mesh)
+    assert latest_step(ckdir) == 1
+    resumed = _walk(args, checkpoint_dir=ckdir)  # 1-device resume
+    for name in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, name)),
+            np.asarray(getattr(resumed, name)), err_msg=name)
+    _tree_equal(full.params1_by_date, resumed.params1_by_date)
+
+
+def test_resume_across_topology_gn_band(tmp_path):
+    """Same 8-dev-checkpoint -> 1-dev resume under Gauss-Newton: the mesh
+    lowers the Gram/rhs reductions to per-shard partials + psum, so the
+    mesh-computed dates differ from single-device by reduction order
+    (~1 f32 ulp per date, compounding through the warm-start chain) — a
+    tight relative band, not bitwise (the adam test above carries the
+    bitwise pin)."""
+    args = _setup()
+    gn = dict(optimizer="gauss_newton", gn_iters_first=8, gn_iters_warm=4)
+    full = _walk(args, **gn)
+    model, feats, y, b, term = args
+    mesh = make_mesh(8)
+    sf, sy, st = shard_paths((feats, y, term), mesh)
+    ckdir = str(tmp_path / "topo_gn")
+    with guard.faults(FaultPlan(kill_after_step=1)):
+        with pytest.raises(guard.WalkKilled):
+            backward_induction(model, sf, sy, b, st,
+                               BackwardConfig(**{**BASE, **gn},
+                                              checkpoint_dir=ckdir),
+                               mesh=mesh)
+    resumed = _walk(args, checkpoint_dir=ckdir, **gn)
+    for name in ("values", "phi", "psi", "var_residuals"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(full, name)),
+            np.asarray(getattr(resumed, name)),
+            rtol=5e-5, atol=5e-5, err_msg=name)
 
 
 # -- atomic side files + CLI resume ------------------------------------------

@@ -25,7 +25,7 @@ def codes(src, **kw):
 
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
-                          | {"ORP010", "ORP011"})
+                          | {"ORP010", "ORP011", "ORP012"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -725,6 +725,87 @@ def test_orp011_noqa_suppresses():
         DEV = jax.devices()[0]  # orp: noqa[ORP011] -- topology introspection
     """
     assert codes(src) == []
+
+
+# -- ORP012: engine rebuild/swap work under a lock -----------------------------
+
+ORP012_POS = """
+    from orp_tpu.serve.engine import HedgeEngine
+    from orp_tpu.serve.batcher import MicroBatcher
+    from orp_tpu.serve.bundle import load_bundle
+
+    class Host:
+        def reload_tenant(self, name, source):
+            with self._lock:
+                policy = load_bundle(source)         # bundle load under lock
+                engine = HedgeEngine(policy)         # build under lock
+                old = self._batcher
+                self._batcher = MicroBatcher(engine)  # build under lock
+                old.close()                          # drain under lock
+
+        def rebuild_engine(self, spec):
+            with self._cv:
+                self.engine = HedgeEngine(self.policy, mesh=spec)
+"""
+
+ORP012_NEG = """
+    from orp_tpu.serve.engine import HedgeEngine
+    from orp_tpu.serve.batcher import MicroBatcher
+
+    class Host:
+        def reload_tenant(self, name, policy):
+            engine = HedgeEngine(policy)             # built OUTSIDE the lock
+            batcher = MicroBatcher(engine)
+            with self._lock:
+                old = self._batcher                  # pointer swap only
+                self._batcher = batcher
+                self.engine = engine
+            old.close()                              # drained outside
+
+        def reload_from_build_lock(self, policy):
+            with self.build_lock:
+                # a BUILD serializer exists to hold construction; nothing
+                # drains or serves under it — exempt by lock name
+                return HedgeEngine(policy)
+
+        def activate(self, policy):
+            with self._lock:
+                # non-rebuild/swap/reload functions are out of scope
+                self.engine = HedgeEngine(policy)
+"""
+
+
+def test_orp012_flags_rebuild_work_under_lock():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP012_POS),
+                                       path="orp_tpu/serve/host.py")]
+    # load_bundle + HedgeEngine + MicroBatcher + close in reload_tenant,
+    # HedgeEngine in rebuild_engine
+    assert got.count("ORP012") == 5
+
+
+def test_orp012_scopes_to_serve_and_guard_paths():
+    assert lint_source(textwrap.dedent(ORP012_POS),
+                       path="orp_tpu/train/backward.py") == []
+    assert [f.rule for f in lint_source(
+        textwrap.dedent(ORP012_POS),
+        path="orp_tpu/guard/degrade.py")].count("ORP012") == 5
+
+
+def test_orp012_clean_negative():
+    assert lint_source(textwrap.dedent(ORP012_NEG),
+                       path="orp_tpu/serve/host.py") == []
+
+
+def test_orp012_noqa_suppresses():
+    src = """
+        from orp_tpu.serve.engine import HedgeEngine
+
+        def swap(self, policy):
+            with self._lock:
+                self.engine = HedgeEngine(policy)  # orp: noqa[ORP012] -- single-tenant toy host: nothing else queues on this lock
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/host.py") == []
 
 
 # -- suppressions ------------------------------------------------------------
