@@ -42,17 +42,27 @@ def _is_xla_banner(line: str) -> bool:
 
 
 def _device_alive(timeout_s: int = 150) -> bool:
-    """Probe the accelerator via the shared timeout-subprocess probe
-    (``_tunnel_probe``): a dead axon tunnel hangs `jax.devices()`
-    indefinitely at interpreter start, which would turn the whole bench run
-    into a silent hang instead of a record. A healthy CPU-only JAX is NOT a
-    live accelerator (full-size 1M-path runs on CPU are the hang-equivalent
-    the fallback exists to avoid); any non-cpu platform (tpu/axon here, gpu
-    elsewhere) counts as alive."""
-    from _tunnel_probe import probe_device_info
-
-    info = probe_device_info(timeout_s)
-    return info is not None and info["platform"] != "cpu"
+    """Probe the accelerator in a SUBPROCESS with a timeout: a dead axon
+    tunnel hangs `jax.devices()` indefinitely at interpreter start, which
+    would turn the whole bench run into a silent hang instead of a record
+    (the probe process exits cleanly, releasing the chip grant). A healthy
+    CPU-only JAX is NOT a live accelerator (full-size 1M-path runs on CPU
+    are the hang-equivalent the fallback exists to avoid); any non-cpu
+    platform (tpu/axon here, gpu elsewhere) counts as alive."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('probe=%s' % jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode != 0:
+        return False
+    for line in r.stdout.splitlines():
+        if line.startswith("probe="):
+            return line[len("probe="):] != "cpu"
+    return False
 
 
 def last_tpu_summary(repo=None):

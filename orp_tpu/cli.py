@@ -36,7 +36,14 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 cold serve process pays ZERO XLA compiles
 - ``serve-bench`` load a bundle and benchmark the serving path (bucketed
                 engine + micro-batcher), emitting ``BENCH_serve.json``;
-                ``--prewarm`` asserts no compile lands in the measured window
+                ``--prewarm`` asserts no compile lands in the measured
+                window; ``--ingest`` appends the columnar-ingest sweep
+                (per-request vs ``submit_block`` vs gateway loopback, bits
+                pinned equal, ``submit_ns_per_row`` headline)
+- ``serve-gateway`` serve a bundle over the ``orp-ingest-v1`` TCP front
+                (``orp_tpu/serve/gateway.py``): length-prefixed columnar
+                frames in, columnar replies out — the non-Python-per-row
+                ingest plane; ``orp doctor --gateway host:port`` probes it
 - ``warm``      pre-populate the persistent XLA compile cache for training:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
@@ -47,13 +54,14 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 failing check prints its fix in flag-speak; the first
                 thing to run on a broken pod
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP012 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP013 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
                 blocking calls in serve dispatch-loop code, single-device
                 assumptions in mesh-reachable code, engine rebuild/swap
-                work under a lock); exits non-zero
+                work under a lock, per-row Python work in ingest-path
+                code); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -739,6 +747,14 @@ def cmd_serve_bench(args):
         except (OSError, json.JSONDecodeError) as e:
             print(f"warning: ignoring unreadable previous record "
                   f"{args.out}: {e}", file=sys.stderr)
+    ingest_rows = args.ingest_rows
+    ingest_blocks = tuple(int(x) for x in args.ingest_blocks.split(","))
+    if args.quick:
+        # the CI smoke shape: tiny block counts, same lanes, same pins —
+        # the speedup claim stays regression-gated without bench-scale spend
+        ingest_rows = min(ingest_rows, 512)
+        ingest_blocks = tuple(b for b in ingest_blocks
+                              if b <= ingest_rows) or (1, 64)
     record = serve_bench(
         bundle,
         n_requests=args.requests,
@@ -754,11 +770,65 @@ def cmd_serve_bench(args):
         degrade_at=args.degrade_at,
         degrade_requests=args.degrade_requests,
         degrade_survivors=args.degrade_survivors,
+        ingest=args.ingest,
+        ingest_rows=ingest_rows,
+        ingest_block_sizes=ingest_blocks,
         previous=previous,
     )
+    if args.ingest:
+        ing = record["ingest"]
+        if not ing["submit_ns_per_row"] < ing["per_request"]["submit_ns_per_row"]:
+            # the regression gate the --ingest record exists for: columnar
+            # admission must beat the per-request path it amortizes
+            raise SystemExit(
+                "error: columnar submit_ns_per_row "
+                f"({ing['submit_ns_per_row']}) is not below the per-request "
+                f"path ({ing['per_request']['submit_ns_per_row']}) — the "
+                "ingest amortization regressed")
     if args.out:
         write_bench_record(record, args.out)
     print(json.dumps(record))
+
+
+def cmd_serve_gateway(args):
+    """Serve a bundle over the ``orp-ingest-v1`` TCP front: columnar frames
+    in, columnar replies out (``orp_tpu/serve/gateway.py``). Runs until
+    interrupted (or ``--max-seconds``); ``--ready-file`` drops
+    ``host port`` once the socket is listening, for supervisors and
+    loopback harnesses that need the bound port (``--port 0`` picks a free
+    one)."""
+    import pathlib
+    import threading
+
+    from orp_tpu.guard.serve import GuardPolicy
+    from orp_tpu.serve import ServeGateway, ServeHost
+
+    policy = None
+    if args.deadline_ms is not None or args.watermark is not None:
+        policy = GuardPolicy(deadline_ms=args.deadline_ms,
+                             queue_watermark=args.watermark)
+    host = ServeHost(max_live_engines=args.max_live_engines)
+    host.add_tenant(args.tenant, args.bundle, policy=policy,
+                    max_pending=args.max_pending)
+    try:
+        with ServeGateway(host, addr=args.addr, port=args.port,
+                          default_tenant=args.tenant) as gw:
+            addr, port = gw.address
+            line = {"addr": addr, "port": port, "tenant": args.tenant,
+                    "bundle": args.bundle}
+            print(json.dumps(line) if args.json
+                  else f"serving {args.bundle} as tenant {args.tenant!r} "
+                       f"on {addr}:{port} (orp-ingest-v1; ctrl-C to drain)",
+                  flush=True)
+            if args.ready_file:
+                pathlib.Path(args.ready_file).write_text(f"{addr} {port}\n")
+            try:
+                # parked, not polling: the event only fires at --max-seconds
+                threading.Event().wait(args.max_seconds)
+            except KeyboardInterrupt:
+                pass
+    finally:
+        host.close()
 
 
 def cmd_warm(args):
@@ -814,7 +884,8 @@ def cmd_doctor(args):
     from orp_tpu.serve.health import doctor_report
 
     rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
-                        telemetry_dir=args.telemetry_dir)
+                        telemetry_dir=args.telemetry_dir,
+                        gateway=args.gateway)
     if args.json:
         print(json.dumps(rep))
     else:
@@ -1178,6 +1249,22 @@ def build_parser():
     psb.add_argument("--degrade-survivors", type=int, default=None,
                      help="device count the injected loss reports alive "
                           "(default: mesh size minus one)")
+    psb.add_argument("--ingest", action="store_true",
+                     help="append the columnar-ingest sweep: per-request vs "
+                          "submit_block vs gateway-loopback at each "
+                          "--ingest-blocks size, bits pinned equal across "
+                          "lanes; promotes submit_ns_per_row / "
+                          "ingest_rows_per_s to record fields and fails if "
+                          "columnar does not beat the per-request path")
+    psb.add_argument("--ingest-rows", type=int, default=4096,
+                     help="total rows per ingest lane (must divide by every "
+                          "block size)")
+    psb.add_argument("--ingest-blocks", default="1,64,1024",
+                     help="comma-separated block sizes for the ingest sweep")
+    psb.add_argument("--quick", action="store_true",
+                     help="CI smoke shape: shrink the ingest sweep to tiny "
+                          "row/block counts (same lanes, same bitwise and "
+                          "speedup gates)")
     psb.add_argument("--prewarm", action="store_true",
                      help="assert the warmup contract: fail loudly if any "
                           "measured request paid a first-touch bucket "
@@ -1187,6 +1274,46 @@ def build_parser():
                           "subcommands; the record always prints as JSON")
     _add_telemetry_flag(psb)
     psb.set_defaults(fn=cmd_serve_bench)
+
+    pgw = sub.add_parser(
+        "serve-gateway",
+        help="serve a bundle over the orp-ingest-v1 TCP front: length-"
+             "prefixed columnar frames in, columnar replies out — the "
+             "non-Python-per-row ingest plane (probe with "
+             "`orp doctor --gateway host:port`)",
+    )
+    pgw.add_argument("--bundle", required=True,
+                     help="policy bundle directory to serve")
+    pgw.add_argument("--tenant", default="default",
+                     help="tenant name frames route to when their tenant "
+                          "field is empty (16 ASCII bytes max on the wire)")
+    pgw.add_argument("--addr", default="127.0.0.1",
+                     help="bind address (default loopback; bind 0.0.0.0 "
+                          "only behind your own transport security)")
+    pgw.add_argument("--port", type=int, default=7433,
+                     help="bind port (0 = pick a free one; see "
+                          "--ready-file)")
+    pgw.add_argument("--deadline-ms", type=float, default=None,
+                     help="per-row queue-age budget (guard policy): rows "
+                          "aged past it come back status shed-deadline")
+    pgw.add_argument("--watermark", type=int, default=None,
+                     help="row-counted admission watermark: past it a "
+                          "block's tail rows come back status "
+                          "shed-watermark")
+    pgw.add_argument("--max-pending", type=int, default=None,
+                     help="tenant quota in rows: past it a block's tail "
+                          "rows come back status shed-quota")
+    pgw.add_argument("--max-live-engines", type=int, default=4)
+    pgw.add_argument("--max-seconds", type=float, default=None,
+                     help="serve for this long then drain and exit "
+                          "(default: until ctrl-C)")
+    pgw.add_argument("--ready-file", default=None, metavar="PATH",
+                     help="write 'host port' to PATH once listening (how a "
+                          "supervisor or loopback harness learns a "
+                          "--port 0 binding)")
+    pgw.add_argument("--json", action="store_true",
+                     help="emit the bound address as a JSON line")
+    pgw.set_defaults(fn=cmd_serve_gateway)
 
     pdoc = sub.add_parser(
         "doctor",
@@ -1207,6 +1334,9 @@ def build_parser():
     pdoc.add_argument("--telemetry-dir", default=None, metavar="DIR",
                       help="probe DIR as an obs sink target (--telemetry "
                            "runs stream events.jsonl there live)")
+    pdoc.add_argument("--gateway", default=None, metavar="HOST:PORT",
+                      help="probe a running ingest gateway: TCP connect + "
+                           "orp-ingest-v1 PING/PONG round trip")
     pdoc.add_argument("--json", action="store_true",
                       help="machine-readable report")
     pdoc.set_defaults(fn=cmd_doctor)
@@ -1215,7 +1345,8 @@ def build_parser():
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
              "drift, key reuse, silent excepts, blocking dispatch loops, "
-             "single-device assumptions — rules ORP001-ORP012); non-zero "
+             "single-device assumptions, per-row ingest work — rules "
+             "ORP001-ORP013); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

@@ -51,16 +51,24 @@ from orp_tpu.obs import count as obs_count
 
 
 class _Tracked:
-    """One request as the manager remembers it: enough to replay."""
+    """One request (or one columnar block) as the manager remembers it:
+    enough to replay. ``is_block`` routes the resubmission through
+    ``submit_block`` — a trapped block replays AS a block, with its
+    per-row deadline budgets restarted exactly like a per-request replay's
+    ``deadline_s`` is."""
 
-    __slots__ = ("date_idx", "states", "prices", "deadline_s", "outer")
+    __slots__ = ("date_idx", "states", "prices", "deadline_s", "outer",
+                 "is_block")
 
-    def __init__(self, date_idx, states, prices, deadline_s, outer):
+    def __init__(self, date_idx, states, prices, deadline_s, outer,
+                 is_block=False):
         self.date_idx = date_idx
         self.states = states
         self.prices = prices
-        self.deadline_s = deadline_s
+        self.deadline_s = deadline_s   # per-request budget OR the block's
+        # per-row deadlines column (relative seconds), per lane
         self.outer = outer
+        self.is_block = is_block
 
 
 class DegradeManager:
@@ -147,6 +155,23 @@ class DegradeManager:
         self._submit_inner(req)
         return outer
 
+    def submit_block(self, date_idx: int, states, prices=None,
+                     deadlines=None):
+        """Columnar lane through the degradation state machine: the future
+        resolves to the batcher's own
+        :class:`~orp_tpu.serve.ingest.BlockResult` — except that a topology
+        death under the block TRAPS the WHOLE block and replays it (as a
+        block, one resubmission) through the rebuilt engine instead of
+        failing its caller."""
+        from orp_tpu.serve.batcher import SlimFuture
+
+        outer = SlimFuture()
+        req = _Tracked(int(date_idx),
+                       np.atleast_2d(np.ascontiguousarray(states)),
+                       prices, deadlines, outer, is_block=True)
+        self._submit_inner(req)
+        return outer
+
     def evaluate(self, date_idx: int, states, prices=None):
         """Synchronous convenience: ``submit(...).result()``."""
         return self.submit(date_idx, states, prices).result()
@@ -161,8 +186,13 @@ class DegradeManager:
                     raise RuntimeError("DegradeManager is closed")
                 batcher = self._batcher
             try:
-                fut = batcher.submit(req.date_idx, req.states, req.prices,
-                                     deadline_s=req.deadline_s)
+                if req.is_block:
+                    fut = batcher.submit_block(req.date_idx, req.states,
+                                               req.prices, req.deadline_s)
+                else:
+                    fut = batcher.submit(req.date_idx, req.states,
+                                         req.prices,
+                                         deadline_s=req.deadline_s)
             except RuntimeError:
                 continue
             fut.add_done_callback(lambda f, r=req: self._inner_done(r, f))

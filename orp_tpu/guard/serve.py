@@ -100,8 +100,11 @@ class GuardPolicy:
     ``deadline_ms``     — default per-request deadline (queue age budget);
                           ``submit(..., deadline_s=...)`` overrides per
                           request; None = requests never expire.
-    ``queue_watermark`` — max pending requests before admission control
-                          sheds the earliest-deadline one; None = unbounded.
+    ``queue_watermark`` — max pending ROWS before admission control sheds
+                          the earliest-deadline request (a single-row
+                          request is one row; a columnar block counts its
+                          rows, and an over-watermark block sheds its own
+                          tail as a slice); None = unbounded.
     ``max_retries``     — retries around one engine dispatch for
                           :class:`TransientDispatchError` (0 = off).
     ``backoff_ms``      — first retry backoff; doubles per attempt, capped

@@ -58,6 +58,16 @@ ORP012  engine rebuild/swap under a lock: the degradation round's whole
         ``guard/`` where those operations live; locks whose name says
         ``build`` are exempt — a build serializer exists precisely to hold
         construction, and nothing drains under it.
+ORP013  per-row Python work in ingest-path code: the columnar ingest plane
+        exists because per-request Python object churn (~6µs/row: one
+        submit, one future, one dict insert per row) was the measured serve
+        ceiling — so a ``for`` loop over rows that constructs futures,
+        appends to per-row lists, or calls ``submit``/``submit_block``
+        inside ingest-path functions (``*ingest*``/``*decode*``/
+        ``*encode*``/``submit_block`` under ``serve/``) reintroduces
+        exactly the cost the plane amortizes away. Vectorize (mask/slice/
+        ``frombuffer``) or carry a noqa saying why this loop is not
+        per-row (e.g. the bench lane that MEASURES the per-request path).
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -808,6 +818,62 @@ def check_rebuild_under_lock(ctx: FileContext) -> Iterator[Finding]:
                         "done-callbacks may re-enter the lock holder "
                         "(deadlock); unlink under the lock, drain outside "
                         "every lock",
+                    )
+
+
+# -- ORP013 ------------------------------------------------------------------
+
+# the functions that ARE the columnar ingest path: wire encode/decode, the
+# block-lane submit, anything named for ingest — under the serve package
+_ORP013_FN_RE = re.compile(r"ingest|decode|encode|submit_block")
+# per-row object churn the columnar plane exists to eliminate
+_ORP013_SUBMITS = {"submit", "submit_block"}
+_ORP013_FUTURE_RE = re.compile(r"Future$")
+
+
+@rule("ORP013", "per-row Python work inside columnar ingest-path code")
+def check_ingest_row_loop(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _ORP013_FN_RE.search(fdef.name):
+            continue
+        for loop in walk_scope(fdef):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                tail = (d.split(".")[-1] if d is not None
+                        else getattr(node.func, "attr", None))
+                if tail in _ORP013_SUBMITS:
+                    yield ctx.finding(
+                        node, "ORP013",
+                        f".{tail}() inside a for loop in ingest-path "
+                        f"{fdef.name!r} — one submit per iteration is the "
+                        "~6µs/row per-request ceiling the columnar lane "
+                        "amortizes away; admit the rows as ONE block",
+                    )
+                elif (isinstance(node.func, ast.Name)
+                      and _ORP013_FUTURE_RE.search(node.func.id)):
+                    yield ctx.finding(
+                        node, "ORP013",
+                        f"{node.func.id}(...) constructed inside a for "
+                        f"loop in ingest-path {fdef.name!r} — a future per "
+                        "row is per-request object churn; the block lane "
+                        "carries ONE future per block",
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "append"):
+                    yield ctx.finding(
+                        node, "ORP013",
+                        f".append() inside a for loop in ingest-path "
+                        f"{fdef.name!r} — growing a per-row Python list; "
+                        "move the rows in columns (slice/mask/frombuffer)",
                     )
 
 

@@ -22,6 +22,16 @@ that into production artifacts and serves them:
   and canary-gated hot bundle reload (``reload_tenant``: the candidate
   must reproduce pinned probe rows bitwise before taking traffic;
   rejects roll back to the serving bundle);
+- ``ingest``  — the columnar block lane: ``submit_block`` admits N rows
+  under one lock pass with ONE future; answers are ``BlockResult``
+  columns plus a per-row status column (served / shed-deadline /
+  shed-watermark / shed-quota) — guard semantics exact but vectorized;
+- ``wire``    — ``orp-ingest-v1``: versioned fixed-width little-endian
+  frames, ``np.frombuffer``/``tobytes`` only, malformed frames refused
+  with structured error frames in flag-speak;
+- ``gateway`` — the length-prefixed TCP ingest front (``orp
+  serve-gateway``): decode → ``submit_block`` → encode is the whole
+  per-frame Python bill, amortized over the block's rows;
 - ``health``  — the stuck-dispatch watchdog (``GuardPolicy.hard_wall_ms``:
   hung batches force-fail, feed the engine's circuit breaker, retry on a
   path that can answer) and the ``orp doctor`` environment/bundle probe;
@@ -35,18 +45,30 @@ from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.bench import serve_bench, write_bench_record
 from orp_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
 from orp_tpu.serve.engine import HedgeEngine, PendingEval
+from orp_tpu.serve.gateway import GatewayClient, GatewayError, ServeGateway
 from orp_tpu.serve.health import DispatchWatchdog, doctor_report
 from orp_tpu.serve.host import (CanaryRejected, ServeHost, SloPolicy,
                                 burn_rate)
+from orp_tpu.serve.ingest import (SERVED, SHED_DEADLINE, SHED_QUOTA,
+                                  SHED_WATERMARK, STATUS_NAMES, BlockResult)
 from orp_tpu.serve.metrics import ServingMetrics
 
 __all__ = [
+    "BlockResult",
     "CanaryRejected",
     "DispatchWatchdog",
+    "GatewayClient",
+    "GatewayError",
     "HedgeEngine",
     "MicroBatcher",
     "PendingEval",
     "PolicyBundle",
+    "SERVED",
+    "SHED_DEADLINE",
+    "SHED_QUOTA",
+    "SHED_WATERMARK",
+    "STATUS_NAMES",
+    "ServeGateway",
     "ServeHost",
     "ServingMetrics",
     "SloPolicy",

@@ -45,9 +45,11 @@ its exact pre-async semantics under concurrency:
   (``guard/shed{reason="deadline"}``), never served late — so the queue
   age of every *served* request is bounded by its deadline, whatever a
   slow neighbour did;
-- ADMISSION CONTROL: past ``queue_watermark`` pending requests, the
+- ADMISSION CONTROL: past ``queue_watermark`` pending ROWS (one unit on
+  both lanes — a block's rows are backlog like anyone else's), the
   earliest-deadline (then oldest) request is shed at submit time
-  (``guard/shed{reason="watermark"}``);
+  (``guard/shed{reason="watermark"}``); an over-watermark block sheds its
+  own TAIL rows as a slice instead;
 - RETRIES of a dispatch that raised :class:`TransientDispatchError`, with
   bounded exponential backoff (``guard/retry``) — the backoff waits on an
   Event the close path sets, not ``time.sleep``, so it is interruptible
@@ -75,6 +77,8 @@ from orp_tpu.guard.serve import GuardPolicy, Rejection, TransientDispatchError
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import observe as obs_observe
 from orp_tpu.obs import span
+from orp_tpu.serve.ingest import (SHED_DEADLINE, SHED_WATERMARK, Block,
+                                  as_deadline_column)
 from orp_tpu.serve.metrics import ServingMetrics
 
 _PENDING, _DONE, _FAILED = 0, 1, 2
@@ -173,7 +177,7 @@ class SlimFuture:
 
 class _Request:
     __slots__ = ("date_idx", "features", "prices", "future", "submitted_at",
-                 "deadline")
+                 "deadline", "rows")
 
     def __init__(self, date_idx: int, features, prices, future: SlimFuture,
                  submitted_at: float, deadline: float | None):
@@ -183,6 +187,7 @@ class _Request:
         self.future = future
         self.submitted_at = submitted_at
         self.deadline = deadline      # absolute perf_counter instant; None = never
+        self.rows = features.shape[0]  # hoisted off the admit hot loop
 
 
 @dataclasses.dataclass
@@ -203,6 +208,10 @@ class _Group:
     prices: object = None
     pending: object = None        # engine handle with .result()
     error: Exception | None = None
+    # columnar lane: a Block rides its OWN group (one block = one dispatch;
+    # its rows are already a device-shaped batch) and resolves through its
+    # single future with the per-row status column instead of request slices
+    block: Block | None = None
 
 
 def _shed_order(req: _Request) -> tuple:
@@ -272,7 +281,11 @@ class MicroBatcher:
         # arbitrary queued requests under the watermark policy, which a
         # SimpleQueue cannot express
         self._cv = threading.Condition()
-        self._pending: collections.deque[_Request] = collections.deque()
+        self._pending: collections.deque = collections.deque()
+        # row count of everything queued (requests AND blocks): the columnar
+        # lane's watermark unit — shedding whole blocks by request count
+        # would make a 1024-row block as cheap as a 1-row request
+        self._pending_rows = 0
         self._closed = False
         # set at close(): wakes a retry backoff immediately instead of
         # letting the dispatch loop finish a nap nobody is waiting for
@@ -311,13 +324,24 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append(req)
+            self._pending_rows += req.rows
             wm = None if self.policy is None else self.policy.queue_watermark
-            while wm is not None and len(self._pending) > wm:
-                # admission control: keep the queue at the watermark by
-                # shedding the earliest-deadline request (possibly the one
-                # just submitted) — a structured decision, not an error
-                victim = min(self._pending, key=_shed_order)
+            # the watermark is ROW-counted on both lanes (one unit, one
+            # meaning — a 1024-row block is 1024 rows of backlog, not one
+            # entry): keep the queued rows at the watermark by shedding the
+            # earliest-deadline request (possibly the one just submitted) —
+            # a structured decision, not an error. Queued BLOCKS are not
+            # victims: the columnar lane sheds by tail-slice at its own
+            # admission edge (submit_block), never by growing a per-row
+            # Rejection out of a queued column
+            while wm is not None and self._pending_rows > wm:
+                victim = min(
+                    (r for r in self._pending if not isinstance(r, Block)),
+                    key=_shed_order, default=None)
+                if victim is None:
+                    break
                 self._pending.remove(victim)
+                self._pending_rows -= victim.rows
                 shed.append(victim)
             if len(self._pending) == 1:
                 # notify only on the empty->nonempty edge: a worker in its
@@ -331,6 +355,69 @@ class MicroBatcher:
             # would deadlock on the held Condition
             self._shed(victim, "watermark")
         return fut
+
+    def submit_block(self, date_idx: int, states, prices=None,
+                     deadlines=None) -> SlimFuture:
+        """Columnar ingest lane: admit N rows for ONE date under one lock
+        pass with ONE future for the whole block. The future resolves to a
+        :class:`~orp_tpu.serve.ingest.BlockResult` — contiguous ``phi``/
+        ``psi``/``value`` columns plus a per-row ``status`` column — whose
+        served rows are BITWISE what N per-request ``submit`` calls of the
+        same rows return (the forward is per-row; only the Python admission
+        cost changes).
+
+        ``states``: ``(n, n_features)`` feature rows (C-contiguous is the
+        zero-copy path). ``prices``: optional ``(n, k)``. ``deadlines``:
+        per-row queue-age budgets in seconds — an ``(n,)`` column, a scalar
+        for every row, or None for the policy default. Like the per-request
+        lane, deadlines/watermark only act under a :class:`GuardPolicy`;
+        guard decisions come back through the STATUS column (deadline
+        expiry = one mask compare at admit; watermark = the tail rows past
+        the row-counted watermark shed as a slice at submit), never as
+        per-row ``Rejection`` objects.
+        """
+        feats = np.atleast_2d(np.ascontiguousarray(states))
+        n = feats.shape[0]
+        if n < 1 or feats.ndim != 2:
+            raise ValueError(
+                f"block of shape {np.shape(states)}: submit_block takes a "
+                "non-empty (rows, n_features) feature matrix")
+        pr = None
+        if prices is not None:
+            pr = np.atleast_2d(np.ascontiguousarray(prices))
+            if pr.shape[0] != n:
+                raise ValueError(
+                    f"prices column has {pr.shape[0]} rows, features {n} — "
+                    "a block is one row set")
+        now = time.perf_counter()
+        dl = None
+        if self.policy is not None:
+            default = (None if self.policy.deadline_ms is None
+                       else self.policy.deadline_ms / 1e3)
+            dl = as_deadline_column(deadlines, n, now, default)
+        blk = Block(int(date_idx), feats, pr, SlimFuture(), now, dl)
+        n_wm = 0
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            wm = None if self.policy is None else self.policy.queue_watermark
+            if wm is not None and self._pending_rows + n > wm:
+                # row-counted admission control, vectorized: strike the TAIL
+                # rows past the watermark in one slice — never grow per-row
+                # objects out of an overload decision
+                n_wm = blk.shed_tail(max(0, wm - self._pending_rows),
+                                     SHED_WATERMARK)
+            live = blk.n_live
+            if live:
+                self._pending.append(blk)
+                self._pending_rows += live
+                if len(self._pending) == 1:
+                    self._cv.notify()
+        # signals + resolution OUTSIDE the lock (the per-request shed rule)
+        blk.emit_shed(SHED_WATERMARK, n_wm)
+        if not live:
+            blk.resolve_shed_only()
+        return blk.future
 
     def evaluate(self, date_idx: int, states, prices=None):
         """Synchronous convenience: ``submit(...).result()``."""
@@ -385,7 +472,12 @@ class MicroBatcher:
             for req in expired:
                 # outside the lock: resolving a future runs its
                 # done-callbacks synchronously (see submit's shed note)
-                self._shed(req, "deadline")
+                if isinstance(req, Block):
+                    # a block every row of which expired: its shed signals
+                    # were emitted at admit, only the resolution is left
+                    req.resolve_shed_only()
+                else:
+                    self._shed(req, "deadline")
             if batch:
                 inflight.append(self._dispatch(batch))
             if inflight and (not batch or len(inflight) >= self.max_inflight):
@@ -420,6 +512,25 @@ class MicroBatcher:
                 if self._pending:
                     req = self._pending.popleft()
                     now = time.perf_counter()
+                    if isinstance(req, Block):
+                        # columnar lane: deadline expiry is ONE mask
+                        # compare against the float64 deadline column —
+                        # expired rows are struck in place, never objects
+                        self._pending_rows -= req.n_live
+                        n_exp = req.mask_expired(now)
+                        req.emit_shed(SHED_DEADLINE, n_exp)
+                        live = req.n_live
+                        if not live:
+                            expired.append(req)
+                            continue
+                        obs_observe("serve/queue_age_seconds",
+                                    now - req.submitted_at, outcome="served")
+                        batch.append(req)
+                        rows += live
+                        if window_end is None:
+                            window_end = now + self.max_wait_us * 1e-6
+                        continue
+                    self._pending_rows -= req.rows
                     if req.deadline is not None and now > req.deadline:
                         # expired while queued: never burn a device
                         # dispatch on an answer nobody is waiting for
@@ -428,7 +539,7 @@ class MicroBatcher:
                     obs_observe("serve/queue_age_seconds",
                                 now - req.submitted_at, outcome="served")
                     batch.append(req)
-                    rows += req.features.shape[0]
+                    rows += req.rows
                     if window_end is None:
                         window_end = now + self.max_wait_us * 1e-6
                     continue
@@ -449,13 +560,40 @@ class MicroBatcher:
         Grouping key: same date, same feature width and same prices
         shape-presence. Width in the key means a malformed request (wrong
         feature count) fails on ITS OWN future with the engine's error
-        instead of poisoning the concat of an entire well-formed batch."""
+        instead of poisoning the concat of an entire well-formed batch.
+
+        A :class:`~orp_tpu.serve.ingest.Block` rides its OWN group: its
+        rows are already one contiguous device-shaped batch (the whole
+        point of the columnar lane — zero concatenates on the clean path),
+        and its single future resolves with the status column instead of
+        per-request slices."""
         groups: dict[tuple, list[_Request]] = {}
+        out: list[_Group] = []
         for req in batch:
+            if isinstance(req, Block):
+                feats, prices = req.live_columns()
+                g = _Group(reqs=[], has_prices=prices is not None,
+                           rows=int(feats.shape[0]), date_idx=req.date_idx,
+                           block=req)
+                out.append(g)
+                try:
+                    g.feats, g.prices = feats, prices
+                    g.pending = self._dispatch_engine(g.date_idx, feats,
+                                                      prices)
+                except Exception as e:  # orp: noqa[ORP009] -- delivered to the block's future by _resolve
+                    g.error = e
+                    continue
+                obs_count("serve/batcher_dispatches")
+                obs_count("serve/ingest_block_rows", g.rows, sink_event=False)
+                if self.metrics is not None:
+                    cap = (self.engine.bucket_for(g.rows)
+                           if hasattr(self.engine, "bucket_for") else
+                           self.max_batch)
+                    self.metrics.record_dispatch(1, g.rows, cap)
+                continue
             key = (req.date_idx, req.features.shape[1],
                    None if req.prices is None else req.prices.shape[1])
             groups.setdefault(key, []).append(req)
-        out: list[_Group] = []
         for (date_idx, _, pwidth), reqs in groups.items():
             has_prices = pwidth is not None
             g = _Group(reqs=reqs, has_prices=has_prices,
@@ -541,6 +679,9 @@ class MicroBatcher:
         bulk — strictly outside the Condition (done-callbacks run
         synchronously and may re-enter the batcher)."""
         for g in groups:
+            if g.block is not None:
+                self._resolve_block(g)
+                continue
             if g.error is not None:
                 for r in g.reqs:
                     if r.future.set_running_or_notify_cancel():
@@ -570,6 +711,29 @@ class MicroBatcher:
                 served.append((done - r.submitted_at, n))
             if self.metrics is not None:
                 self.metrics.record_many(served)
+
+    def _resolve_block(self, g: _Group) -> None:
+        """Resolve a columnar block's single future: the dispatched live
+        rows scatter back into full-size columns next to the status ledger
+        (``ingest.Block.resolve_served``); a failed dispatch delivers its
+        exception to the one future — no per-row error objects either."""
+        blk = g.block
+        if g.error is not None:
+            if blk.future.set_running_or_notify_cancel():
+                blk.future.set_exception(g.error)
+            return
+        try:
+            with span("serve/batch", attrs={"requests": 1,
+                                            "rows": g.rows}) as sp:
+                phi, psi, value = self._blocked_result(g)
+        except Exception as e:  # noqa: BLE001 — delivered through the block future
+            if blk.future.set_running_or_notify_cancel():
+                blk.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        blk.resolve_served(phi, psi, value)
+        if self.metrics is not None:
+            self.metrics.record(done - blk.submitted_at, g.rows)
 
 
 class _Resolved:

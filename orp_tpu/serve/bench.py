@@ -190,6 +190,157 @@ def _mesh_sweep_phase(policy, mesh_sizes, *, rows: int, repeats: int,
     return out
 
 
+def _columnar_level(engine, feats, bsz: int, top: int, max_wait_us: float,
+                    pin) -> dict:
+    """One columnar-lane point: the full row set through ``submit_block``
+    at block size ``bsz``; ``submit_ns_per_row`` times the submit calls
+    only (the admission cost being amortized), ``ingest_rows_per_s`` the
+    end-to-end serve."""
+    rows = feats.shape[0]
+    with MicroBatcher(engine, max_batch=max(top, bsz),
+                      max_wait_us=max_wait_us) as mb:
+        t0 = time.perf_counter()
+        futures = [mb.submit_block(0, feats[o:o + bsz])
+                   for o in range(0, rows, bsz)]
+        t1 = time.perf_counter()
+        results = [f.result(timeout=120) for f in futures]
+        t_done = time.perf_counter()
+    pin(np.concatenate([r.phi for r in results]),
+        np.concatenate([r.psi for r in results]), f"columnar@{bsz}")
+    if any(r.status.any() for r in results):
+        raise RuntimeError("columnar lane shed rows with no guard policy "
+                           "installed")
+    return {
+        "block": bsz,
+        "submit_ns_per_row": round((t1 - t0) / rows * 1e9, 1),
+        "ingest_rows_per_s": round(rows / (t_done - t0), 1),
+    }
+
+
+def _gateway_level(client, feats, bsz: int, pin) -> dict:
+    """One gateway-loopback point: encode → TCP → decode → submit_block →
+    encode reply, serially per block — the full wire round trip the
+    record's ``rtt_us_per_block`` names."""
+    rows = feats.shape[0]
+    # untimed warmup round trip: the tenant's engine lives inside the host
+    # and pays any first-touch cost (bucket compile on a non-AOT bundle,
+    # AOT shakeout otherwise) HERE, not inside the measured window
+    client.submit_block("bench", 0, feats[:bsz])
+    t0 = time.perf_counter()
+    results = [client.submit_block("bench", 0, feats[o:o + bsz])
+               for o in range(0, rows, bsz)]
+    t_done = time.perf_counter()
+    pin(np.concatenate([r.phi for r in results]),
+        np.concatenate([r.psi for r in results]), f"gateway@{bsz}")
+    return {
+        "block": bsz,
+        "rows_per_s": round(rows / (t_done - t0), 1),
+        "rtt_us_per_block": round((t_done - t0) / (rows // bsz) * 1e6, 1),
+    }
+
+
+def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
+                  max_wait_us: float = 200.0) -> dict:
+    """The columnar-ingest sweep (CLI ``serve-bench --ingest``): the SAME
+    feature rows through three lanes, timed where each lane pays its
+    Python —
+
+    1. **per_request** — one ``MicroBatcher.submit()`` per row, the PR 7
+       ceiling being measured: ``submit_ns_per_row`` is the pure submit-
+       call wall (no device time), the ~6µs/request Python object bill;
+    2. **columnar**    — ``submit_block`` at each block size: the same
+       admission amortized over the block (one lock pass, one future);
+    3. **gateway**     — encode → TCP loopback → decode → ``submit_block``
+       → encode reply, the full wire round trip per block.
+
+    Served bits are pinned BITWISE across all three lanes against a direct
+    ``engine.evaluate`` of the same rows (a lane that changes a bit is a
+    broken lane, not a fast one) — the phase RAISES on any mismatch, so a
+    CI smoke (`--ingest --quick`) regression-gates the claim. The measured
+    window is compile-free (every reachable bucket prewarmed;
+    ``xla_compiles`` recorded from the engine's own counter)."""
+    from orp_tpu.serve.gateway import GatewayClient, ServeGateway
+    from orp_tpu.serve.host import ServeHost
+
+    block_sizes = tuple(int(b) for b in block_sizes)
+    top = max(block_sizes)
+    if any(rows % b for b in block_sizes):
+        raise ValueError(
+            f"--ingest-rows {rows} must be divisible by every block size "
+            f"{block_sizes} so each lane serves identical rows")
+    engine = HedgeEngine(policy)
+    nf = engine.model.n_features
+    rng = np.random.default_rng(seed)
+    feats = (1.0 + 0.1 * rng.standard_normal((rows, nf))).astype(np.float32)
+    # prewarm every bucket any lane can reach: single rows coalesce up to
+    # `top` in the batcher, blocks dispatch at their own size
+    sizes, b = [], engine.min_bucket
+    while b <= engine.bucket_for(top):
+        sizes.append(b)
+        b *= 2
+    engine.prewarm(sizes)
+    # the all-rows reference evaluation pads to ITS own (rows-sized) bucket,
+    # which no lane dispatches — run it BEFORE the compile snapshot so the
+    # measured window is exactly the three lanes
+    ref_phi, ref_psi, _ = engine.evaluate(0, feats)
+    compiles0 = engine.cache_info()["xla_compiles"]
+
+    def _pin(phi, psi, lane):
+        if not (np.array_equal(phi, ref_phi) and np.array_equal(psi, ref_psi)):
+            raise RuntimeError(
+                f"ingest lane {lane!r} served different BITS than a direct "
+                "engine.evaluate of the same rows — a broken lane, not a "
+                "fast one")
+
+    # lane 1: per-request — the measured ceiling this plane exists to break
+    with MicroBatcher(engine, max_batch=top, max_wait_us=max_wait_us) as mb:
+        futures = []
+        t0 = time.perf_counter()
+        for i in range(rows):
+            futures.append(mb.submit(0, feats[i:i + 1]))  # orp: noqa[ORP013] -- this loop IS the per-request lane being measured (the ceiling the columnar lane is compared against)
+        t1 = time.perf_counter()
+        got = [f.result(timeout=120) for f in futures]
+        t_done = time.perf_counter()
+    _pin(np.concatenate([g[0] for g in got]),
+         np.concatenate([g[1] for g in got]), "per_request")
+    per_request = {
+        "rows": rows,
+        "submit_ns_per_row": round((t1 - t0) / rows * 1e9, 1),
+        "rows_per_s": round(rows / (t_done - t0), 1),
+    }
+
+    # lanes 2+3 iterate BLOCKS, not rows (the whole point) — list
+    # comprehensions over the level helpers below, so the per-level work
+    # stays out of ORP013's per-row-loop scope by construction
+    columnar = [_columnar_level(engine, feats, bsz, top, max_wait_us, _pin)
+                for bsz in block_sizes]
+    with ServeHost(max_live_engines=1) as host:
+        host.add_tenant("bench", policy)
+        with ServeGateway(host, port=0) as gw:
+            with GatewayClient(*gw.address) as client:
+                gateway = [_gateway_level(client, feats, bsz, _pin)
+                           for bsz in block_sizes]
+
+    # the LARGEST block is the amortization headline — by value, not list
+    # position, so an unsorted --ingest-blocks cannot flip the CLI gate
+    best = max(columnar, key=lambda c: c["block"])
+    return {
+        "rows": rows,
+        "block_sizes": list(block_sizes),
+        "per_request": per_request,
+        "columnar": columnar,
+        "gateway": gateway,
+        "submit_ns_per_row": best["submit_ns_per_row"],
+        "ingest_rows_per_s": max(c["ingest_rows_per_s"] for c in columnar),
+        "submit_speedup_vs_per_request": round(
+            per_request["submit_ns_per_row"]
+            / max(best["submit_ns_per_row"], 1e-9), 2),
+        "bitwise_equal_to_per_request": True,  # _pin raised otherwise
+        "xla_compiles": (None if compiles0 is None
+                         else engine.cache_info()["xla_compiles"] - compiles0),
+    }
+
+
 def _degrade_drill(policy, *, degrade_at: int, n_requests: int,
                    survivors: int | None, mesh, seed: int) -> dict:
     """Degradation drill (CLI ``--degrade-at``): stream single-row requests
@@ -281,6 +432,9 @@ def serve_bench(
     degrade_at: int | None = None,
     degrade_requests: int = 64,
     degrade_survivors: int | None = None,
+    ingest: bool = False,
+    ingest_rows: int = 4096,
+    ingest_block_sizes: tuple[int, ...] = (1, 64, 1024),
     previous: dict | None = None,
 ) -> dict:
     """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
@@ -302,6 +456,12 @@ def serve_bench(
     contract is zero — trapped requests replay), and a post-recovery
     bits-equal pin against the healthy single-device engine; ``mttr_ms``
     becomes a first-class record field.
+    ``ingest=True`` (CLI ``--ingest``) appends the columnar-ingest sweep
+    (:func:`_ingest_phase`): per-request vs ``submit_block`` vs gateway
+    loopback over the same rows at each block size, with every lane's bits
+    pinned against a direct evaluation (the phase raises on a flipped bit),
+    and promotes ``submit_ns_per_row`` / ``ingest_rows_per_s`` to
+    first-class record fields.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
     synchronous-tier baseline forward as ``batcher_before``."""
     engine = HedgeEngine(policy, mesh=mesh)
@@ -399,6 +559,14 @@ def serve_bench(
         record["degrade"] = drill
         # the headline resilience number, first-class like p99
         record["mttr_ms"] = drill["mttr_ms"]
+    if ingest:
+        ing = _ingest_phase(policy, rows=ingest_rows,
+                            block_sizes=ingest_block_sizes, seed=seed,
+                            max_wait_us=max_wait_us)
+        record["ingest"] = ing
+        # the amortized-submit headlines, first-class like p99/mttr
+        record["submit_ns_per_row"] = ing["submit_ns_per_row"]
+        record["ingest_rows_per_s"] = ing["ingest_rows_per_s"]
     if sweep:
         record["sweep"] = sweep
         record["batcher_sustained_requests_per_s"] = best["requests_per_s"]

@@ -1,0 +1,327 @@
+"""The socket gateway: a non-Python-per-row ingest front over ``ServeHost``.
+
+The serve tier's last serialization point (ROADMAP, PR 7's measurement) was
+the per-request Python submit path — ~6µs of object churn per request no
+matter how well the device was amortized. This module is the other half of
+the columnar fix: requests arrive over TCP as ``orp-ingest-v1`` frames
+(``serve/wire.py``), and the ENTIRE per-frame Python bill is
+
+    decode (header check + 3 buffer views)
+    → ``ServeHost.submit_block`` (one lock pass, one future)
+    → encode (status/phi/psi/value ``tobytes``)
+
+amortized over every row in the block. A 1024-row frame costs the gateway
+the same Python as a 1-row frame.
+
+Transport: length-prefixed frames — a ``<u4`` byte count, then the frame —
+over a plain TCP stream; one handler thread per connection (the GIL is not
+the bottleneck: handlers spend their time parked on ``recv`` or on the
+block future, both of which release it). Malformed frames are answered
+with a structured ERROR frame in flag-speak; the framing itself (length
+prefix) stays intact, so one bad frame never poisons the connection.
+``close()`` drains gracefully: stop accepting, let every handler finish
+the frame it is serving, then shut the sockets.
+
+``GatewayClient`` is the reference client (the README's 5-line snippet,
+the loopback bench, the doctor probe): connect, ``submit_block``, read the
+columnar reply.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from orp_tpu.obs import count as obs_count
+from orp_tpu.serve import wire
+from orp_tpu.serve.ingest import BlockResult
+
+_LEN = struct.Struct("<I")
+#: transport-level ceiling on one frame (the wire's own MAX_ROWS is the
+#: semantic cap; this one bounds the recv allocation before decoding)
+MAX_FRAME_BYTES = 1 << 28
+
+
+class GatewayError(RuntimeError):
+    """The server answered with a structured ERROR frame; the message is
+    the server's flag-speak refusal."""
+
+
+def _recv_exact(sock: socket.socket, n: int, closed) -> bytes | None:
+    """Read exactly ``n`` bytes, polling the drain flag between timeouts;
+    None when the peer closed (or the gateway is draining)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        if closed is not None and closed.is_set():
+            return None
+        try:
+            k = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            if closed is None:
+                raise  # a client with no drain flag wants its timeout
+            continue
+        except OSError:
+            return None
+        if k == 0:
+            return None
+        got += k
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, frame: bytes) -> None:
+    sock.sendall(_LEN.pack(len(frame)) + frame)
+
+
+def _recv_frame(sock: socket.socket, closed=None,
+                max_bytes: int = MAX_FRAME_BYTES) -> bytes | None:
+    head = _recv_exact(sock, _LEN.size, closed)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > max_bytes:
+        raise wire.WireError(
+            f"frame length {n} exceeds the {max_bytes}-byte transport cap "
+            "— split the block")
+    return _recv_exact(sock, n, closed)
+
+
+class ServeGateway:
+    """Length-prefixed TCP front over a :class:`~orp_tpu.serve.host.ServeHost`.
+
+    ``host``           — the multi-tenant host that serves decoded blocks.
+    ``addr``/``port``  — bind address (``port=0`` picks a free port; read
+    it back from :attr:`address`).
+    ``default_tenant`` — tenant for frames whose tenant field is empty.
+    ``reply_timeout_s`` — bound on waiting for a block's future (a stuck
+    block answers the CONNECTION with an ERROR frame instead of wedging
+    the handler forever).
+
+    Per-connection observability: ``serve/gateway_connections`` (opened),
+    ``serve/gateway_frames{kind}``, ``serve/gateway_rows``,
+    ``serve/gateway_errors{stage}`` counters, plus :meth:`stats` for the
+    live per-connection frame/row ledgers.
+    """
+
+    def __init__(self, host, *, addr: str = "127.0.0.1", port: int = 0,
+                 default_tenant: str | None = None, backlog: int = 16,
+                 reply_timeout_s: float = 60.0,
+                 max_frame_bytes: int = MAX_FRAME_BYTES):
+        self.host = host
+        self.default_tenant = default_tenant
+        self.reply_timeout_s = float(reply_timeout_s)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._closed = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: dict[int, dict] = {}
+        self._handlers: list[threading.Thread] = []
+        self._next_conn = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((addr, int(port)))
+        self._sock.listen(backlog)
+        self.address: tuple[str, int] = self._sock.getsockname()[:2]
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name="orp-serve-gateway", daemon=True)
+        self._acceptor.start()
+
+    # -- accept / serve ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.25)
+        while not self._closed.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: the drain path
+            conn.settimeout(0.25)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                cid = self._next_conn
+                self._next_conn += 1
+                self._conns[cid] = {"peer": f"{peer[0]}:{peer[1]}",
+                                    "frames": 0, "rows": 0, "errors": 0}
+                t = threading.Thread(
+                    target=self._serve_conn, args=(conn, cid),
+                    name=f"orp-gateway-conn-{cid}", daemon=True)
+                # prune finished handlers so a long-lived gateway's ledger
+                # stays O(live connections)
+                self._handlers = [h for h in self._handlers if h.is_alive()]
+                self._handlers.append(t)
+            obs_count("serve/gateway_connections")
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket, cid: int) -> None:
+        stats = self._conns[cid]
+        try:
+            while not self._closed.is_set():
+                try:
+                    frame = _recv_frame(conn, self._closed,
+                                        self.max_frame_bytes)
+                except wire.WireError as e:
+                    # transport-level refusal: answer, then close — past an
+                    # oversized length prefix the stream offset is garbage
+                    stats["errors"] += 1
+                    obs_count("serve/gateway_errors", stage="transport")
+                    self._try_send(conn, wire.encode_error(str(e)))
+                    return
+                if frame is None:
+                    return  # peer closed (or drain): a clean end
+                stats["frames"] += 1
+                reply = self._handle_frame(frame, stats)
+                if not self._try_send(conn, reply):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:  # orp: noqa[ORP009] -- best-effort close of a dead socket; nothing to emit
+                pass
+            with self._lock:
+                self._conns.pop(cid, None)
+
+    def _handle_frame(self, frame: bytes, stats: dict) -> bytes:
+        """decode → submit_block → encode: the whole per-frame Python bill.
+        Every failure mode becomes a structured ERROR frame in flag-speak;
+        the connection survives anything the framing survived."""
+        try:
+            kind = wire.decode_kind(frame)
+        except wire.WireError as e:
+            stats["errors"] += 1
+            obs_count("serve/gateway_errors", stage="decode")
+            return wire.encode_error(str(e))
+        obs_count("serve/gateway_frames", kind=str(kind), sink_event=False)
+        if kind == wire.KIND_PING:
+            return wire.encode_pong()
+        if kind != wire.KIND_REQUEST:
+            stats["errors"] += 1
+            obs_count("serve/gateway_errors", stage="decode")
+            return wire.encode_error(
+                "this endpoint takes request/ping frames only")
+        try:
+            req = wire.decode_request(frame)
+        except wire.WireError as e:
+            stats["errors"] += 1
+            obs_count("serve/gateway_errors", stage="decode")
+            return wire.encode_error(str(e))
+        tenant = req["tenant"] or self.default_tenant
+        if tenant is None:
+            stats["errors"] += 1
+            obs_count("serve/gateway_errors", stage="route")
+            return wire.encode_error(
+                "frame names no tenant and the gateway has no default — "
+                "set the tenant field or start with --tenant")
+        try:
+            fut = self.host.submit_block(tenant, req["date_idx"],
+                                         req["states"], req["prices"],
+                                         req["deadlines"])
+            result: BlockResult = fut.result(timeout=self.reply_timeout_s)
+        except Exception as e:  # orp: noqa[ORP009] -- emitted: counted AND shipped to the client as an ERROR frame
+            stats["errors"] += 1
+            obs_count("serve/gateway_errors", stage="serve")
+            return wire.encode_error(f"{type(e).__name__}: {e}")
+        n = result.n_rows
+        stats["rows"] += n
+        obs_count("serve/gateway_rows", n, sink_event=False)
+        return wire.encode_reply(result, date_idx=req["date_idx"])
+
+    def _try_send(self, conn: socket.socket, frame: bytes) -> bool:
+        try:
+            _send_frame(conn, frame)
+            return True
+        except OSError:
+            obs_count("serve/gateway_errors", stage="send")
+            return False
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    def stats(self) -> dict:
+        """Live per-connection ledgers: ``{conn_id: {peer, frames, rows,
+        errors}}``."""
+        with self._lock:
+            return {cid: dict(s) for cid, s in self._conns.items()}
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Graceful drain: stop accepting, let every handler finish the
+        frame it is serving (their recv polls notice the flag), then close
+        the listener."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:  # orp: noqa[ORP009] -- already closed; the drain continues
+            pass
+        self._acceptor.join(timeout)
+        with self._lock:
+            handlers = list(self._handlers)
+        for t in handlers:
+            t.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class GatewayClient:
+    """The reference ``orp-ingest-v1`` client: one TCP connection, columnar
+    frames in, :class:`BlockResult` out. The five-line usage::
+
+        from orp_tpu.serve.gateway import GatewayClient
+        with GatewayClient("127.0.0.1", 7433) as c:
+            res = c.submit_block("desk-a", date_idx=3, states=feats)
+        print(res.phi, res.status)
+    """
+
+    def __init__(self, addr: str, port: int, *, timeout_s: float = 60.0):
+        self._sock = socket.create_connection((addr, int(port)),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()  # one in-flight frame per connection
+
+    def submit_block(self, tenant: str, date_idx: int, states, prices=None,
+                     deadlines=None, *,
+                     deadline_ms: float | None = None) -> BlockResult:
+        """Ship one block and block on its columnar reply. Raises
+        :class:`GatewayError` with the server's flag-speak message when the
+        server refused the frame (or the serve itself failed)."""
+        frame = wire.encode_request(tenant, date_idx, states, prices,
+                                    deadlines, deadline_ms=deadline_ms)
+        reply = self._roundtrip(frame)
+        if wire.decode_kind(reply) == wire.KIND_ERROR:
+            raise GatewayError(wire.decode_error(reply))
+        return wire.decode_reply(reply)
+
+    def ping(self) -> bool:
+        """One PING round trip — the doctor probe's liveness check."""
+        reply = self._roundtrip(wire.encode_ping())
+        return wire.decode_kind(reply) == wire.KIND_PONG
+
+    def _roundtrip(self, frame: bytes) -> bytes:
+        with self._lock:
+            _send_frame(self._sock, frame)
+            reply = _recv_frame(self._sock)
+        if reply is None:
+            raise GatewayError("connection closed by the gateway mid-reply")
+        return reply
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # orp: noqa[ORP009] -- best-effort close; nothing to emit
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -165,7 +165,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
-                  telemetry_dir=None) -> dict:
+                  telemetry_dir=None, gateway=None) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
     row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
@@ -179,6 +179,9 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     the repo ``.jax_cache``).
     ``telemetry_dir`` — optionally probe the obs sink target for
     ``--telemetry DIR`` runs.
+    ``gateway``     — optionally probe a running ingest gateway
+    (``"host:port"``): one TCP connect + ``orp-ingest-v1`` PING/PONG round
+    trip, the liveness check for a ``orp serve-gateway`` front.
     """
     checks: list[dict] = []
     # 1) devices + topology fingerprint: everything downstream keys on this
@@ -253,4 +256,24 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
         _check(checks, "telemetry_sink", ok, detail,
                fix="--telemetry DIR must name a writable directory "
                    "(events.jsonl streams live)")
+    # 6) ingest gateway liveness: connect + PING/PONG over orp-ingest-v1
+    if gateway is not None:
+        from orp_tpu.serve.gateway import GatewayClient
+
+        addr, _, port = str(gateway).rpartition(":")
+        try:
+            with GatewayClient(addr or "127.0.0.1", int(port),
+                               timeout_s=5.0) as client:
+                ok = client.ping()
+            _check(checks, "gateway", ok,
+                   f"{gateway}: PING/PONG {'ok' if ok else 'FAILED'}",
+                   fix="the endpoint answered but not in orp-ingest-v1 — "
+                       "is something else listening on that port?")
+        # RuntimeError covers GatewayError (connection dropped mid-reply:
+        # wrong service, or a gateway mid-drain) — the probe's whole job is
+        # to turn ANY of these into a failing check row, never a traceback
+        except (OSError, ValueError, RuntimeError) as e:
+            _check(checks, "gateway", False, f"{gateway}: {e}",
+                   fix="start the front with `orp serve-gateway --bundle "
+                       "DIR --port N` (or fix the host:port)")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}

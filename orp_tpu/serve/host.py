@@ -266,37 +266,50 @@ class ServeHost:
 
     # -- request path --------------------------------------------------------
 
-    def submit(self, tenant: str, date_idx: int, states, prices=None, *,
-               deadline_s: float | None = None):
-        """Route one request to ``tenant``'s batcher; returns its future
-        (``(phi, psi, value)``, or a :class:`Rejection` — the tenant's own
-        guard sheds plus the host's ``reason="quota"``)."""
-        # claim loop: between activation and the claim a concurrent
-        # activation may LRU-evict this tenant (its batcher closes); the
-        # claim (in_submit, under the host lock) is what makes the batcher
-        # un-evictable, so a failed claim just re-activates. Bounded: a
-        # freshly-activated tenant loses the race only to an eviction that
-        # slipped between the two locks.
+    def _claim_batcher(self, name: str):
+        """Activate ``name`` and CLAIM its live batcher: ``(tenant,
+        batcher)`` with ``in_submit`` already incremented (the token that
+        makes the batcher un-evictable); the caller MUST release via
+        :meth:`_release_claim` once its enqueue is done.
+
+        Claim loop: between activation and the claim a concurrent
+        activation may LRU-evict this tenant (its batcher closes); a failed
+        claim just re-activates. Bounded: a freshly-activated tenant loses
+        the race only to an eviction that slipped between the two locks.
+        Evicted victims drain HERE, outside every lock (the drain resolves
+        futures, and a done-callback may re-enter the host)."""
         for _ in range(16):
             with self._lock:
                 if self._closed:
                     raise RuntimeError("ServeHost is closed")
-            t, batcher, evicted = self._activate(tenant)
+            t, batcher, evicted = self._activate(name)
             with self._lock:
                 claimed = t.batcher is batcher and batcher is not None
                 if claimed:
                     t.in_submit += 1
             for victim in evicted:
-                # drained OUTSIDE every lock: the drain resolves futures,
-                # and a done-callback may re-enter the host (submit-on-
-                # reject) — under a held lock that would deadlock the drain
                 victim.close()
             if claimed:
-                break
-        else:  # pragma: no cover - needs pathological eviction churn
-            raise RuntimeError(
-                f"tenant {tenant!r}: could not claim a live batcher "
-                "(eviction churn; raise max_live_engines)")
+                return t, batcher
+        # pragma: no cover - needs pathological eviction churn
+        raise RuntimeError(
+            f"tenant {name!r}: could not claim a live batcher "
+            "(eviction churn; raise max_live_engines)")
+
+    def _release_claim(self, t: _Tenant) -> None:
+        with self._lock:
+            t.in_submit -= 1
+            if t.in_submit == 0:
+                # a reload swap may be parked on this count (notify on
+                # the shared host lock: nanoseconds with no waiters)
+                self._swap_cv.notify_all()
+
+    def submit(self, tenant: str, date_idx: int, states, prices=None, *,
+               deadline_s: float | None = None):
+        """Route one request to ``tenant``'s batcher; returns its future
+        (``(phi, psi, value)``, or a :class:`Rejection` — the tenant's own
+        guard sheds plus the host's ``reason="quota"``)."""
+        t, batcher = self._claim_batcher(tenant)
         try:
             with self._pending_lock:
                 over = (t.max_pending is not None
@@ -320,16 +333,82 @@ class ServeHost:
             fut.add_done_callback(lambda _f, _t=t: self._request_done(_t))
             return fut
         finally:
-            with self._lock:
-                t.in_submit -= 1
-                if t.in_submit == 0:
-                    # a reload swap may be parked on this count (notify on
-                    # the shared host lock: nanoseconds with no waiters)
-                    self._swap_cv.notify_all()
+            self._release_claim(t)
+
+    def submit_block(self, tenant: str, date_idx: int, states, prices=None,
+                     deadlines=None):
+        """Columnar ingest lane through the host: one
+        :meth:`~orp_tpu.serve.batcher.MicroBatcher.submit_block` per block,
+        ONE future, quota counted in ROWS against the tenant's
+        ``max_pending`` budget. Rows past the remaining budget are shed as
+        a TAIL SLICE — status :data:`~orp_tpu.serve.ingest.SHED_QUOTA` in
+        the returned :class:`~orp_tpu.serve.ingest.BlockResult`, zero queue
+        age, never a per-row ``Rejection`` — and only the head rows consume
+        batcher capacity. (The per-request lane counts the same budget in
+        requests; a mixed tenant's ``pending`` is requests + block rows.)"""
+        from orp_tpu.serve.ingest import (SHED_QUOTA, all_shed_result,
+                                          merge_tail_shed)
+
+        feats = np.atleast_2d(np.ascontiguousarray(states))
+        n = feats.shape[0]
+        pr = (np.atleast_2d(np.ascontiguousarray(prices))
+              if prices is not None else None)
+        t, batcher = self._claim_batcher(tenant)
+        try:
+            with self._pending_lock:
+                keep = (n if t.max_pending is None
+                        else max(0, min(n, t.max_pending - t.pending)))
+                t.pending += keep
+            n_quota = n - keep
+            if n_quota:
+                obs_count("guard/shed", n_quota, reason="quota",
+                          tenant=t.name, lane="block")
+            if keep == 0:
+                fut = SlimFuture()
+                fut.set_result(all_shed_result(
+                    n, SHED_QUOTA, has_value=pr is not None,
+                    dtype=feats.dtype if feats.dtype.kind == "f"
+                    else np.float32))
+                return fut
+            dl = deadlines
+            if dl is not None and np.ndim(dl) == 1:
+                dl = np.asarray(dl)[:keep]  # the admitted head's budgets
+            try:
+                inner = batcher.submit_block(
+                    date_idx, feats[:keep],
+                    None if pr is None else pr[:keep], dl)
+            except BaseException:
+                self._rows_done(t, keep)  # reserved rows, never enqueued
+                raise
+            if n_quota == 0:
+                inner.add_done_callback(
+                    lambda _f, _t=t, _k=keep: self._rows_done(_t, _k))
+                return inner
+            # partial admission: the caller's future must still describe
+            # ALL n rows — append the quota-shed tail to the head's result
+            outer = SlimFuture()
+
+            def _forward(f, _t=t, _k=keep, _tail=n_quota):
+                self._rows_done(_t, _k)
+                exc = f.exception()
+                if exc is not None:
+                    outer.set_exception(exc)
+                else:
+                    outer.set_result(
+                        merge_tail_shed(f.result(), _tail, SHED_QUOTA))
+
+            inner.add_done_callback(_forward)
+            return outer
+        finally:
+            self._release_claim(t)
 
     def _request_done(self, t: _Tenant) -> None:
         with self._pending_lock:
             t.pending -= 1
+
+    def _rows_done(self, t: _Tenant, k: int) -> None:
+        with self._pending_lock:
+            t.pending -= k
 
     # -- hot reload ----------------------------------------------------------
 

@@ -1,0 +1,259 @@
+"""Columnar ingest plane: move requests in columns, not Python objects.
+
+PR 7 measured the continuous batcher's ceiling precisely: once dispatch is
+amortized and the device overlapped, the per-request *host-language* cost —
+one ``submit()`` call, one ``SlimFuture``, one ``_Request``, one dict-group
+insert per row — serializes the whole tier at ~6µs/request. That is the
+Orca lesson (Yu et al., PAPERS.md) taken one level down: continuous
+batching amortizes the DEVICE over requests; the next 10x amortizes the
+HOST over rows. Every production inference gateway lands on the same fix —
+struct-of-arrays request blocks whose per-row cost is a NumPy slice, with
+all Python object churn paid once per block:
+
+- a **block** is N rows for one rebalance date: a contiguous ``(n,
+  n_features)`` feature matrix, an optional ``(n, k)`` price matrix, an
+  optional per-row float64 deadline column — and exactly ONE
+  :class:`~orp_tpu.serve.batcher.SlimFuture` for all N rows;
+- guard semantics stay exact but become **vectorized**: deadline expiry is
+  a mask compare on the deadline column, watermark/quota shed the TAIL
+  rows of a block as a slice — never a per-row ``Rejection`` object;
+- the answer is a :class:`BlockResult`: contiguous ``phi``/``psi``/
+  ``value`` columns plus a per-row ``status`` column (:data:`SERVED` /
+  :data:`SHED_DEADLINE` / :data:`SHED_WATERMARK` / :data:`SHED_QUOTA`),
+  bitwise-equal on served rows to N per-request submits of the same rows
+  (pinned in ``tests/test_ingest.py``).
+
+Lint rule ORP013 enforces the discipline this module exists for: no
+``for`` loop over rows constructing objects, appending futures or calling
+``submit`` inside ingest-path code under ``serve/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import observe as obs_observe
+
+# per-row status codes (the BlockResult.status column / the wire's status
+# column — a u8, so the codec ships it with one tobytes)
+SERVED = 0
+SHED_DEADLINE = 1
+SHED_WATERMARK = 2
+SHED_QUOTA = 3
+
+STATUS_NAMES = {
+    SERVED: "served",
+    SHED_DEADLINE: "shed-deadline",
+    SHED_WATERMARK: "shed-watermark",
+    SHED_QUOTA: "shed-quota",
+}
+
+_SHED_REASON = {SHED_DEADLINE: "deadline", SHED_WATERMARK: "watermark",
+                SHED_QUOTA: "quota"}
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockResult:
+    """The columnar answer to a ``submit_block``: one contiguous column per
+    output, one status byte per row. Rows whose status is not
+    :data:`SERVED` carry zeros in the value columns — the status column,
+    not a sentinel value, is the contract (a legitimately-served phi can be
+    0.0).
+
+    ``phi``/``psi``: ``(n,)`` hedge ratios; ``value``: ``(n,)`` portfolio
+    values or None when the block carried no prices; ``status``: ``(n,)``
+    uint8 of status codes (:data:`STATUS_NAMES`).
+    """
+
+    phi: np.ndarray
+    psi: np.ndarray
+    value: np.ndarray | None
+    status: np.ndarray
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.status.shape[0])
+
+    @property
+    def served_mask(self) -> np.ndarray:
+        """Boolean column: True where the row was served."""
+        return self.status == SERVED
+
+    @property
+    def n_served(self) -> int:
+        return int(np.count_nonzero(self.status == SERVED))
+
+    def shed_counts(self) -> dict[str, int]:
+        """Rows per non-served status name (zero-count statuses omitted)."""
+        codes, counts = np.unique(self.status, return_counts=True)
+        return {STATUS_NAMES[int(c)]: int(k)
+                for c, k in zip(codes, counts) if int(c) != SERVED}
+
+
+def all_shed_result(n: int, code: int, *, has_value: bool,
+                    dtype=np.float32) -> BlockResult:
+    """A block that never reached the device: every row shed with ``code``
+    (quota at the host, watermark at submit, deadline for a block that
+    expired whole)."""
+    z = np.zeros(n, dtype)
+    return BlockResult(
+        phi=z, psi=z.copy(),
+        value=np.zeros(n, dtype) if has_value else None,
+        status=np.full(n, code, np.uint8),
+    )
+
+
+def merge_tail_shed(head: BlockResult, n_tail: int, code: int) -> BlockResult:
+    """Extend ``head`` (the admitted prefix of a block) with ``n_tail``
+    tail rows shed as ``code`` — the quota/watermark tail-slice semantics:
+    the shed rows were never objects, so the merge is two concatenates and
+    a fill."""
+    if n_tail <= 0:
+        return head
+    tail = all_shed_result(n_tail, code, has_value=head.value is not None,
+                           dtype=head.phi.dtype)
+    return BlockResult(
+        phi=np.concatenate([head.phi, tail.phi]),
+        psi=np.concatenate([head.psi, tail.psi]),
+        value=(None if head.value is None
+               else np.concatenate([head.value, tail.value])),
+        status=np.concatenate([head.status, tail.status]),
+    )
+
+
+class Block:
+    """One admitted request block as the batcher tracks it: the columns,
+    the per-row status ledger, and the single future the whole block
+    resolves through. All mutation is vectorized — the ORP013 contract.
+
+    ``deadlines`` is an absolute-``perf_counter`` float64 column (or None:
+    rows never expire); ``status`` starts all-:data:`SERVED` and rows are
+    struck off by slice (watermark tail at submit) or mask (deadline at
+    admit) before dispatch. ``features``/``prices`` keep the FULL n rows —
+    the live subset is sliced out only at dispatch, so the clean path
+    (nothing shed) dispatches the caller's own contiguous arrays with zero
+    copies.
+    """
+
+    __slots__ = ("date_idx", "features", "prices", "future", "submitted_at",
+                 "deadlines", "status", "n")
+
+    def __init__(self, date_idx: int, features, prices, future,
+                 submitted_at: float, deadlines):
+        self.date_idx = int(date_idx)
+        self.features = features            # (n, n_features), contiguous
+        self.prices = prices                # (n, k) or None
+        self.future = future                # ONE SlimFuture for the block
+        self.submitted_at = submitted_at
+        self.deadlines = deadlines          # (n,) float64 absolute, or None
+        self.n = int(features.shape[0])
+        self.status = np.zeros(self.n, np.uint8)
+
+    @property
+    def n_live(self) -> int:
+        return int(np.count_nonzero(self.status == SERVED))
+
+    def shed_tail(self, keep: int, code: int) -> int:
+        """Watermark/quota semantics: strike every row past ``keep`` (that
+        is still live) with ``code``; returns how many rows were struck."""
+        tail = self.status[max(0, keep):]
+        struck = tail == SERVED
+        tail[struck] = code
+        return int(np.count_nonzero(struck))
+
+    def mask_expired(self, now: float) -> int:
+        """Deadline semantics, vectorized: one compare against the deadline
+        column strikes every live row whose deadline has passed; returns
+        how many rows were struck."""
+        if self.deadlines is None:
+            return 0
+        expired = (self.status == SERVED) & (self.deadlines < now)
+        k = int(np.count_nonzero(expired))
+        if k:
+            self.status[expired] = SHED_DEADLINE
+        return k
+
+    def live_columns(self):
+        """The dispatchable columns: ``(features, prices)`` restricted to
+        live rows. The nothing-shed fast path returns the stored arrays
+        themselves — no copy, no concatenate."""
+        if self.n_live == self.n:
+            return self.features, self.prices
+        live = self.status == SERVED
+        return (np.ascontiguousarray(self.features[live]),
+                None if self.prices is None
+                else np.ascontiguousarray(self.prices[live]))
+
+    def emit_shed(self, code: int, n_rows: int) -> None:
+        """Guard signals for ``n_rows`` struck with ``code`` — ONE counter
+        bump (by row count) and ONE queue-age observation per block event,
+        mirroring the per-request lane's ``guard/shed`` /
+        ``serve/queue_age_seconds`` semantics at block cost."""
+        if n_rows <= 0:
+            return
+        obs_count("guard/shed", n_rows, reason=_SHED_REASON[code],
+                  lane="block")
+        obs_observe("serve/queue_age_seconds",
+                    time.perf_counter() - self.submitted_at, outcome="shed")
+
+    def resolve_shed_only(self) -> None:
+        """Resolve a block none of whose rows survived to dispatch (all
+        quota/watermark/deadline) — zeros in every value column, the status
+        column tells the story."""
+        if self.future.set_running_or_notify_cancel():
+            dt = self.features.dtype if self.features.dtype.kind == "f" \
+                else np.float32
+            z = np.zeros(self.n, dt)
+            self.future.set_result(BlockResult(
+                phi=z, psi=z.copy(),
+                value=np.zeros(self.n, dt) if self.prices is not None else None,
+                status=self.status,
+            ))
+
+    def resolve_served(self, phi, psi, value) -> None:
+        """Scatter the dispatched (live-row) results back into full-size
+        columns and resolve the block's one future. The nothing-shed fast
+        path hands the engine's arrays through untouched."""
+        if self.n_live == self.n:
+            out = BlockResult(phi=phi, psi=psi, value=value,
+                              status=self.status)
+        else:
+            live = self.status == SERVED
+            full_phi = np.zeros(self.n, phi.dtype)
+            full_psi = np.zeros(self.n, psi.dtype)
+            full_phi[live] = phi
+            full_psi[live] = psi
+            full_value = None
+            if value is not None:
+                full_value = np.zeros(self.n, value.dtype)
+                full_value[live] = value
+            out = BlockResult(phi=full_phi, psi=full_psi, value=full_value,
+                              status=self.status)
+        if self.future.set_running_or_notify_cancel():
+            self.future.set_result(out)
+
+
+def as_deadline_column(deadlines, n: int, now: float,
+                       default_s: float | None) -> np.ndarray | None:
+    """Normalise a caller's ``deadlines`` argument — None, a scalar budget
+    in seconds, or an ``(n,)`` per-row budget column — into the absolute
+    float64 deadline column the admit-time mask compares against. With no
+    per-row deadlines and no policy default, returns None (rows never
+    expire)."""
+    if deadlines is None:
+        if default_s is None:
+            return None
+        return np.full(n, now + default_s, np.float64)
+    col = np.asarray(deadlines, np.float64)
+    if col.ndim == 0:
+        return np.full(n, now + float(col), np.float64)
+    if col.shape != (n,):
+        raise ValueError(
+            f"deadlines column has shape {col.shape}; expected ({n},) — one "
+            "relative budget (seconds) per block row, or a scalar for all"
+        )
+    return now + col

@@ -1,0 +1,314 @@
+"""``orp-ingest-v1``: the columnar wire format of the ingest plane.
+
+A request crosses the process boundary as ONE versioned fixed-width
+little-endian frame — a 48-byte header plus raw feature/price/deadline
+columns — encoded and decoded with ``np.frombuffer``/``tobytes`` only.
+Zero per-row Python objects on either side (the ORP013 contract): the
+decoder's cost is a header validation plus three buffer views, whatever
+the row count; the gateway's whole per-frame Python bill IS the ingest
+overhead.
+
+Frame layout (all little-endian, no padding)::
+
+    magic      4s   b"ORPI"
+    version    u1   1
+    kind       u1   REQUEST | REPLY | ERROR | PING | PONG
+    dtype_tag  u1   1 = float32 value columns
+    flags      u1   REQUEST: bit0 prices, bit1 per-row deadlines
+                    REPLY:   bit0 value column present
+    tenant     16s  NUL-padded ASCII tenant name (REQUEST; else zeros)
+    date_idx   i4
+    n_rows     u4
+    n_features u4   (REQUEST; 0 otherwise)
+    n_prices   u4   (REQUEST; 0 otherwise)
+    deadline_ms f8  block-level deadline budget (NaN = none)
+
+followed by the payload columns, in order:
+
+- REQUEST: features ``f4[n_rows, n_features]``, prices ``f4[n_rows,
+  n_prices]`` (flag bit0), deadlines ``f8[n_rows]`` (flag bit1 —
+  per-row budgets in SECONDS, overriding ``deadline_ms``);
+- REPLY: status ``u1[n_rows]``, phi ``f4[n_rows]``, psi ``f4[n_rows]``,
+  value ``f4[n_rows]`` (flag bit0);
+- ERROR: the UTF-8 message (flag-speak: it names the field to fix);
+- PING/PONG: empty.
+
+The frame is self-describing in length: a decoder knows the exact payload
+size from the header, and ANY mismatch (bad magic, unknown version/kind/
+dtype, truncated or oversized payload, absurd row count) is refused with a
+:class:`WireError` whose message is what the gateway ships back in a
+structured ERROR frame — a malformed frame never reaches the batcher.
+Transport framing (the ``u4`` length prefix on the socket) belongs to the
+gateway; this module sees complete frame buffers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from orp_tpu.serve.ingest import BlockResult
+
+MAGIC = b"ORPI"
+VERSION = 1
+
+KIND_REQUEST = 1
+KIND_REPLY = 2
+KIND_ERROR = 3
+KIND_PING = 4
+KIND_PONG = 5
+
+_KIND_NAMES = {KIND_REQUEST: "request", KIND_REPLY: "reply",
+               KIND_ERROR: "error", KIND_PING: "ping", KIND_PONG: "pong"}
+
+DTYPE_F32 = 1
+_DTYPES = {DTYPE_F32: np.dtype("<f4")}
+
+FLAG_PRICES = 1     # request: a prices column follows the features
+FLAG_DEADLINES = 2  # request: a per-row f8 deadline column closes the frame
+FLAG_VALUE = 1      # reply: the value column is present
+
+TENANT_BYTES = 16
+#: refuse absurd frames before allocating anything for them
+MAX_ROWS = 1 << 24
+MAX_COLS = 1 << 16
+
+HEADER = np.dtype([
+    ("magic", "S4"),
+    ("version", "<u1"),
+    ("kind", "<u1"),
+    ("dtype_tag", "<u1"),
+    ("flags", "<u1"),
+    ("tenant", f"S{TENANT_BYTES}"),
+    ("date_idx", "<i4"),
+    ("n_rows", "<u4"),
+    ("n_features", "<u4"),
+    ("n_prices", "<u4"),
+    ("deadline_ms", "<f8"),
+])
+HEADER_BYTES = HEADER.itemsize  # 48
+
+
+class WireError(ValueError):
+    """A frame this codec refuses — malformed, truncated, or from a future
+    version. The message is flag-speak (it names what to fix) and is what
+    the gateway returns in a structured ERROR frame."""
+
+
+def _header(kind: int, *, dtype_tag: int = DTYPE_F32, flags: int = 0,
+            tenant: str = "", date_idx: int = 0, n_rows: int = 0,
+            n_features: int = 0, n_prices: int = 0,
+            deadline_ms: float = float("nan")) -> bytes:
+    t = tenant.encode("ascii")
+    if len(t) > TENANT_BYTES:
+        raise WireError(
+            f"tenant {tenant!r} exceeds the wire's {TENANT_BYTES}-byte "
+            "field — use a shorter tenant name")
+    h = np.zeros(1, HEADER)
+    h["magic"] = MAGIC
+    h["version"] = VERSION
+    h["kind"] = kind
+    h["dtype_tag"] = dtype_tag
+    h["flags"] = flags
+    h["tenant"] = t
+    h["date_idx"] = int(date_idx)
+    h["n_rows"] = int(n_rows)
+    h["n_features"] = int(n_features)
+    h["n_prices"] = int(n_prices)
+    h["deadline_ms"] = deadline_ms
+    return h.tobytes()
+
+
+# -- encode -------------------------------------------------------------------
+
+
+def encode_request(tenant: str, date_idx: int, states, prices=None,
+                   deadlines=None, *, deadline_ms: float | None = None) -> bytes:
+    """One request block as a frame: columns in, bytes out — no per-row
+    work. ``deadlines`` (per-row budgets, seconds) ships as an f8 column;
+    ``deadline_ms`` is the cheaper block-level budget when every row shares
+    one."""
+    feats = np.ascontiguousarray(np.atleast_2d(np.asarray(states)),
+                                 dtype="<f4")
+    n, f = feats.shape
+    parts = [feats.tobytes()]
+    flags = 0
+    n_prices = 0
+    if prices is not None:
+        pr = np.ascontiguousarray(np.atleast_2d(np.asarray(prices)),
+                                  dtype="<f4")
+        if pr.shape[0] != n:
+            raise WireError(
+                f"prices column has {pr.shape[0]} rows, features {n} — a "
+                "frame carries one row set")
+        flags |= FLAG_PRICES
+        n_prices = pr.shape[1]
+        parts.append(pr.tobytes())
+    if deadlines is not None:
+        col = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(deadlines, "<f8"), (n,)))
+        flags |= FLAG_DEADLINES
+        parts.append(col.tobytes())
+    head = _header(KIND_REQUEST, flags=flags, tenant=tenant,
+                   date_idx=date_idx, n_rows=n, n_features=f,
+                   n_prices=n_prices,
+                   deadline_ms=(float("nan") if deadline_ms is None
+                                else float(deadline_ms)))
+    return b"".join([head, *parts])
+
+
+def encode_reply(result: BlockResult, *, date_idx: int = 0) -> bytes:
+    """A BlockResult as a frame: the status column plus the contiguous
+    phi/psi(/value) columns, straight ``tobytes``."""
+    n = result.n_rows
+    flags = FLAG_VALUE if result.value is not None else 0
+    parts = [
+        np.ascontiguousarray(result.status, "u1").tobytes(),
+        np.ascontiguousarray(result.phi, "<f4").tobytes(),
+        np.ascontiguousarray(result.psi, "<f4").tobytes(),
+    ]
+    if result.value is not None:
+        parts.append(np.ascontiguousarray(result.value, "<f4").tobytes())
+    head = _header(KIND_REPLY, flags=flags, date_idx=date_idx, n_rows=n)
+    return b"".join([head, *parts])
+
+
+def encode_error(message: str) -> bytes:
+    """A structured refusal: the flag-speak message as the payload."""
+    body = message.encode("utf-8")
+    return _header(KIND_ERROR) + body
+
+
+def encode_ping() -> bytes:
+    return _header(KIND_PING)
+
+
+def encode_pong() -> bytes:
+    return _header(KIND_PONG)
+
+
+# -- decode -------------------------------------------------------------------
+
+
+def _decode_header(buf) -> np.void:
+    if len(buf) < HEADER_BYTES:
+        raise WireError(
+            f"frame of {len(buf)} bytes is shorter than the {HEADER_BYTES}-"
+            "byte orp-ingest-v1 header")
+    h = np.frombuffer(buf, HEADER, count=1)[0]
+    if bytes(h["magic"]) != MAGIC:
+        raise WireError(
+            f"bad magic {bytes(h['magic'])!r}; this endpoint speaks "
+            "orp-ingest-v1 frames (magic b'ORPI')")
+    if int(h["version"]) != VERSION:
+        raise WireError(
+            f"frame version {int(h['version'])} != {VERSION}; upgrade the "
+            "older side of this connection")
+    if int(h["kind"]) not in _KIND_NAMES:
+        raise WireError(f"unknown frame kind {int(h['kind'])}")
+    return h
+
+
+def decode_kind(buf) -> int:
+    """Validate the header and return the frame kind — the gateway's one
+    branch point per frame."""
+    return int(_decode_header(buf)["kind"])
+
+
+def _expect(buf, expected: int, what: str) -> None:
+    if len(buf) != expected:
+        raise WireError(
+            f"{what} frame is {len(buf)} bytes, expected {expected} from "
+            "its own header — truncated or corrupt")
+
+
+def decode_request(buf) -> dict:
+    """Decode a REQUEST frame into the ``submit_block`` arguments:
+    ``{"tenant", "date_idx", "states", "prices", "deadlines"}``. Columns
+    are zero-copy read-only views over ``buf`` (the engine pads from them
+    without writing). Any malformation raises :class:`WireError` with the
+    field to fix."""
+    h = _decode_header(buf)
+    if int(h["kind"]) != KIND_REQUEST:
+        raise WireError(
+            f"expected a request frame, got {_KIND_NAMES[int(h['kind'])]}")
+    dt = _DTYPES.get(int(h["dtype_tag"]))
+    if dt is None:
+        raise WireError(
+            f"unknown dtype tag {int(h['dtype_tag'])}; this build serves "
+            f"{sorted(_DTYPES)} (1 = float32)")
+    n = int(h["n_rows"])
+    f = int(h["n_features"])
+    k = int(h["n_prices"])
+    flags = int(h["flags"])
+    if not 1 <= n <= MAX_ROWS:
+        raise WireError(
+            f"n_rows={n} outside [1, {MAX_ROWS}] — split the block")
+    if not 1 <= f <= MAX_COLS:
+        raise WireError(f"n_features={f} outside [1, {MAX_COLS}]")
+    has_prices = bool(flags & FLAG_PRICES)
+    if has_prices and not 1 <= k <= MAX_COLS:
+        raise WireError(f"n_prices={k} outside [1, {MAX_COLS}] with the "
+                        "prices flag set")
+    if not has_prices and k:
+        raise WireError(f"n_prices={k} without the prices flag — set flag "
+                        "bit0 or zero the count")
+    has_deadlines = bool(flags & FLAG_DEADLINES)
+    expected = (HEADER_BYTES + 4 * n * f + (4 * n * k if has_prices else 0)
+                + (8 * n if has_deadlines else 0))
+    _expect(buf, expected, "request")
+    off = HEADER_BYTES
+    states = np.frombuffer(buf, dt, count=n * f, offset=off).reshape(n, f)
+    off += 4 * n * f
+    prices = None
+    if has_prices:
+        prices = np.frombuffer(buf, dt, count=n * k, offset=off).reshape(n, k)
+        off += 4 * n * k
+    deadlines = None
+    if has_deadlines:
+        deadlines = np.frombuffer(buf, "<f8", count=n, offset=off)
+    elif np.isfinite(h["deadline_ms"]):
+        deadlines = float(h["deadline_ms"]) / 1e3
+    tenant = bytes(h["tenant"]).rstrip(b"\x00").decode("ascii")
+    return {
+        "tenant": tenant,
+        "date_idx": int(h["date_idx"]),
+        "states": states,
+        "prices": prices,
+        "deadlines": deadlines,
+    }
+
+
+def decode_reply(buf) -> BlockResult:
+    """Decode a REPLY frame back into a :class:`BlockResult` (read-only
+    column views)."""
+    h = _decode_header(buf)
+    if int(h["kind"]) == KIND_ERROR:
+        raise WireError(decode_error(buf))
+    if int(h["kind"]) != KIND_REPLY:
+        raise WireError(
+            f"expected a reply frame, got {_KIND_NAMES[int(h['kind'])]}")
+    n = int(h["n_rows"])
+    if not 1 <= n <= MAX_ROWS:
+        raise WireError(f"n_rows={n} outside [1, {MAX_ROWS}]")
+    has_value = bool(int(h["flags"]) & FLAG_VALUE)
+    expected = HEADER_BYTES + n * (1 + 4 + 4 + (4 if has_value else 0))
+    _expect(buf, expected, "reply")
+    off = HEADER_BYTES
+    status = np.frombuffer(buf, "u1", count=n, offset=off)
+    off += n
+    phi = np.frombuffer(buf, "<f4", count=n, offset=off)
+    off += 4 * n
+    psi = np.frombuffer(buf, "<f4", count=n, offset=off)
+    off += 4 * n
+    value = (np.frombuffer(buf, "<f4", count=n, offset=off)
+             if has_value else None)
+    return BlockResult(phi=phi, psi=psi, value=value, status=status)
+
+
+def decode_error(buf) -> str:
+    """The flag-speak message of an ERROR frame."""
+    h = _decode_header(buf)
+    if int(h["kind"]) != KIND_ERROR:
+        raise WireError(
+            f"expected an error frame, got {_KIND_NAMES[int(h['kind'])]}")
+    return bytes(buf[HEADER_BYTES:]).decode("utf-8", errors="replace")

@@ -1018,3 +1018,114 @@ def test_cli_resume_flag(tmp_path):
     args = parser.parse_args(["euro", "--nan-guard", "--nan-retries", "1"])
     cfg = _train_cfg(args, "mse_only")
     assert cfg.nan_guard and cfg.nan_retries == 1
+
+
+# -- the columnar block lane under chaos --------------------------------------
+#
+# PR 10's acceptance bar: every guard semantic proven above for the
+# per-request lane holds on the block lane — but VECTORIZED: deadline
+# expiry is a mask on the float64 deadline column, watermark/quota shed
+# tail slices, a transient retry re-dispatches the block whole, and a
+# device loss traps and replays the WHOLE block bitwise. No sleep > 50ms.
+
+
+def test_block_lane_deadline_mask_sheds_expired_rows(trained):
+    """One slow dispatch occupies the worker; a queued block with mixed
+    per-row deadlines comes back with the aged-out rows struck by the mask
+    (status column pinned) while the surviving rows serve BITWISE —
+    per-row guard semantics at block cost."""
+    from orp_tpu.serve.ingest import SERVED, SHED_DEADLINE
+
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([1, 3, 6])
+    feats = _rows(6, nf, seed=3)
+    live_idx = [1, 3, 5]
+    ref_phi, ref_psi, _ = engine.evaluate(0, feats[live_idx])
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(delay={"serve/dispatch": (1, 0.04)})):
+            with MicroBatcher(engine, max_batch=8, max_wait_us=200.0,
+                              policy=GuardPolicy(deadline_ms=500.0)) as mb:
+                blocker = mb.submit(0, _rows(1, nf))
+                time.sleep(0.005)  # worker now inside the 40ms dispatch
+                deadlines = np.array([0.005, 1.0, 0.005, 1.0, 0.005, 1.0])
+                res = mb.submit_block(0, feats,
+                                      deadlines=deadlines).result(timeout=30)
+    assert not is_rejection(blocker.result())
+    np.testing.assert_array_equal(
+        res.status, [SHED_DEADLINE, SERVED] * 3)
+    np.testing.assert_array_equal(res.phi[live_idx], ref_phi)
+    np.testing.assert_array_equal(res.psi[live_idx], ref_psi)
+    assert (res.phi[[0, 2, 4]] == 0).all()
+    assert res.shed_counts() == {"shed-deadline": 3}
+    assert reg.counter("guard/shed",
+                       {"reason": "deadline", "lane": "block"}).value == 3
+
+
+def test_block_lane_transient_retry_recovers_whole_block(trained):
+    """One injected transient dispatch failure: the bounded retry policy
+    re-dispatches the BLOCK (one resubmission, not N), and the block
+    resolves bitwise with every row served."""
+    engine = HedgeEngine(trained)
+    nf = trained.model.n_features
+    engine.prewarm([6])
+    feats = _rows(6, nf, seed=21)
+    ref_phi, _, _ = engine.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with guard.faults(FaultPlan(fail={"serve/dispatch": 1})) as inj:
+            with MicroBatcher(engine, max_wait_us=200.0,
+                              policy=GuardPolicy(max_retries=2,
+                                                 backoff_ms=1.0)) as mb:
+                res = mb.submit_block(0, feats).result(timeout=30)
+    assert [site for site, _ in inj.log] == ["serve/dispatch"]
+    assert res.n_served == 6
+    np.testing.assert_array_equal(res.phi, ref_phi)
+    assert reg.counter("guard/retry",
+                       {"site": "serve/dispatch", "attempt": "1"}).value == 1
+
+
+def test_block_lane_retry_budget_exhausted_fails_block(trained):
+    """Exhausted retries deliver the error through the block's ONE future —
+    never a partial result, never a stranded caller."""
+    engine = HedgeEngine(trained)
+    engine.prewarm([4])
+    with guard.faults(FaultPlan(fail={"serve/dispatch": 5})):
+        with MicroBatcher(engine, max_wait_us=200.0,
+                          policy=GuardPolicy(max_retries=1,
+                                             backoff_ms=1.0)) as mb:
+            fut = mb.submit_block(0, _rows(4, trained.model.n_features))
+            with pytest.raises(guard.InjectedFault):
+                fut.result(timeout=30)
+
+
+def test_block_lane_device_loss_replays_whole_block_bitwise(topo_aot_bundle):
+    """Device loss under an in-flight block on the 8-device mesh: the WHOLE
+    block is trapped (its caller never sees the loss), the engine rebuilds
+    on the 4-device surviving submesh with zero XLA compiles, and the
+    replayed block resolves BITWISE the healthy single-device engine's
+    answer with every row served."""
+    ref = HedgeEngine(topo_aot_bundle, use_aot=False)
+    nf = topo_aot_bundle.model.n_features
+    feats = _rows(8, nf, seed=17)
+    ref_phi, ref_psi, _ = ref.evaluate(0, feats)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with DegradeManager(topo_aot_bundle, mesh=8) as mgr:
+            healthy = mgr.submit_block(0, feats).result(timeout=120)
+            with guard.faults(FaultPlan(device_loss={"serve/dispatch": 1},
+                                        survivors=7)) as inj:
+                replayed = mgr.submit_block(0, feats).result(timeout=120)
+            recovered = mgr.submit_block(0, feats).result(timeout=120)
+            st = mgr.stats()
+    assert [site for site, _ in inj.log] == ["serve/dispatch"]
+    for res in (healthy, replayed, recovered):
+        assert res.n_served == 8
+        np.testing.assert_array_equal(res.phi, ref_phi)
+        np.testing.assert_array_equal(res.psi, ref_psi)
+    assert st["mesh_devices"] == 4
+    [rec] = st["recoveries"]
+    assert rec["replayed"] == 1 and rec["replay_unresolved"] == 0
+    assert rec["rebuild_xla_compiles"] == 0  # the 4-dev AOT set shipped
+    assert reg.counter("guard/device_loss", {"survivors": "7"}).value == 1

@@ -1,0 +1,366 @@
+"""Columnar ingest-plane oracles (orp_tpu/serve/{ingest,wire,gateway}):
+the block lane serves BITWISE what N per-request submits serve, the
+orp-ingest-v1 codec round-trips columns exactly and refuses malformed
+frames in flag-speak, the TCP gateway's loopback reply carries bitwise the
+same values as a direct engine evaluation of the same rows (the acceptance
+pin), quotas count rows and shed tails as slices, and the
+``serve-bench --ingest --quick`` smoke regression-gates the amortized
+submit-cost claim."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.serve import (
+    SERVED,
+    SHED_QUOTA,
+    SHED_WATERMARK,
+    GatewayClient,
+    GatewayError,
+    HedgeEngine,
+    MicroBatcher,
+    ServeGateway,
+    ServeHost,
+    export_bundle,
+)
+from orp_tpu.serve import wire
+from orp_tpu.serve.ingest import BlockResult, all_shed_result, merge_tail_shed
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+def _rows(n, nf=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return (1.0 + 0.1 * rng.standard_normal((n, nf))).astype(np.float32)
+
+
+# -- wire codec ---------------------------------------------------------------
+
+
+def test_wire_request_roundtrip_bit_for_bit():
+    feats = _rows(6, 3, seed=1)
+    prices = _rows(6, 2, seed=2)
+    dl = np.array([0.1, 0.2, 0.3, 0.4, 0.5, 0.6])
+    buf = wire.encode_request("desk-a", 3, feats, prices, dl)
+    req = wire.decode_request(buf)
+    assert req["tenant"] == "desk-a" and req["date_idx"] == 3
+    np.testing.assert_array_equal(req["states"], feats)
+    np.testing.assert_array_equal(req["prices"], prices)
+    np.testing.assert_array_equal(req["deadlines"], dl)
+    # header-level (scalar) deadline, no per-row column, no prices
+    buf2 = wire.encode_request("desk-a", 0, feats, deadline_ms=250.0)
+    req2 = wire.decode_request(buf2)
+    assert req2["prices"] is None
+    assert req2["deadlines"] == pytest.approx(0.25)
+    # the fixed-width header is the versioned contract: 48 packed bytes
+    assert wire.HEADER_BYTES == 48
+    assert buf2[:4] == b"ORPI"
+
+
+def test_wire_reply_and_error_roundtrip():
+    res = BlockResult(phi=_rows(5)[:, 0], psi=_rows(5, seed=2)[:, 0],
+                      value=_rows(5, seed=3)[:, 0],
+                      status=np.array([0, 1, 2, 3, 0], np.uint8))
+    back = wire.decode_reply(wire.encode_reply(res))
+    np.testing.assert_array_equal(back.phi, res.phi)
+    np.testing.assert_array_equal(back.psi, res.psi)
+    np.testing.assert_array_equal(back.value, res.value)
+    np.testing.assert_array_equal(back.status, res.status)
+    # value column is optional, flagged in the header
+    novalue = BlockResult(phi=res.phi, psi=res.psi, value=None,
+                          status=res.status)
+    assert wire.decode_reply(wire.encode_reply(novalue)).value is None
+    # error frames carry the flag-speak message; decode_reply surfaces it
+    err = wire.encode_error("--tenant names nobody")
+    assert wire.decode_kind(err) == wire.KIND_ERROR
+    assert wire.decode_error(err) == "--tenant names nobody"
+    with pytest.raises(wire.WireError, match="names nobody"):
+        wire.decode_reply(err)
+
+
+def test_wire_refuses_malformed_frames_in_flagspeak():
+    feats = _rows(4)
+    good = wire.encode_request("t", 0, feats)
+    with pytest.raises(wire.WireError, match="shorter than"):
+        wire.decode_request(good[:10])
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.decode_request(b"XXXX" + good[4:])
+    bad_ver = bytearray(good)
+    bad_ver[4] = 9
+    with pytest.raises(wire.WireError, match="version 9"):
+        wire.decode_request(bytes(bad_ver))
+    with pytest.raises(wire.WireError, match="truncated or corrupt"):
+        wire.decode_request(good + b"extra")
+    with pytest.raises(wire.WireError, match="expected a request"):
+        wire.decode_request(wire.encode_ping())
+    # a row count the payload cannot back is refused BEFORE any view math
+    bad_rows = bytearray(good)
+    h = np.frombuffer(bytes(bad_rows[:wire.HEADER_BYTES]),
+                      wire.HEADER).copy()
+    h["n_rows"] = 10_000
+    bad_rows[:wire.HEADER_BYTES] = h.tobytes()
+    with pytest.raises(wire.WireError, match="truncated or corrupt"):
+        wire.decode_request(bytes(bad_rows))
+    with pytest.raises(wire.WireError, match="16-byte"):
+        wire.encode_request("a-tenant-name-way-too-long", 0, feats)
+
+
+def test_block_result_helpers():
+    shed = all_shed_result(3, SHED_QUOTA, has_value=True)
+    assert shed.n_served == 0 and shed.shed_counts() == {"shed-quota": 3}
+    head = BlockResult(phi=np.ones(2, np.float32), psi=np.zeros(2, np.float32),
+                       value=None, status=np.zeros(2, np.uint8))
+    merged = merge_tail_shed(head, 2, SHED_QUOTA)
+    assert merged.n_rows == 4 and merged.n_served == 2
+    np.testing.assert_array_equal(merged.status, [0, 0, 3, 3])
+    np.testing.assert_array_equal(merged.phi, [1, 1, 0, 0])
+
+
+# -- block lane ---------------------------------------------------------------
+
+
+def test_submit_block_bitwise_equals_per_request_submits(trained):
+    """THE block-lane acceptance pin: one submit_block of N rows resolves
+    to columns bitwise-equal to N per-request submits of the same rows —
+    the lane changes the Python admission cost, never the answer."""
+    engine = HedgeEngine(trained)
+    feats = _rows(10, seed=7)
+    prices = np.stack([feats[:, 0],
+                       np.full(10, 1.02, np.float32)], axis=1)
+    with MicroBatcher(engine, max_wait_us=50_000.0) as mb:
+        per_req = [mb.submit(1, feats[i:i + 1], prices[i:i + 1])
+                   for i in range(10)]
+        blk = mb.submit_block(1, feats, prices)
+        got = [f.result(timeout=30) for f in per_req]
+        res = blk.result(timeout=30)
+    assert isinstance(res, BlockResult)
+    assert res.n_rows == 10 and res.n_served == 10
+    assert (res.status == SERVED).all()
+    np.testing.assert_array_equal(res.phi,
+                                  np.concatenate([g[0] for g in got]))
+    np.testing.assert_array_equal(res.psi,
+                                  np.concatenate([g[1] for g in got]))
+    np.testing.assert_array_equal(res.value,
+                                  np.concatenate([g[2] for g in got]))
+    # and both equal the direct engine evaluation of the same rows
+    phi, psi, value = engine.evaluate(1, feats, prices)
+    np.testing.assert_array_equal(res.phi, phi)
+    np.testing.assert_array_equal(res.psi, psi)
+    np.testing.assert_array_equal(res.value, value)
+
+
+def test_submit_block_shapes_and_validation(trained):
+    engine = HedgeEngine(trained)
+    with MicroBatcher(engine, max_wait_us=50_000.0) as mb:
+        # a single feature row promotes to a 1-row block
+        res = mb.submit_block(0, np.ones(1, np.float32)).result(timeout=30)
+        assert res.n_rows == 1 and res.value is None
+        with pytest.raises(ValueError, match="one row set"):
+            mb.submit_block(0, _rows(4), _rows(3, 2))
+        bad = mb.submit_block(0, np.ones((2, 3), np.float32))  # wrong width
+        with pytest.raises(ValueError, match="features"):
+            bad.result(timeout=30)
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit_block(0, _rows(2))
+
+
+def test_host_submit_block_quota_counts_rows_and_sheds_tail(trained):
+    """Host quota on the block lane: max_pending is a ROW budget; rows past
+    it come back as a quota-shed TAIL slice, head rows serve bitwise, and
+    the budget is released when the block resolves."""
+    engine = HedgeEngine(trained)
+    feats = _rows(7, seed=11)
+    with ServeHost() as host:
+        host.add_tenant("t", trained, max_pending=4)
+        res = host.submit_block("t", 0, feats).result(timeout=30)
+        np.testing.assert_array_equal(res.status, [0, 0, 0, 0, 3, 3, 3])
+        phi, psi, _ = engine.evaluate(0, feats[:4])
+        np.testing.assert_array_equal(res.phi[:4], phi)
+        np.testing.assert_array_equal(res.psi[:4], psi)
+        assert (res.phi[4:] == 0).all()
+        # budget released at resolution: a fresh full block serves whole
+        res2 = host.submit_block("t", 0, feats[:4]).result(timeout=30)
+        assert res2.n_served == 4
+    # a block arriving with ZERO budget left is all-quota at zero cost —
+    # the wide coalescing window keeps the first block unresolved (budget
+    # held) while the second submits, so the shed is deterministic
+    with ServeHost(batcher_kwargs={"max_wait_us": 50_000.0}) as host:
+        host.add_tenant("z", trained, max_pending=2)
+        f1 = host.submit_block("z", 0, feats)          # takes the budget
+        res3 = host.submit_block("z", 0, feats[:3]).result(timeout=30)
+        f1.result(timeout=30)
+        assert res3.shed_counts() == {"shed-quota": 3}
+    with pytest.raises(RuntimeError, match="closed"):
+        host.submit_block("t", 0, feats)
+
+
+# -- gateway ------------------------------------------------------------------
+
+
+def test_gateway_loopback_bitwise_equals_direct_evaluate(tmp_path, trained):
+    """THE gateway acceptance pin: encode → TCP → decode → submit_block →
+    encode reply → decode returns bitwise the same values as a direct
+    ``engine.evaluate`` of the same rows."""
+    engine = HedgeEngine(trained)
+    feats = _rows(9, seed=5)
+    prices = np.stack([feats[:, 0], np.full(9, 1.02, np.float32)], axis=1)
+    with ServeHost(max_live_engines=1) as host:
+        host.add_tenant("desk", trained)
+        with ServeGateway(host, port=0) as gw:
+            with GatewayClient(*gw.address) as client:
+                assert client.ping()
+                res = client.submit_block("desk", 2, feats, prices)
+                res_nop = client.submit_block("desk", 2, feats)
+                with pytest.raises(GatewayError, match="unknown tenant"):
+                    client.submit_block("nobody", 0, feats)
+                # read the ledger while the connection is still live (its
+                # row is dropped once the peer closes)
+                stats = gw.stats()
+    phi, psi, value = engine.evaluate(2, feats, prices)
+    assert (res.status == SERVED).all()
+    np.testing.assert_array_equal(res.phi, phi)
+    np.testing.assert_array_equal(res.psi, psi)
+    np.testing.assert_array_equal(res.value, value)
+    assert res_nop.value is None
+    np.testing.assert_array_equal(res_nop.phi, phi)
+    # per-connection ledger saw the frames and the error
+    [conn] = stats.values()
+    assert conn["frames"] == 4 and conn["rows"] == 18 and conn["errors"] == 1
+
+
+def test_gateway_answers_malformed_frames_with_error_frames(trained):
+    import socket
+    import struct
+
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, default_tenant="d") as gw:
+            addr, port = gw.address
+            s = socket.create_connection((addr, port), timeout=10)
+            try:
+                s.sendall(struct.pack("<I", 12) + b"not-a-frame!")
+                ln = s.recv(4)
+                body = b""
+                want = struct.unpack("<I", ln)[0]
+                while len(body) < want:
+                    body += s.recv(want - len(body))
+                assert wire.decode_kind(body) == wire.KIND_ERROR
+                assert "orp-ingest-v1" in wire.decode_error(body)
+            finally:
+                s.close()
+            # the gateway survives the bad client: a good one still serves
+            with GatewayClient(addr, port) as client:
+                res = client.submit_block("", 0, _rows(3))  # default tenant
+                assert res.n_served == 3
+
+
+def test_doctor_probes_gateway_liveness(trained):
+    from orp_tpu.serve.health import doctor_report
+
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0) as gw:
+            addr, port = gw.address
+            rep = doctor_report(gateway=f"{addr}:{port}")
+            [check] = [c for c in rep["checks"] if c["check"] == "gateway"]
+            assert check["ok"] and "PING/PONG ok" in check["detail"]
+    # a dead endpoint fails with the serve-gateway fix in flag-speak
+    rep = doctor_report(gateway=f"{addr}:{port}")
+    [check] = [c for c in rep["checks"] if c["check"] == "gateway"]
+    assert not check["ok"] and "serve-gateway" in check["fix"]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_serve_bench_ingest_quick_smoke(tmp_path, capsys, trained):
+    """The CI satellite: `serve-bench --ingest --quick` runs the three-lane
+    sweep at tiny sizes and the speedup claim is regression-gated — the
+    command FAILS unless columnar submit_ns_per_row beats the per-request
+    path at bitwise-equal served bits."""
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    cli.main([
+        "serve-bench", "--bundle", str(bdir), "--requests", "8",
+        "--batcher-requests", "8", "--sweep-concurrency", "",
+        "--ingest", "--quick", "--out", "",
+    ])
+    rec = json.loads(capsys.readouterr().out.strip())
+    ing = rec["ingest"]
+    assert ing["bitwise_equal_to_per_request"] is True
+    assert ing["xla_compiles"] == 0
+    assert rec["submit_ns_per_row"] == ing["columnar"][-1]["submit_ns_per_row"]
+    assert rec["ingest_rows_per_s"] > 0
+    # the regression gate: columnar admission beats per-request admission
+    assert (ing["submit_ns_per_row"]
+            < ing["per_request"]["submit_ns_per_row"])
+    assert ing["submit_speedup_vs_per_request"] > 1
+    # all three lanes measured at every block size
+    assert [c["block"] for c in ing["columnar"]] == ing["block_sizes"]
+    assert [g["block"] for g in ing["gateway"]] == ing["block_sizes"]
+
+
+def test_cli_serve_gateway_ready_file_and_drain(tmp_path, trained):
+    """`orp serve-gateway` smoke: binds --port 0, drops the ready file,
+    serves orp-ingest-v1 blocks bitwise, drains at --max-seconds."""
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    ready = tmp_path / "gw.addr"
+    t = threading.Thread(target=cli.main, args=([
+        "serve-gateway", "--bundle", str(bdir), "--port", "0",
+        "--ready-file", str(ready), "--max-seconds", "20", "--json",
+    ],), daemon=True)
+    t.start()
+    deadline = time.perf_counter() + 15
+    while not ready.exists() and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    assert ready.exists(), "gateway never wrote its ready file"
+    addr, port = ready.read_text().split()
+    engine = HedgeEngine(trained)
+    feats = _rows(5, seed=9)
+    with GatewayClient(addr, int(port)) as client:
+        res = client.submit_block("default", 0, feats)
+    phi, _, _ = engine.evaluate(0, feats)
+    np.testing.assert_array_equal(res.phi, phi)
+    # not joining t to its 20s wall: the daemon thread dies with the
+    # process; the serve path above is the smoke
+
+
+def test_block_lane_watermark_sheds_tail_rows_as_slice(trained):
+    """Row-counted watermark on the block lane: rows past the watermark
+    come back as a SHED_WATERMARK tail slice while the head serves
+    bitwise — no Rejection objects anywhere."""
+    from orp_tpu import obs
+    from orp_tpu.guard import GuardPolicy
+
+    engine = HedgeEngine(trained)
+    engine.prewarm([4])
+    feats = _rows(8, seed=13)
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        with MicroBatcher(engine, max_wait_us=200.0,
+                          policy=GuardPolicy(queue_watermark=4)) as mb:
+            res = mb.submit_block(0, feats).result(timeout=30)
+    np.testing.assert_array_equal(res.status,
+                                  [SERVED] * 4 + [SHED_WATERMARK] * 4)
+    phi, psi, _ = engine.evaluate(0, feats[:4])
+    np.testing.assert_array_equal(res.phi[:4], phi)
+    np.testing.assert_array_equal(res.psi[:4], psi)
+    assert (res.phi[4:] == 0).all() and (res.psi[4:] == 0).all()
+    assert reg.counter("guard/shed",
+                       {"reason": "watermark", "lane": "block"}).value == 4

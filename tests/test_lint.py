@@ -25,7 +25,7 @@ def codes(src, **kw):
 
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
-                          | {"ORP010", "ORP011", "ORP012"})
+                          | {"ORP010", "ORP011", "ORP012", "ORP013"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -806,6 +806,79 @@ def test_orp012_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/host.py") == []
+
+
+# -- ORP013: per-row Python work in columnar ingest-path code ------------------
+
+ORP013_POS = """
+    from orp_tpu.serve.batcher import SlimFuture
+
+    def decode_rows(buf, batcher):
+        futs = []
+        for row in buf:
+            fut = SlimFuture()        # a future per row
+            futs.append(fut)          # a list append per row
+            batcher.submit(0, row)    # a submit per row
+        return futs
+
+    def submit_block(rows, mb):
+        out = []
+        for r in rows:
+            out.append(mb.submit_block(0, r))
+        return out
+"""
+
+ORP013_NEG = """
+    import numpy as np
+
+    def decode_request(buf):
+        # columnar: header view + column views, no per-row Python
+        n = int(np.frombuffer(buf, "<u4", count=1)[0])
+        feats = np.frombuffer(buf, "<f4", offset=4).reshape(n, -1)
+        for name in ("a", "b"):         # a loop over FIELDS is fine
+            print(name)
+        return feats
+
+    def encode_reply(result):
+        return result.status.tobytes() + result.phi.tobytes()
+
+    def route(batcher, rows):
+        # non-ingest-path functions are out of scope
+        futs = []
+        for r in rows:
+            futs.append(batcher.submit(0, r))
+        return futs
+"""
+
+
+def test_orp013_flags_per_row_work_in_ingest_path():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP013_POS),
+                                       path="orp_tpu/serve/ingest.py")]
+    # SlimFuture + append + submit in decode_rows; the append(submit_block)
+    # line in submit_block (one finding per line per rule)
+    assert got.count("ORP013") == 4
+
+
+def test_orp013_scopes_to_serve_paths():
+    assert lint_source(textwrap.dedent(ORP013_POS),
+                       path="orp_tpu/train/backward.py") == []
+
+
+def test_orp013_clean_negative():
+    assert lint_source(textwrap.dedent(ORP013_NEG),
+                       path="orp_tpu/serve/wire.py") == []
+
+
+def test_orp013_noqa_suppresses():
+    src = """
+        def ingest_bench(mb, rows):
+            futs = []
+            for r in rows:
+                futs.append(mb.submit(0, r))  # orp: noqa[ORP013] -- the per-request lane being measured
+            return futs
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/bench.py") == []
 
 
 # -- suppressions ------------------------------------------------------------
