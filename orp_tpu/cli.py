@@ -39,11 +39,17 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 ``--prewarm`` asserts no compile lands in the measured
                 window; ``--ingest`` appends the columnar-ingest sweep
                 (per-request vs ``submit_block`` vs gateway loopback, bits
-                pinned equal, ``submit_ns_per_row`` headline)
-- ``serve-gateway`` serve a bundle over the ``orp-ingest-v1`` TCP front
+                pinned equal, ``submit_ns_per_row`` headline);
+                ``--gateway-drill`` appends the kill-at-frame-k delivery
+                drill (frame-level MTTR, ``rows_lost: 0``)
+- ``serve-gateway`` serve a bundle over the ``orp-ingest`` TCP front
                 (``orp_tpu/serve/gateway.py``): length-prefixed columnar
                 frames in, columnar replies out — the non-Python-per-row
-                ingest plane; ``orp doctor --gateway host:port`` probes it
+                ingest plane, with v2 delivery guarantees (sequencing,
+                reconnect-replay dedup, frame deadlines, BUSY
+                backpressure, drain-and-redirect; SIGTERM/SIGINT run the
+                graceful zero-loss drain); ``orp doctor --gateway
+                host:port`` probes it
 - ``warm``      pre-populate the persistent XLA compile cache for training:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
@@ -54,7 +60,7 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 failing check prints its fix in flag-speak; the first
                 thing to run on a broken pod
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP013 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP014 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
@@ -749,12 +755,22 @@ def cmd_serve_bench(args):
                   f"{args.out}: {e}", file=sys.stderr)
     ingest_rows = args.ingest_rows
     ingest_blocks = tuple(int(x) for x in args.ingest_blocks.split(","))
+    drill_blocks, drill_rows = args.drill_blocks, args.drill_rows
     if args.quick:
         # the CI smoke shape: tiny block counts, same lanes, same pins —
         # the speedup claim stays regression-gated without bench-scale spend
         ingest_rows = min(ingest_rows, 512)
         ingest_blocks = tuple(b for b in ingest_blocks
                               if b <= ingest_rows) or (1, 64)
+        drill_blocks = min(drill_blocks, 16)
+        drill_rows = min(drill_rows, 32)
+    drill_kill_at = (args.drill_kill_at if args.drill_kill_at is not None
+                     else max(1, drill_blocks // 3))
+    if args.gateway_drill and not 0 < drill_kill_at <= drill_blocks:
+        raise SystemExit(
+            f"error: --drill-kill-at {drill_kill_at} is outside the frame "
+            f"stream [1, {drill_blocks}] — the kill would never fire; "
+            "raise --drill-blocks or lower --drill-kill-at")
     record = serve_bench(
         bundle,
         n_requests=args.requests,
@@ -773,6 +789,10 @@ def cmd_serve_bench(args):
         ingest=args.ingest,
         ingest_rows=ingest_rows,
         ingest_block_sizes=ingest_blocks,
+        gateway_drill=args.gateway_drill,
+        drill_blocks=drill_blocks,
+        drill_block_rows=drill_rows,
+        drill_kill_at=drill_kill_at,
         previous=previous,
     )
     if args.ingest:
@@ -790,14 +810,31 @@ def cmd_serve_bench(args):
     print(json.dumps(record))
 
 
+def _gateway_shutdown(gw, ready_file, stop) -> None:
+    """The supervisor contract (SIGTERM/SIGINT → here): remove the ready
+    file FIRST (stop routing new producers at us), run the graceful drain
+    (in-flight frames finish, their replies flush — zero rows lost), then
+    let the main loop exit. Idempotent: a second signal while draining is
+    absorbed."""
+    import pathlib
+
+    if ready_file:
+        pathlib.Path(ready_file).unlink(missing_ok=True)
+    gw.close()
+    stop.set()
+
+
 def cmd_serve_gateway(args):
-    """Serve a bundle over the ``orp-ingest-v1`` TCP front: columnar frames
-    in, columnar replies out (``orp_tpu/serve/gateway.py``). Runs until
-    interrupted (or ``--max-seconds``); ``--ready-file`` drops
+    """Serve a bundle over the ``orp-ingest`` TCP front (v2 sequenced
+    frames with reconnect-replay dedup; v1 frames still answered):
+    columnar frames in, columnar replies out (``orp_tpu/serve/gateway.py``).
+    Runs until SIGTERM/SIGINT (both run the graceful zero-loss drain and
+    remove ``--ready-file``) or ``--max-seconds``; ``--ready-file`` drops
     ``host port`` once the socket is listening, for supervisors and
     loopback harnesses that need the bound port (``--port 0`` picks a free
     one)."""
     import pathlib
+    import signal
     import threading
 
     from orp_tpu.guard.serve import GuardPolicy
@@ -810,23 +847,38 @@ def cmd_serve_gateway(args):
     host = ServeHost(max_live_engines=args.max_live_engines)
     host.add_tenant(args.tenant, args.bundle, policy=policy,
                     max_pending=args.max_pending)
+    stop = threading.Event()
     try:
         with ServeGateway(host, addr=args.addr, port=args.port,
-                          default_tenant=args.tenant) as gw:
+                          default_tenant=args.tenant,
+                          frame_deadline_s=args.frame_deadline_s,
+                          max_inflight_replies=args.max_inflight) as gw:
+            if threading.current_thread() is threading.main_thread():
+                # supervisors send SIGTERM and expect a clean zero-loss
+                # shutdown, not an abort mid-frame; SIGINT (ctrl-C) takes
+                # the same path so by-hand runs drain identically
+                handler = (lambda signum, frame:
+                           _gateway_shutdown(gw, args.ready_file, stop))
+                signal.signal(signal.SIGTERM, handler)
+                signal.signal(signal.SIGINT, handler)
             addr, port = gw.address
             line = {"addr": addr, "port": port, "tenant": args.tenant,
                     "bundle": args.bundle}
             print(json.dumps(line) if args.json
                   else f"serving {args.bundle} as tenant {args.tenant!r} "
-                       f"on {addr}:{port} (orp-ingest-v1; ctrl-C to drain)",
+                       f"on {addr}:{port} (orp-ingest v1/v2; SIGTERM or "
+                       "ctrl-C to drain)",
                   flush=True)
             if args.ready_file:
                 pathlib.Path(args.ready_file).write_text(f"{addr} {port}\n")
             try:
-                # parked, not polling: the event only fires at --max-seconds
-                threading.Event().wait(args.max_seconds)
+                # parked, not polling: wakes at --max-seconds or the signal
+                stop.wait(args.max_seconds)
             except KeyboardInterrupt:
-                pass
+                _gateway_shutdown(gw, args.ready_file, stop)
+            if not stop.is_set() and args.ready_file:
+                # --max-seconds elapsed without a signal: same clean exit
+                pathlib.Path(args.ready_file).unlink(missing_ok=True)
     finally:
         host.close()
 
@@ -885,7 +937,8 @@ def cmd_doctor(args):
 
     rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
                         telemetry_dir=args.telemetry_dir,
-                        gateway=args.gateway)
+                        gateway=args.gateway,
+                        gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
     else:
@@ -1261,10 +1314,26 @@ def build_parser():
                           "block size)")
     psb.add_argument("--ingest-blocks", default="1,64,1024",
                      help="comma-separated block sizes for the ingest sweep")
+    psb.add_argument("--gateway-drill", action="store_true",
+                     help="append the gateway-kill chaos drill: a "
+                          "ResilientGatewayClient streams sequenced frames, "
+                          "the gateway is killed right after admitting "
+                          "frame --drill-kill-at and restarted on the same "
+                          "port; records frame-level MTTR, rows_lost "
+                          "(contract 0), duplicate_serves (contract 0) and "
+                          "a bits-equal pin vs an uninterrupted run — the "
+                          "phase FAILS when any contract is violated")
+    psb.add_argument("--drill-blocks", type=int, default=64,
+                     help="frames the drill client streams")
+    psb.add_argument("--drill-rows", type=int, default=256,
+                     help="rows per drill frame")
+    psb.add_argument("--drill-kill-at", type=int, default=None, metavar="K",
+                     help="admitted-frame count at which the gateway dies "
+                          "(default: a third of --drill-blocks)")
     psb.add_argument("--quick", action="store_true",
-                     help="CI smoke shape: shrink the ingest sweep to tiny "
-                          "row/block counts (same lanes, same bitwise and "
-                          "speedup gates)")
+                     help="CI smoke shape: shrink the ingest sweep and the "
+                          "gateway drill to tiny row/block counts (same "
+                          "lanes, same bitwise and speedup gates)")
     psb.add_argument("--prewarm", action="store_true",
                      help="assert the warmup contract: fail loudly if any "
                           "measured request paid a first-touch bucket "
@@ -1304,9 +1373,20 @@ def build_parser():
                      help="tenant quota in rows: past it a block's tail "
                           "rows come back status shed-quota")
     pgw.add_argument("--max-live-engines", type=int, default=4)
+    pgw.add_argument("--frame-deadline-s", type=float, default=30.0,
+                     help="partial-frame read deadline: a client holding "
+                          "half a frame past it gets an ERROR frame and a "
+                          "reset, freeing the handler (a sequenced client "
+                          "replays the frame on reconnect)")
+    pgw.add_argument("--max-inflight", type=int, default=8,
+                     help="per-connection unanswered-frame bound: past it "
+                          "sequenced frames are refused with a BUSY frame "
+                          "(backpressure — the producer resends; no rows "
+                          "shed)")
     pgw.add_argument("--max-seconds", type=float, default=None,
                      help="serve for this long then drain and exit "
-                          "(default: until ctrl-C)")
+                          "(default: until SIGTERM/ctrl-C — both run the "
+                          "graceful zero-loss drain)")
     pgw.add_argument("--ready-file", default=None, metavar="PATH",
                      help="write 'host port' to PATH once listening (how a "
                           "supervisor or loopback harness learns a "
@@ -1336,7 +1416,11 @@ def build_parser():
                            "runs stream events.jsonl there live)")
     pdoc.add_argument("--gateway", default=None, metavar="HOST:PORT",
                       help="probe a running ingest gateway: TCP connect + "
-                           "orp-ingest-v1 PING/PONG round trip")
+                           "orp-ingest PING/PONG round trip")
+    pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
+                      help="bound on the gateway probe's connect and every "
+                           "recv — a dead-but-accepting endpoint fails "
+                           "within it instead of blocking")
     pdoc.add_argument("--json", action="store_true",
                       help="machine-readable report")
     pdoc.set_defaults(fn=cmd_doctor)
@@ -1346,7 +1430,7 @@ def build_parser():
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
              "drift, key reuse, silent excepts, blocking dispatch loops, "
              "single-device assumptions, per-row ingest work — rules "
-             "ORP001-ORP013); non-zero "
+             "ORP001-ORP014); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

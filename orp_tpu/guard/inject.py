@@ -37,17 +37,33 @@ Fault kinds (mirroring the guard features they prove):
   bundle/AOT artifact tamper detection and fallback);
 - ``corrupt_policy``  — perturb one param leaf of an already-LOADED policy
   (bundle corruption mid-reload that slipped past the on-disk digests —
-  proves the hot-reload canary gate + rollback, ``serve/host.py``).
+  proves the hot-reload canary gate + rollback, ``serve/host.py``);
+- ``torn_send(site)`` — write HALF a wire frame, then kill the socket
+  (proves the gateway discards partials and the resilient client's
+  reconnect-replay re-delivers the block, ``serve/client.py``);
+- ``stall_send(site)`` — write half a frame and go SILENT with the socket
+  open for a fixed duration (the stalled reader: proves the gateway's
+  ``frame_deadline_s`` evicts the connection while others keep serving);
+- ``gateway_kill(n)`` — abort the ENTIRE gateway right after its ``n``-th
+  admitted frame (``kill_gateway_at_frame``): the frame is submitted, its
+  reply will never flush, sessions die with the object — exactly a
+  SIGKILL mid-stream. Proves the kill-at-frame-k chaos pin: the client
+  replays against whatever next binds the port, zero rows lost.
 
 A hung execute is ``delay`` at the ``serve/execute`` site (the block point,
 ``serve/engine.py::PendingEval.result``) past a ``GuardPolicy.hard_wall_ms``
-— the watchdog's prey.
+— the watchdog's prey. A connection-reset-after-submit-before-reply is
+``fail`` at the ``gateway/reply`` site: the gateway closes the connection
+instead of sending the reply it just cached, so the client's replay must be
+answered from the reply cache — the exactly-once-serve proof.
 
 Hook sites in production code (grep for ``inject.active()``):
 ``train/fit_target`` and the kill switch in ``train/backward.py``,
 ``serve/dispatch`` and ``serve/aot_dispatch`` in ``serve/engine.py``,
 ``serve/execute`` in ``PendingEval.result``, ``serve/bundle_reload`` in
-``serve/host.py::ServeHost.reload_tenant``.
+``serve/host.py::ServeHost.reload_tenant``, ``gateway/reply`` and the
+``gateway_kill`` frame counter in ``serve/gateway.py``, ``client/send`` in
+``serve/client.py``.
 """
 
 from __future__ import annotations
@@ -98,6 +114,14 @@ class FaultPlan:
     # first n corrupt_policy() calls perturb the loaded params (bundle
     # corruption mid-reload that slipped past the on-disk digests)
     corrupt_reload: int = 0
+    # wire faults: site -> first n sends write half the frame then kill the
+    # socket (torn) / hold it open silently for `secs` (stalled reader)
+    torn_send: dict[str, int] = dataclasses.field(default_factory=dict)
+    stall_send: dict[str, tuple[int, float]] = dataclasses.field(
+        default_factory=dict)  # site -> (n_calls, seconds held open)
+    # abort the whole gateway right after its n-th admitted frame (None =
+    # never) — synthetic SIGKILL mid-stream, sessions lost with the object
+    kill_gateway_at_frame: int | None = None
 
 
 class FaultInjector:
@@ -186,6 +210,43 @@ class FaultInjector:
             with self._lock:
                 self.log.append((site, f"fail {attrs}"))
             raise InjectedFault(f"injected fault at {site} {attrs}")
+
+    # -- wire / gateway faults -----------------------------------------------
+
+    def torn_send(self, site: str) -> bool:
+        """True when this send should tear: write half the frame, kill the
+        socket (the caller's contract — ``serve/client.py::_send_raw``)."""
+        budget = self.plan.torn_send.get(site, 0)
+        if not budget or self._take(f"torn:{site}", budget) is None:
+            return False
+        with self._lock:
+            self.log.append((site, "torn"))
+        return True
+
+    def stall_send(self, site: str) -> float | None:
+        """Seconds to hold a half-written frame open and silent (the
+        stalled-reader fault), or None when this send is clean."""
+        n, secs = self.plan.stall_send.get(site, (0, 0.0))
+        if not n or self._take(f"stall:{site}", n) is None:
+            return None
+        with self._lock:
+            self.log.append((site, f"stall {secs * 1e3:.0f}ms"))
+        return secs
+
+    def gateway_kill(self, frame_no: int) -> bool:
+        """True exactly when ``frame_no`` (the gateway's admitted-frame
+        counter) matches the planned kill point — the caller aborts the
+        whole gateway, simulating process death mid-stream."""
+        k = self.plan.kill_gateway_at_frame
+        if k is None or frame_no != k:
+            return False
+        # one-shot: the RESTARTED gateway's own frame counter passes k too,
+        # and killing the replacement would turn a drill into an outage
+        if self._take("gateway_kill", 1) is None:
+            return False
+        with self._lock:
+            self.log.append(("gateway/kill", f"frame={frame_no}"))
+        return True
 
     # -- artifacts -----------------------------------------------------------
 

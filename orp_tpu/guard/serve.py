@@ -22,6 +22,14 @@ AOT bucket executable fails     circuit breaker: after ``threshold``
 repeatedly at steady state      consecutive failures the bucket is demoted
                                 to the always-correct jit path for the
                                 process lifetime (``guard/circuit_open``)
+gateway connection lost         the SAME retry machinery applied to the
+mid-stream (ingest plane)       connection itself: ``ResilientGatewayClient``
+                                reconnects on the ``backoff_s`` schedule,
+                                RESUMEs its session and replays unacked
+                                frames; the gateway's dedup window makes
+                                the replay exactly-once-serve
+                                (``guard/retry{site="client/connect"}``,
+                                ``serve/client.py``)
 ==============================  =============================================
 
 Everything here is OPT-IN: a batcher constructed without a

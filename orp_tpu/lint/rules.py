@@ -68,6 +68,15 @@ ORP013  per-row Python work in ingest-path code: the columnar ingest plane
         exactly the cost the plane amortizes away. Vectorize (mask/slice/
         ``frombuffer``) or carry a noqa saying why this loop is not
         per-row (e.g. the bench lane that MEASURES the per-request path).
+ORP014  unbounded socket I/O in serve-plane code: a ``recv``/``accept``/
+        ``sendall``/``connect`` on a socket with no ``settimeout`` (or
+        ``create_connection(timeout=)``) reaching it parks a handler
+        thread forever the moment a peer goes silent — the gateway's
+        stalled-reader eviction exists because exactly this hole let one
+        half-written frame pin a handler. Likewise an unbounded ``while
+        True`` loop with no deadline/timeout check inside ``*read*``/
+        ``*recv*`` functions (the ``_read_exact``-polls-forever bug class).
+        Sites whose socket is configured by the caller say so with a noqa.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -875,6 +884,90 @@ def check_ingest_row_loop(ctx: FileContext) -> Iterator[Finding]:
                         f"{fdef.name!r} — growing a per-row Python list; "
                         "move the rows in columns (slice/mask/frombuffer)",
                     )
+
+
+# -- ORP014 ------------------------------------------------------------------
+
+# blocking socket primitives: any of these on an un-timed socket parks the
+# calling thread until the peer feels like answering
+_ORP014_SOCK_OPS = {"recv", "recv_into", "accept", "sendall", "connect"}
+_ORP014_TIMEOUT_RE = re.compile(r"deadline|timeout|clock|wall", re.IGNORECASE)
+_ORP014_READ_FN_RE = re.compile(r"read|recv", re.IGNORECASE)
+
+
+def _orp014_configures_timeout(fdef: ast.AST) -> bool:
+    """True when the function itself configures a socket timeout — a
+    ``.settimeout(...)`` call or ``create_connection`` with a timeout."""
+    for node in walk_scope(fdef):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"):
+            return True
+        d = dotted(node.func)
+        tail = (d.split(".")[-1] if d is not None
+                else getattr(node.func, "attr", None))
+        if tail == "create_connection" and (
+                len(node.args) >= 2
+                or any(kw.arg == "timeout" for kw in node.keywords)):
+            return True
+    return False
+
+
+def _orp014_deadline_checked(loop: ast.AST) -> bool:
+    """True when the loop body shows deadline evidence: a name/attribute/
+    keyword matching deadline|timeout|clock|wall, or a monotonic-clock
+    read — the check that bounds how long a stalled peer is humoured."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name) and _ORP014_TIMEOUT_RE.search(node.id):
+            return True
+        if (isinstance(node, ast.Attribute)
+                and _ORP014_TIMEOUT_RE.search(node.attr)):
+            return True
+        if (isinstance(node, ast.keyword) and node.arg
+                and _ORP014_TIMEOUT_RE.search(node.arg)):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.split(".")[-1] in ("perf_counter",
+                                                      "monotonic"):
+                return True
+    return False
+
+
+@rule("ORP014", "unbounded socket I/O in serve-plane code")
+def check_unbounded_socket_io(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_timeout = _orp014_configures_timeout(fdef)
+        is_read_fn = _ORP014_READ_FN_RE.search(fdef.name) is not None
+        for node in walk_scope(fdef):
+            if (not has_timeout and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ORP014_SOCK_OPS):
+                yield ctx.finding(
+                    node, "ORP014",
+                    f".{node.func.attr}() in {fdef.name!r} with no "
+                    "settimeout/create_connection(timeout=) reaching the "
+                    "socket — a silent peer parks this thread forever; "
+                    "configure a timeout (or noqa naming where it is "
+                    "configured)",
+                )
+            elif (is_read_fn and isinstance(node, ast.While)
+                    and isinstance(node.test, ast.Constant)
+                    and bool(node.test.value)
+                    and not _orp014_deadline_checked(node)):
+                yield ctx.finding(
+                    node, "ORP014",
+                    f"unbounded `while True` loop in read-path "
+                    f"{fdef.name!r} with no deadline/timeout check — a "
+                    "stalled peer holds this handler forever; bound the "
+                    "loop with a deadline",
+                )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

@@ -26,12 +26,22 @@ that into production artifacts and serves them:
   under one lock pass with ONE future; answers are ``BlockResult``
   columns plus a per-row status column (served / shed-deadline /
   shed-watermark / shed-quota) — guard semantics exact but vectorized;
-- ``wire``    — ``orp-ingest-v1``: versioned fixed-width little-endian
+- ``wire``    — ``orp-ingest-v2``: versioned fixed-width little-endian
   frames, ``np.frombuffer``/``tobytes`` only, malformed frames refused
-  with structured error frames in flag-speak;
+  with structured error frames in flag-speak; v2 adds per-session frame
+  sequencing, the HELLO/RESUME handshake and the BUSY/REDIRECT delivery
+  frames (v1 frames still accepted, without guarantees);
 - ``gateway`` — the length-prefixed TCP ingest front (``orp
   serve-gateway``): decode → ``submit_block`` → encode is the whole
-  per-frame Python bill, amortized over the block's rows;
+  per-frame Python bill, amortized over the block's rows; sessions
+  deduplicate replayed frames (bounded reply cache), a partial-frame read
+  deadline evicts stalled clients, per-connection in-flight bounds answer
+  BUSY backpressure, and ``close(successor=...)`` drains-and-redirects a
+  live producer with zero lost rows;
+- ``client``  — ``ResilientGatewayClient``: the delivery-guaranteed
+  producer — bounded replay buffer of unacknowledged sequenced frames,
+  reconnect with guard-policy backoff, RESUME + replay (at-least-once-
+  submit, exactly-once-serve), BUSY retransmit and REDIRECT following;
 - ``health``  — the stuck-dispatch watchdog (``GuardPolicy.hard_wall_ms``:
   hung batches force-fail, feed the engine's circuit breaker, retry on a
   path that can answer) and the ``orp doctor`` environment/bundle probe;
@@ -44,25 +54,30 @@ that into production artifacts and serves them:
 from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.bench import serve_bench, write_bench_record
 from orp_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
+from orp_tpu.serve.client import ResilientGatewayClient
 from orp_tpu.serve.engine import HedgeEngine, PendingEval
-from orp_tpu.serve.gateway import GatewayClient, GatewayError, ServeGateway
+from orp_tpu.serve.gateway import (FrameStall, GatewayClient, GatewayError,
+                                   ServeGateway)
 from orp_tpu.serve.health import DispatchWatchdog, doctor_report
 from orp_tpu.serve.host import (CanaryRejected, ServeHost, SloPolicy,
                                 burn_rate)
 from orp_tpu.serve.ingest import (SERVED, SHED_DEADLINE, SHED_QUOTA,
-                                  SHED_WATERMARK, STATUS_NAMES, BlockResult)
+                                  SHED_WATERMARK, STATUS_NAMES, BlockResult,
+                                  concat_results)
 from orp_tpu.serve.metrics import ServingMetrics
 
 __all__ = [
     "BlockResult",
     "CanaryRejected",
     "DispatchWatchdog",
+    "FrameStall",
     "GatewayClient",
     "GatewayError",
     "HedgeEngine",
     "MicroBatcher",
     "PendingEval",
     "PolicyBundle",
+    "ResilientGatewayClient",
     "SERVED",
     "SHED_DEADLINE",
     "SHED_QUOTA",
@@ -73,6 +88,7 @@ __all__ = [
     "ServingMetrics",
     "SloPolicy",
     "burn_rate",
+    "concat_results",
     "doctor_report",
     "export_bundle",
     "load_bundle",

@@ -28,6 +28,7 @@ artifact by the ``bench.py`` hook.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import pathlib
 import threading
@@ -341,6 +342,137 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
     }
 
 
+def _gateway_drill(policy, *, blocks: int, block_rows: int,
+                   kill_at_frame: int, seed: int,
+                   window: int = 8) -> dict:
+    """The gateway-kill chaos drill (CLI ``serve-bench --gateway-drill``):
+    a :class:`~orp_tpu.serve.client.ResilientGatewayClient` streams
+    ``blocks`` sequenced frames; right after the gateway ADMITS frame
+    ``kill_at_frame`` it is aborted (synthetic SIGKILL — sessions lost, no
+    replies flush) and a fresh gateway is brought up on the SAME port. The
+    client reconnects with backoff, RESUMEs, replays every unacknowledged
+    frame, and the record answers the delivery questions:
+
+    - ``rows_lost``          — rows sent minus rows served (contract: 0);
+    - ``duplicate_serves``   — replies delivered twice to the client
+      (contract: 0 — at-least-once-submit, exactly-once-SERVE);
+    - ``mttr_ms``            — frame-level MTTR: kill instant to the first
+      reply after recovery;
+    - ``replayed_bits_equal`` — the kill-run's concatenated served columns
+      are BITWISE an uninterrupted baseline run's (replay changes
+      delivery, never answers).
+    """
+    from orp_tpu import guard
+    from orp_tpu.serve.client import ResilientGatewayClient
+    from orp_tpu.serve.gateway import ServeGateway
+    from orp_tpu.serve.host import ServeHost
+    from orp_tpu.serve.ingest import concat_results
+
+    if not 0 < int(kill_at_frame) <= int(blocks):
+        raise ValueError(
+            f"kill_at_frame={kill_at_frame} is outside the frame stream "
+            f"[1, {blocks}] — the kill would never fire; raise "
+            "--drill-blocks or lower --drill-kill-at")
+    nf = policy.model.n_features  # the host builds the real engine
+    rng = np.random.default_rng(seed)
+    feats = [(1.0 + 0.1 * rng.standard_normal((block_rows, nf)))
+             .astype(np.float32) for _ in range(blocks)]
+
+    def run(kill: bool) -> tuple:
+        with ServeHost(max_live_engines=1) as host:
+            host.add_tenant("drill", policy)
+            gw_a = ServeGateway(host, port=0, frame_deadline_s=5.0)
+            addr, port = gw_a.address
+            gw_b_box: list = [None]
+            t_kill: list = [None]
+            t_up: list = [None]
+
+            def restart():
+                # the supervisor: notice the death, rebind the same port
+                # (retrying while the dead gateway's acceptor releases it —
+                # exactly a process supervisor's restart loop)
+                gw_a.aborted.wait(timeout=60)
+                if not gw_a.aborted.is_set():
+                    return
+                t_kill[0] = time.perf_counter()
+                for _ in range(500):
+                    try:
+                        gw_b_box[0] = ServeGateway(host, addr=addr,
+                                                   port=port,
+                                                   frame_deadline_s=5.0)
+                        t_up[0] = time.perf_counter()
+                        return
+                    except OSError:  # orp: noqa[ORP009] -- the retry IS the response: the port is mid-release
+                        time.sleep(0.01)
+
+            sup = threading.Thread(target=restart, daemon=True)
+            if kill:
+                sup.start()
+            plan = guard.FaultPlan(kill_gateway_at_frame=kill_at_frame)
+            try:
+                with ResilientGatewayClient(addr, port,
+                                            window=window) as client:
+                    ctx = (guard.faults(plan) if kill
+                           else contextlib.nullcontext())
+                    resolved_at = [None] * blocks
+
+                    def stamp(i):
+                        return lambda f: resolved_at.__setitem__(
+                            i, time.perf_counter())
+
+                    with ctx:
+                        futures = []
+                        for i, f in enumerate(feats):
+                            fut = client.submit_block_async("drill", 0, f)
+                            fut.add_done_callback(stamp(i))
+                            futures.append(fut)
+                        results = [f.result(timeout=120) for f in futures]
+                    stats = dict(client.stats)
+            finally:
+                gw_a.close(timeout=5.0)
+                if kill:
+                    sup.join(timeout=60)
+                gw_b = gw_b_box[0]
+                totals = gw_a.totals()
+                if gw_b is not None:
+                    tb = gw_b.totals()
+                    totals = {k: totals.get(k, 0) + tb.get(k, 0)
+                              for k in set(totals) | set(tb)}
+                    gw_b.close(timeout=5.0)
+        mttr_ms = None
+        if kill and t_kill[0] is not None and t_up[0] is not None:
+            # frame-level MTTR: kill instant -> first reply the RESTARTED
+            # gateway delivered (resolutions before t_up are A's replies
+            # that were already buffered on the wire at the kill)
+            after = [t for t in resolved_at
+                     if t is not None and t >= t_up[0]]
+            if after:
+                mttr_ms = round((min(after) - t_kill[0]) * 1e3, 1)
+        return concat_results(results), stats, totals, mttr_ms
+
+    base, _, _, _ = run(kill=False)
+    served, stats, totals, mttr_ms = run(kill=True)
+    total_rows = blocks * block_rows
+    bits_equal = bool(np.array_equal(served.phi, base.phi)
+                      and np.array_equal(served.psi, base.psi)
+                      and np.array_equal(served.status, base.status))
+    return {
+        "blocks": int(blocks),
+        "block_rows": int(block_rows),
+        "kill_at_frame": int(kill_at_frame),
+        "rows_sent": total_rows,
+        "rows_served": served.n_served,
+        "rows_lost": total_rows - served.n_served,
+        "duplicate_serves": stats["duplicate_replies"],
+        "reconnects": stats["reconnects"],
+        "replayed_frames": stats["replayed_frames"],
+        "frames_submitted_total": totals["submitted_frames"],
+        "replayed_from_cache": totals.get("replayed_from_cache", 0),
+        "mttr_ms": mttr_ms,
+        "replayed_bits_equal": bits_equal,
+    }
+
+
 def _degrade_drill(policy, *, degrade_at: int, n_requests: int,
                    survivors: int | None, mesh, seed: int) -> dict:
     """Degradation drill (CLI ``--degrade-at``): stream single-row requests
@@ -435,6 +567,10 @@ def serve_bench(
     ingest: bool = False,
     ingest_rows: int = 4096,
     ingest_block_sizes: tuple[int, ...] = (1, 64, 1024),
+    gateway_drill: bool = False,
+    drill_blocks: int = 64,
+    drill_block_rows: int = 256,
+    drill_kill_at: int = 20,
     previous: dict | None = None,
 ) -> dict:
     """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
@@ -456,6 +592,14 @@ def serve_bench(
     contract is zero — trapped requests replay), and a post-recovery
     bits-equal pin against the healthy single-device engine; ``mttr_ms``
     becomes a first-class record field.
+    ``gateway_drill=True`` (CLI ``--gateway-drill``) appends the
+    gateway-kill chaos drill (:func:`_gateway_drill`): a
+    ``ResilientGatewayClient`` streams ``drill_blocks`` sequenced frames,
+    the gateway is aborted right after admitting frame ``drill_kill_at``
+    and restarted on the same port; the record carries the frame-level
+    MTTR, ``rows_lost`` (contract: 0), ``duplicate_serves`` (contract: 0)
+    and a bits-equal pin against an uninterrupted baseline run — and the
+    phase RAISES when any contract is violated, so the record cannot lie.
     ``ingest=True`` (CLI ``--ingest``) appends the columnar-ingest sweep
     (:func:`_ingest_phase`): per-request vs ``submit_block`` vs gateway
     loopback over the same rows at each block size, with every lane's bits
@@ -559,6 +703,19 @@ def serve_bench(
         record["degrade"] = drill
         # the headline resilience number, first-class like p99
         record["mttr_ms"] = drill["mttr_ms"]
+    if gateway_drill:
+        drill = _gateway_drill(policy, blocks=drill_blocks,
+                               block_rows=drill_block_rows,
+                               kill_at_frame=drill_kill_at, seed=seed)
+        record["gateway_drill"] = drill
+        if (drill["rows_lost"] or drill["duplicate_serves"]
+                or not drill["replayed_bits_equal"]):
+            raise RuntimeError(
+                "gateway drill contract violated: "
+                f"rows_lost={drill['rows_lost']} "
+                f"duplicate_serves={drill['duplicate_serves']} "
+                f"replayed_bits_equal={drill['replayed_bits_equal']} — the "
+                "delivery guarantee regressed; do not commit this record")
     if ingest:
         ing = _ingest_phase(policy, rows=ingest_rows,
                             block_sizes=ingest_block_sizes, seed=seed,

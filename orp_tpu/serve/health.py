@@ -165,7 +165,8 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
-                  telemetry_dir=None, gateway=None) -> dict:
+                  telemetry_dir=None, gateway=None,
+                  gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
     row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
@@ -180,8 +181,12 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     ``telemetry_dir`` — optionally probe the obs sink target for
     ``--telemetry DIR`` runs.
     ``gateway``     — optionally probe a running ingest gateway
-    (``"host:port"``): one TCP connect + ``orp-ingest-v1`` PING/PONG round
+    (``"host:port"``): one TCP connect + ``orp-ingest`` PING/PONG round
     trip, the liveness check for a ``orp serve-gateway`` front.
+    ``gateway_timeout_s`` bounds the probe's connect AND every recv — a
+    dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
+    becomes a failing check row within this budget, never an indefinite
+    block.
     """
     checks: list[dict] = []
     # 1) devices + topology fingerprint: everything downstream keys on this
@@ -263,17 +268,23 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
         addr, _, port = str(gateway).rpartition(":")
         try:
             with GatewayClient(addr or "127.0.0.1", int(port),
-                               timeout_s=5.0) as client:
+                               timeout_s=float(gateway_timeout_s)) as client:
                 ok = client.ping()
             _check(checks, "gateway", ok,
                    f"{gateway}: PING/PONG {'ok' if ok else 'FAILED'}",
-                   fix="the endpoint answered but not in orp-ingest-v1 — "
+                   fix="the endpoint answered but not in orp-ingest — "
                        "is something else listening on that port?")
         # RuntimeError covers GatewayError (connection dropped mid-reply:
-        # wrong service, or a gateway mid-drain) — the probe's whole job is
-        # to turn ANY of these into a failing check row, never a traceback
+        # wrong service, or a gateway mid-drain); socket.timeout (an
+        # OSError) covers the dead-but-accepting endpoint, surfaced within
+        # gateway_timeout_s — the probe's whole job is to turn ANY of these
+        # into a failing check row, never a traceback or an open-ended wait
         except (OSError, ValueError, RuntimeError) as e:
-            _check(checks, "gateway", False, f"{gateway}: {e}",
+            _check(checks, "gateway", False,
+                   f"{gateway}: {type(e).__name__}: {e}"
+                   if not str(e) else f"{gateway}: {e}",
                    fix="start the front with `orp serve-gateway --bundle "
-                       "DIR --port N` (or fix the host:port)")
+                       "DIR --port N` (or fix the host:port); a connect "
+                       "that hangs past the timeout is a dead-but-accepting "
+                       "endpoint — restart it")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}

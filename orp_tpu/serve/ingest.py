@@ -107,6 +107,23 @@ def all_shed_result(n: int, code: int, *, has_value: bool,
     )
 
 
+def concat_results(results) -> BlockResult:
+    """Stack a sequence of :class:`BlockResult`\\ s into one (the drill /
+    bench shape: many blocks, one ledger to compare bitwise). ``value`` is
+    kept only when every block carries it."""
+    results = list(results)
+    if not results:
+        raise ValueError("concat_results needs at least one BlockResult")
+    has_value = all(r.value is not None for r in results)
+    return BlockResult(
+        phi=np.concatenate([r.phi for r in results]),
+        psi=np.concatenate([r.psi for r in results]),
+        value=(np.concatenate([r.value for r in results])
+               if has_value else None),
+        status=np.concatenate([r.status for r in results]),
+    )
+
+
 def merge_tail_shed(head: BlockResult, n_tail: int, code: int) -> BlockResult:
     """Extend ``head`` (the admitted prefix of a block) with ``n_tail``
     tail rows shed as ``code`` — the quota/watermark tail-slice semantics:

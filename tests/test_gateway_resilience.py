@@ -1,0 +1,494 @@
+"""Delivery-guarantee chaos pins for the gateway plane (orp_tpu/serve/
+{wire,gateway,client}): the orp-ingest-v2 sequencing + HELLO/RESUME
+handshake turn connection loss, torn frames, stalled readers, gateway
+kills and live handoffs into recoverable events — every pin proves
+zero-row-loss, exactly-once-serve and bitwise-equal answers against the
+uninterrupted path. All faults come from ``guard/inject.py`` plans or
+raw-socket drivers; no sleep exceeds 50ms."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from orp_tpu import guard
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.serve import (
+    GatewayClient,
+    GatewayError,
+    HedgeEngine,
+    ResilientGatewayClient,
+    ServeGateway,
+    ServeHost,
+    concat_results,
+    export_bundle,
+)
+from orp_tpu.serve import wire
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+def _blocks(n, rows=8, nf=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(1.0 + 0.1 * rng.standard_normal((rows, nf)))
+            .astype(np.float32) for _ in range(n)]
+
+
+# -- reconnect-replay ---------------------------------------------------------
+
+
+def test_reset_after_submit_replays_from_cache_exactly_once(trained):
+    """THE dedup pin: the gateway drops the connection AFTER submitting a
+    frame but BEFORE its reply (`fail` at the ``gateway/reply`` site). The
+    client reconnects, RESUMEs and replays — the replay is answered from
+    the session's reply cache, NOT re-dispatched: at-least-once-submit,
+    exactly-once-serve."""
+    feats = _blocks(12, seed=1)
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0) as gw:
+            with ResilientGatewayClient(*gw.address, window=1) as rc:
+                with guard.faults(guard.FaultPlan(
+                        fail={"gateway/reply": 1})) as inj:
+                    results = [rc.submit_block("d", 0, f) for f in feats]
+                assert [s for s, _ in inj.log] == ["gateway/reply"]
+                stats = dict(rc.stats)
+            totals = gw.totals()
+    assert all(r.n_served == 8 for r in results)
+    assert stats["reconnects"] == 1
+    assert stats["duplicate_replies"] == 0
+    # exactly-once-SERVE: 12 frames sent, 12 reached the host — the
+    # replayed frame was answered from the cache, never re-dispatched
+    assert totals["submitted_frames"] == 12
+    assert totals["replayed_from_cache"] == 1
+    # and bits never changed: the replayed frame equals a direct evaluate
+    engine = HedgeEngine(trained)
+    for f, r in zip(feats, results):
+        phi, psi, _ = engine.evaluate(0, f)
+        np.testing.assert_array_equal(r.phi, phi)
+        np.testing.assert_array_equal(r.psi, psi)
+
+
+def test_torn_frame_mid_body_discarded_and_redelivered(trained):
+    """A frame torn in half by a dying connection (``torn_send``) never
+    reaches the batcher; the reconnect replays it whole — zero loss, zero
+    duplicates."""
+    feats = _blocks(10, seed=2)
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0) as gw:
+            with ResilientGatewayClient(*gw.address, window=2) as rc:
+                with guard.faults(guard.FaultPlan(
+                        torn_send={"client/send": 1})) as inj:
+                    results = [rc.submit_block("d", 0, f) for f in feats]
+                assert ("client/send", "torn") in inj.log
+                stats = dict(rc.stats)
+            totals = gw.totals()
+    assert all(r.n_served == 8 for r in results)
+    assert stats["reconnects"] == 1 and stats["duplicate_replies"] == 0
+    # the torn partial was discarded, not dispatched: exactly 10 submits
+    assert totals["submitted_frames"] == 10
+
+
+def test_gateway_kill_at_frame_k_zero_loss_bitwise(trained):
+    """THE kill-at-frame-k acceptance pin: a ResilientGatewayClient drives
+    64 blocks; the gateway is aborted right after ADMITTING frame k
+    (synthetic SIGKILL — sessions lost, replies unflushed) and a fresh
+    gateway binds the same port. After reconnect + RESUME + replay every
+    row is served exactly once and the served bits equal an uninterrupted
+    baseline run."""
+    from orp_tpu.serve.bench import _gateway_drill
+
+    rec = _gateway_drill(trained, blocks=64, block_rows=8,
+                         kill_at_frame=20, seed=3)
+    assert rec["rows_lost"] == 0
+    assert rec["duplicate_serves"] == 0
+    assert rec["replayed_bits_equal"] is True
+    assert rec["reconnects"] >= 1 and rec["replayed_frames"] >= 1
+    assert rec["mttr_ms"] is not None and rec["mttr_ms"] > 0
+    # at-least-once-submit across the two gateways: every frame reached a
+    # host at least once (the killed frame may honestly count twice)
+    assert rec["frames_submitted_total"] >= rec["blocks"]
+
+
+def test_reconnect_budget_exhausted_fails_loudly():
+    """A gateway that never comes back kills the client LOUDLY: every
+    outstanding future fails with the reconnect diagnosis, and later
+    submits refuse — ambiguous delivery is the one outcome that must not
+    happen silently."""
+    from orp_tpu.guard import GuardPolicy
+
+    # a listener that accepts the FIRST connection (handshake succeeds)
+    # then goes away entirely
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    addr, port = lst.getsockname()[:2]
+    tok = b"0123456789abcdef"
+
+    def one_shot():
+        conn, _ = lst.accept()
+        conn.settimeout(2.0)
+        # answer the HELLO so the constructor succeeds, then die
+        head = conn.recv(4)
+        (want,) = struct.unpack("<I", head)
+        body = b""
+        while len(body) < want:
+            body += conn.recv(want - len(body))
+        welcome = wire.encode_welcome(tok, 0)
+        conn.sendall(struct.pack("<I", len(welcome)) + welcome)
+        time.sleep(0.02)
+        conn.close()
+        lst.close()
+
+    t = threading.Thread(target=one_shot, daemon=True)
+    t.start()
+    client = ResilientGatewayClient(
+        addr, port, window=2,
+        retry=GuardPolicy(max_retries=2, backoff_ms=5.0, backoff_cap_ms=10.0))
+    try:
+        fut = client.submit_block_async("d", 0, _blocks(1)[0])
+        with pytest.raises(GatewayError, match="reconnect budget exhausted"):
+            fut.result(timeout=10)
+        with pytest.raises(GatewayError, match="reconnect budget exhausted"):
+            client.submit_block_async("d", 0, _blocks(1)[0])
+    finally:
+        client.close()
+    t.join(5)
+
+
+def test_client_handshake_bounded_on_dead_but_accepting_endpoint():
+    """The handshake wall: an endpoint that ACCEPTS the connect but never
+    answers the HELLO fails the constructor within ``timeout_s`` — the
+    frame deadline alone never arms (no bytes arrive), so without the wall
+    this hung forever."""
+    from orp_tpu.guard import GuardPolicy
+
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    addr, port = lst.getsockname()[:2]
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(OSError, match="dead-but-accepting"):
+            ResilientGatewayClient(
+                addr, port, timeout_s=0.2,
+                retry=GuardPolicy(max_retries=0, backoff_ms=1.0))
+        assert time.perf_counter() - t0 < 3.0
+    finally:
+        lst.close()
+
+
+def test_corrupt_reply_keeps_frame_buffered_for_replay(trained):
+    """A reply that fails wire validation must NOT consume the replay-
+    buffer entry: the decode error sends the reader into reconnect with
+    the frame still buffered, so the rows are re-delivered instead of
+    silently lost (the future left hanging)."""
+    from orp_tpu.serve.client import _Entry
+    from orp_tpu.serve.ingest import BlockResult
+
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0) as gw:
+            with ResilientGatewayClient(*gw.address) as rc:
+                ent = _Entry(99, b"frame-bytes")
+                with rc._space:
+                    rc._unacked[99] = ent
+                res = BlockResult(phi=np.ones(4, np.float32),
+                                  psi=np.zeros(4, np.float32), value=None,
+                                  status=np.zeros(4, np.uint8))
+                good = wire.encode_reply(res, seq=99)
+                with pytest.raises(wire.WireError):
+                    rc._on_frame(good[:-3])  # truncated body
+                with rc._space:
+                    assert 99 in rc._unacked  # STILL buffered: will replay
+                rc._on_frame(good)            # the replayed reply resolves
+                with rc._space:
+                    assert 99 not in rc._unacked
+                np.testing.assert_array_equal(
+                    ent.future.result(timeout=5).phi, res.phi)
+
+
+# -- stalled reader / frame deadline ------------------------------------------
+
+
+def test_stalled_half_frame_evicted_while_healthy_conn_serves(trained):
+    """THE stalled-reader acceptance pin: a client holding half a frame is
+    answered with an ERROR frame and reset within ``frame_deadline_s``
+    (small poll multiple), while a healthy connection's frames KEEP
+    serving throughout the stall — throughput never drops to zero."""
+    feats = _blocks(2, seed=4)
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, default_tenant="d",
+                          frame_deadline_s=0.05) as gw:
+            addr, port = gw.address
+            stalled = socket.create_connection((addr, port), timeout=10)
+            frame = wire.encode_request("d", 0, feats[0])
+            t0 = time.perf_counter()
+            stalled.sendall(struct.pack("<I", len(frame)) + frame[:20])
+            # ... and silence. Meanwhile the healthy connection serves:
+            served_during_stall = 0
+            with GatewayClient(addr, port) as healthy:
+                while time.perf_counter() - t0 < 0.12:
+                    res = healthy.submit_block("d", 0, feats[1])
+                    assert res.n_served == 8
+                    served_during_stall += 1
+            assert served_during_stall > 0  # never zero during the stall
+            # the stalled socket was evicted: ERROR frame, then EOF
+            stalled.settimeout(2.0)
+            head = stalled.recv(4)
+            (want,) = struct.unpack("<I", head)
+            body = b""
+            while len(body) < want:
+                body += stalled.recv(want - len(body))
+            evicted_at = time.perf_counter()
+            assert wire.decode_kind(body) == wire.KIND_ERROR
+            assert "frame deadline" in wire.decode_error(body)
+            assert stalled.recv(1) == b""  # the reset
+            stalled.close()
+            # within the deadline plus the poll granularity (deadline/5),
+            # with head-room for a loaded CI box
+            assert evicted_at - t0 < 0.05 * 8
+
+
+def test_injected_stalled_send_recovers_through_eviction(trained):
+    """The stall fault end to end through the resilient client: the
+    injected half-frame-then-silence send is evicted by the gateway's
+    frame deadline, the client reconnects and replays — zero loss."""
+    feats = _blocks(6, seed=5)
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, frame_deadline_s=0.02) as gw:
+            with ResilientGatewayClient(*gw.address, window=1) as rc:
+                with guard.faults(guard.FaultPlan(
+                        stall_send={"client/send": (1, 0.04)})) as inj:
+                    results = [rc.submit_block("d", 0, f) for f in feats]
+                assert any("stall" in d for _, d in inj.log)
+                stats = dict(rc.stats)
+    assert all(r.n_served == 8 for r in results)
+    assert stats["reconnects"] >= 1 and stats["duplicate_replies"] == 0
+
+
+# -- backpressure -------------------------------------------------------------
+
+
+def test_busy_backpressure_resends_no_rows_shed(trained):
+    """BUSY is backpressure, not shedding: past the per-connection
+    in-flight bound the gateway refuses frames with BUSY, the client
+    retransmits after backoff, and every row is eventually served exactly
+    once — no shed statuses anywhere."""
+    feats = _blocks(10, rows=4, seed=6)
+    # a wide coalescing window keeps replies in flight long enough for the
+    # 1-frame bound to trip deterministically
+    with ServeHost(batcher_kwargs={"max_wait_us": 30_000.0}) as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, max_inflight_replies=1) as gw:
+            with ResilientGatewayClient(*gw.address, window=4) as rc:
+                futs = [rc.submit_block_async("d", 0, f) for f in feats]
+                results = [f.result(timeout=30) for f in futs]
+                stats = dict(rc.stats)
+    assert all(r.n_served == 4 for r in results)  # nothing shed
+    assert stats["busy"] >= 1                     # the bound really tripped
+    assert stats["duplicate_replies"] == 0
+
+
+# -- drain-and-redirect -------------------------------------------------------
+
+
+def test_drain_and_redirect_zero_loss_ledgers_sum(trained):
+    """THE drain-and-redirect acceptance pin: ``close(successor=...)`` on
+    gateway A while a client streams → the client follows the REDIRECT to
+    gateway B, zero rows lost, zero duplicates, and the two gateways'
+    ledgers SUM to the total row count (every row served exactly once,
+    somewhere)."""
+    n_blocks, rows = 20, 8
+    feats = _blocks(n_blocks, rows=rows, seed=7)
+    engine = HedgeEngine(trained)
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        gw_a = ServeGateway(host, port=0)
+        gw_b = ServeGateway(host, port=0)
+        try:
+            with ResilientGatewayClient(*gw_a.address, window=4) as rc:
+                futs = []
+                closer = None
+                for i, f in enumerate(feats):
+                    futs.append(rc.submit_block_async("d", 0, f))
+                    if i == 7:
+                        # hand off mid-stream, in-flight frames included
+                        closer = threading.Thread(
+                            target=gw_a.close,
+                            kwargs={"successor": gw_b.address}, daemon=True)
+                        closer.start()
+                results = [f.result(timeout=30) for f in futs]
+                stats = dict(rc.stats)
+                closer.join(10)
+            ta, tb = gw_a.totals(), gw_b.totals()
+        finally:
+            gw_b.close()
+    assert all(r.n_served == rows for r in results)
+    assert stats["redirects"] >= 1
+    assert stats["duplicate_replies"] == 0
+    # the ledger sum: A's rows + B's rows == every row, exactly once
+    assert ta["rows"] + tb["rows"] == n_blocks * rows
+    assert ta["rows"] > 0 and tb["rows"] > 0  # both really served
+    # bits unchanged through the handoff
+    served = concat_results(results)
+    evals = [engine.evaluate(0, f) for f in feats]
+    np.testing.assert_array_equal(
+        served.phi, np.concatenate([e[0] for e in evals]))
+    np.testing.assert_array_equal(
+        served.psi, np.concatenate([e[1] for e in evals]))
+
+
+def test_v1_client_during_drain_gets_error_not_redirect(trained):
+    """Protocol compatibility: REDIRECT is a v2-only kind — an unsequenced
+    (v1) producer hitting a draining gateway must get a plain ERROR frame
+    (surfacing as GatewayError naming the successor), never a frame its
+    decoder cannot classify."""
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0) as gw:
+            with GatewayClient(*gw.address) as v1:
+                assert v1.submit_block("d", 0, _blocks(1)[0]).n_served == 8
+                # white-box: flip the gateway into drain-with-successor
+                # while the v1 connection is live (close() would also tear
+                # the listener down before a new connect could race it)
+                gw._redirect = ("127.0.0.1", 1)
+                gw._draining.set()
+                with pytest.raises(GatewayError, match="draining"):
+                    v1.submit_block("d", 0, _blocks(1)[0])
+            gw._draining.clear()
+            gw._redirect = None
+
+
+# -- doctor / CLI satellites --------------------------------------------------
+
+
+def test_doctor_gateway_dead_but_accepting_fails_within_timeout():
+    """The doctor satellite: an endpoint that ACCEPTS the TCP connect but
+    never answers the PING becomes a failing check row within the probe
+    timeout — not a 60s (or forever) block."""
+    from orp_tpu.serve.health import doctor_report
+
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    addr, port = lst.getsockname()[:2]
+    try:
+        t0 = time.perf_counter()
+        rep = doctor_report(gateway=f"{addr}:{port}",
+                            gateway_timeout_s=0.3)
+        elapsed = time.perf_counter() - t0
+        [check] = [c for c in rep["checks"] if c["check"] == "gateway"]
+        assert not check["ok"]
+        assert "serve-gateway" in check["fix"]
+        assert elapsed < 3.0  # bounded by the timeout, not a 60s default
+    finally:
+        lst.close()
+
+
+def test_cli_sigterm_drain_removes_ready_file(tmp_path, trained):
+    """The supervisor satellite: the serve-gateway shutdown path (what the
+    SIGTERM/SIGINT handler runs) removes the ready file FIRST, drains the
+    gateway gracefully (in-flight replies flush) and releases the main
+    loop — a clean zero-loss shutdown, not an abort mid-frame."""
+    from orp_tpu.cli import _gateway_shutdown
+
+    ready = tmp_path / "gw.addr"
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        gw = ServeGateway(host, port=0)
+        addr, port = gw.address
+        ready.write_text(f"{addr} {port}\n")
+        stop = threading.Event()
+        with ResilientGatewayClient(addr, port) as rc:
+            fut = rc.submit_block_async("d", 0, _blocks(1, rows=8)[0])
+            _gateway_shutdown(gw, str(ready), stop)
+            # the in-flight frame's reply flushed through the drain
+            assert fut.result(timeout=10).n_served == 8
+        assert not ready.exists()
+        assert stop.is_set()
+        # drained: new connections are refused (listener closed)
+        with pytest.raises(OSError):
+            socket.create_connection((addr, port), timeout=0.5)
+
+
+def test_cli_serve_gateway_installs_signal_handlers(tmp_path, trained):
+    """`orp serve-gateway` on the main thread installs SIGTERM/SIGINT
+    handlers that run the graceful drain (pinned by sending ourselves
+    SIGTERM and watching the command exit cleanly with the ready file
+    removed)."""
+    import os
+    import signal
+
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    ready = tmp_path / "gw.addr"
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    done = threading.Event()
+
+    def kicker():
+        deadline = time.perf_counter() + 15
+        while not ready.exists() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        assert ready.exists(), "gateway never wrote its ready file"
+        addr, port = ready.read_text().split()
+        with GatewayClient(addr, int(port)) as c:
+            assert c.ping()
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=kicker, daemon=True)
+    t.start()
+    try:
+        # runs on the MAIN thread: the handler install path is live
+        cli.main(["serve-gateway", "--bundle", str(bdir), "--port", "0",
+                  "--ready-file", str(ready), "--max-seconds", "30",
+                  "--json"])
+        done.set()
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+    t.join(10)
+    assert done.is_set()          # SIGTERM released the command cleanly
+    assert not ready.exists()     # and the handler removed the ready file
+
+
+def test_cli_serve_bench_gateway_drill_quick(tmp_path, capsys, trained):
+    """`serve-bench --gateway-drill --quick` runs the kill-at-frame-k drill
+    at smoke scale and commits the delivery record — rows_lost 0,
+    duplicate_serves 0, bits equal, MTTR measured — failing loudly if any
+    contract breaks."""
+    import json
+
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    out = tmp_path / "BENCH_serve.json"
+    cli.main([
+        "serve-bench", "--bundle", str(bdir), "--requests", "8",
+        "--batcher-requests", "8", "--sweep-concurrency", "",
+        "--gateway-drill", "--quick", "--out", str(out),
+    ])
+    rec = json.loads(capsys.readouterr().out.strip())
+    drill = rec["gateway_drill"]
+    assert drill["rows_lost"] == 0
+    assert drill["duplicate_serves"] == 0
+    assert drill["replayed_bits_equal"] is True
+    assert drill["mttr_ms"] is not None
+    assert json.loads(out.read_text())["gateway_drill"] == drill

@@ -116,6 +116,178 @@ def test_wire_refuses_malformed_frames_in_flagspeak():
         wire.encode_request("a-tenant-name-way-too-long", 0, feats)
 
 
+def test_wire_v2_sequenced_roundtrip_and_handshake_frames():
+    """The orp-ingest-v2 delivery extension: seq-stamped request/reply/
+    error frames (64-byte header) and the HELLO/WELCOME/BUSY/REDIRECT
+    handshake kinds — while seq-less encoding stays byte-identical v1."""
+    feats = _rows(5, 2, seed=4)
+    v1 = wire.encode_request("t", 1, feats)
+    assert v1[4] == 1 and wire.frame_seq(v1) == 0
+    v2 = wire.encode_request("t", 1, feats, seq=7)
+    assert v2[4] == 2 and wire.HEADER_V2_BYTES == 64
+    req = wire.decode_request(v2)
+    assert req["seq"] == 7
+    np.testing.assert_array_equal(req["states"], feats)
+    res = BlockResult(phi=feats[:, 0], psi=feats[:, 1], value=None,
+                      status=np.zeros(5, np.uint8))
+    rep = wire.encode_reply(res, date_idx=1, seq=7)
+    assert wire.frame_seq(rep) == 7
+    np.testing.assert_array_equal(wire.decode_reply(rep).phi, feats[:, 0])
+    err = wire.encode_error("frame 3 refused", seq=3)
+    assert wire.frame_seq(err) == 3
+    # handshake kinds
+    assert wire.decode_hello(wire.encode_hello()) == b""
+    tok = b"0123456789abcdef"
+    assert wire.decode_hello(wire.encode_hello(tok)) == tok
+    assert wire.decode_welcome(wire.encode_welcome(tok, 42)) == (tok, 42)
+    assert wire.decode_busy(wire.encode_busy(9, "slow")) == (9, "slow")
+    assert wire.decode_redirect(
+        wire.encode_redirect("127.0.0.1", 7000, seq=3)) == \
+        ("127.0.0.1", 7000, 3)
+    with pytest.raises(wire.WireError, match="token"):
+        wire.encode_hello(b"short")
+    # a v2-only kind stamped version 1 is refused
+    bad = bytearray(wire.encode_hello(tok))
+    bad[4] = 1
+    with pytest.raises(wire.WireError, match="orp-ingest-v2"):
+        wire.decode_kind(bytes(bad))
+
+
+def _frame_corpus():
+    """Valid v1 AND v2 frames of every kind — the fuzz seed set."""
+    feats = _rows(6, 3, seed=21)
+    prices = _rows(6, 2, seed=22)
+    res = BlockResult(phi=feats[:, 0], psi=feats[:, 1], value=feats[:, 2],
+                      status=np.zeros(6, np.uint8))
+    tok = b"abcdefgh01234567"
+    return [
+        wire.encode_request("desk", 2, feats),
+        wire.encode_request("desk", 2, feats, prices,
+                            np.full(6, 0.5), deadline_ms=100.0),
+        wire.encode_request("desk", 2, feats, seq=5),
+        wire.encode_reply(res, date_idx=2),
+        wire.encode_reply(res, date_idx=2, seq=5),
+        wire.encode_error("a refusal"),
+        wire.encode_error("a refusal", seq=5),
+        wire.encode_ping(),
+        wire.encode_pong(),
+        wire.encode_hello(),
+        wire.encode_hello(tok),
+        wire.encode_welcome(tok, 9),
+        wire.encode_busy(4, "slow"),
+        wire.encode_redirect("127.0.0.1", 7000, seq=4),
+    ]
+
+
+def _decode_any(buf):
+    """Every decoder the gateway/client reach — the fuzz target surface."""
+    kind = wire.decode_kind(buf)
+    wire.frame_seq(buf)
+    if kind == wire.KIND_REQUEST:
+        wire.decode_request(buf)
+    elif kind == wire.KIND_REPLY:
+        wire.decode_reply(buf)
+    elif kind == wire.KIND_ERROR:
+        wire.decode_error(buf)
+    elif kind == wire.KIND_HELLO:
+        wire.decode_hello(buf)
+    elif kind == wire.KIND_WELCOME:
+        wire.decode_welcome(buf)
+    elif kind == wire.KIND_BUSY:
+        wire.decode_busy(buf)
+    elif kind == wire.KIND_REDIRECT:
+        wire.decode_redirect(buf)
+
+
+def test_wire_fuzz_mutated_frames_never_crash_or_hang():
+    """The fuzz satellite, codec half: every corpus frame mutated by
+    truncation, random byte flips and length perturbation must either
+    decode cleanly (a flip can land in a value column) or raise
+    ``WireError`` — NEVER any other exception type. Property-style seeded
+    loop; zero sleeps."""
+    rng = np.random.default_rng(0xF022)
+    corpus = _frame_corpus()
+    for frame in corpus:
+        _decode_any(frame)  # the unmutated corpus is all decodable
+    for _ in range(400):
+        frame = bytearray(corpus[int(rng.integers(len(corpus)))])
+        mode = int(rng.integers(3))
+        if mode == 0:                      # truncate
+            frame = frame[:int(rng.integers(0, len(frame)))]
+        elif mode == 1:                    # flip 1-8 bytes anywhere
+            for _ in range(int(rng.integers(1, 9))):
+                frame[int(rng.integers(len(frame)))] ^= \
+                    int(rng.integers(1, 256))
+        else:                              # grow or shrink the tail
+            delta = int(rng.integers(1, 64))
+            frame = (frame + bytes(delta) if rng.integers(2)
+                     else frame[:max(0, len(frame) - delta)])
+        try:
+            _decode_any(bytes(frame))
+        except wire.WireError:
+            pass  # the refusal contract — anything else fails the test
+
+
+def test_gateway_fuzz_mutated_frames_answered_within_deadline(trained):
+    """The fuzz satellite, transport half: mutated frames (and an
+    oversized length prefix) thrown at a live gateway always yield an
+    ERROR frame or a valid reply within the read deadline — never a hang,
+    a crash, or a partial dispatch — and a well-formed client still
+    serves afterwards."""
+    import socket
+    import struct
+
+    rng = np.random.default_rng(0xF023)
+    corpus = _frame_corpus()
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, default_tenant="d",
+                          frame_deadline_s=0.5) as gw:
+            addr, port = gw.address
+            for trial in range(24):
+                frame = bytearray(corpus[int(rng.integers(len(corpus)))])
+                for _ in range(int(rng.integers(1, 6))):
+                    frame[int(rng.integers(len(frame)))] ^= \
+                        int(rng.integers(1, 256))
+                if trial % 8 == 7:
+                    payload = struct.pack("<I", 1 << 30) + bytes(frame)
+                else:
+                    payload = struct.pack("<I", len(frame)) + bytes(frame)
+                s = socket.create_connection((addr, port), timeout=5.0)
+                try:
+                    s.sendall(payload)
+                    # bounded: either a reply arrives or the gateway reset
+                    # the connection — both within the socket timeout
+                    head = b""
+                    try:
+                        while len(head) < 4:
+                            chunk = s.recv(4 - len(head))
+                            if not chunk:
+                                break
+                            head += chunk
+                    except OSError:
+                        head = b""
+                    if len(head) == 4:
+                        (want,) = struct.unpack("<I", head)
+                        body = b""
+                        while len(body) < want:
+                            chunk = s.recv(want - len(body))
+                            if not chunk:
+                                break
+                            body += chunk
+                        if len(body) == want:
+                            # whatever came back is a well-formed frame
+                            assert wire.decode_kind(body) in (
+                                wire.KIND_ERROR, wire.KIND_REPLY,
+                                wire.KIND_PONG, wire.KIND_WELCOME,
+                                wire.KIND_BUSY)
+                finally:
+                    s.close()
+            # the gateway survived the fuzz barrage: a clean client serves
+            with GatewayClient(addr, port) as client:
+                assert client.submit_block("d", 0, _rows(3)).n_served == 3
+
+
 def test_block_result_helpers():
     shed = all_shed_result(3, SHED_QUOTA, has_value=True)
     assert shed.n_served == 0 and shed.shed_counts() == {"shed-quota": 3}
@@ -256,7 +428,7 @@ def test_gateway_answers_malformed_frames_with_error_frames(trained):
                 while len(body) < want:
                     body += s.recv(want - len(body))
                 assert wire.decode_kind(body) == wire.KIND_ERROR
-                assert "orp-ingest-v1" in wire.decode_error(body)
+                assert "orp-ingest" in wire.decode_error(body)
             finally:
                 s.close()
             # the gateway survives the bad client: a good one still serves

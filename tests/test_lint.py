@@ -25,7 +25,8 @@ def codes(src, **kw):
 
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
-                          | {"ORP010", "ORP011", "ORP012", "ORP013"})
+                          | {"ORP010", "ORP011", "ORP012", "ORP013",
+                             "ORP014"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -879,6 +880,89 @@ def test_orp013_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/bench.py") == []
+
+
+# -- ORP014: unbounded socket I/O in serve-plane code --------------------------
+
+ORP014_POS = """
+    import socket
+
+    def pump(sock):
+        sock.sendall(b"hi")           # no timeout reaches this socket
+        return sock.recv(4096)        # nor this one
+
+    def serve(listener):
+        conn, peer = listener.accept()
+        return conn
+
+    def read_exact(sock, n):
+        buf = b""
+        while True:                   # unbounded read loop, no deadline
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+            if len(buf) >= n:
+                return buf
+"""
+
+ORP014_NEG = """
+    import socket
+    import time
+
+    def pump(sock):
+        sock.settimeout(0.25)         # the timeout reaches the socket
+        sock.sendall(b"hi")
+        return sock.recv(4096)
+
+    def dial(addr, port, budget):
+        s = socket.create_connection((addr, port), timeout=budget)
+        s.sendall(b"hello")
+        return s
+
+    def read_exact(sock, n, deadline_s):
+        buf = b""
+        sock.settimeout(0.05)         # the poll that makes the check RUN
+        t0 = time.perf_counter()
+        while True:                   # bounded: the deadline is checked
+            if time.perf_counter() - t0 > deadline_s:
+                raise TimeoutError("partial frame stalled")
+            chunk = sock.recv(n - len(buf))
+            buf += chunk
+            if len(buf) >= n:
+                return buf
+
+    def spin():
+        while True:                   # not a read/recv function: out of scope
+            work()
+"""
+
+
+def test_orp014_flags_untimed_sockets_and_unbounded_read_loops():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP014_POS),
+                                       path="orp_tpu/serve/gateway.py")]
+    # sendall + recv in pump, accept in serve, the while True + its recv in
+    # read_exact (the loop AND the untimed recv inside it)
+    assert got.count("ORP014") == 5
+
+
+def test_orp014_scopes_to_serve_paths():
+    assert lint_source(textwrap.dedent(ORP014_POS),
+                       path="orp_tpu/train/backward.py") == []
+
+
+def test_orp014_clean_negative():
+    assert lint_source(textwrap.dedent(ORP014_NEG),
+                       path="orp_tpu/serve/gateway.py") == []
+
+
+def test_orp014_noqa_suppresses():
+    src = """
+        def relay(sock, frame):
+            sock.sendall(frame)  # orp: noqa[ORP014] -- the socket was settimeout'd at accept
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/gateway.py") == []
 
 
 # -- suppressions ------------------------------------------------------------
