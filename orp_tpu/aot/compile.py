@@ -174,7 +174,7 @@ def aot_compile(jit_fn, *args, label: str, **static_kwargs):
     obs_count("aot/compiles", fn=label)
     for key in ("flops", "bytes_accessed"):
         if key in meta:
-            obs_set_gauge(f"aot_{key}", meta[key], fn=label)
+            obs_set_gauge(f"aot_{key}", meta[key], fn=label)  # orp: noqa[ORP015] -- the name set is the two-element literal tuple above (aot_flops / aot_bytes_accessed): bounded by construction
     return compiled, meta
 
 

@@ -49,7 +49,17 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 reconnect-replay dedup, frame deadlines, BUSY
                 backpressure, drain-and-redirect; SIGTERM/SIGINT run the
                 graceful zero-loss drain); ``orp doctor --gateway
-                host:port`` probes it
+                host:port`` probes it. The telemetry plane is always on:
+                the live registry answers the METRICS/HEALTH wire kinds
+                (and plain-HTTP Prometheus with ``--metrics-port``), and
+                trace-stamped frames (``obs.new_trace()``) leave their
+                span chain in the ``--telemetry`` bundle
+- ``top``       live serving dashboard off a running gateway: scrape the
+                METRICS/HEALTH wire kinds → req/s, p99, queue depth,
+                shed/BUSY rates, per-tenant table (``--watch`` refreshes)
+- ``trace``     reconstruct one frame's span tree (decode → queue →
+                dispatch → resolve → encode) from a telemetry bundle's
+                ``events.jsonl`` by trace id
 - ``warm``      pre-populate the persistent XLA compile cache for training:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
@@ -60,14 +70,15 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 failing check prints its fix in flag-speak; the first
                 thing to run on a broken pod
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP014 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP015 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
                 blocking calls in serve dispatch-loop code, single-device
                 assumptions in mesh-reachable code, engine rebuild/swap
                 work under a lock, per-row Python work in ingest-path
-                code); exits non-zero
+                code, unbounded socket I/O, dynamic obs instrument names /
+                hot-path instrument construction); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -81,12 +92,15 @@ uninterrupted run) and ``--nan-guard`` (per-date NaN sentinel with the
 adam->gauss_newton->final_solve degradation ladder) — the ``orp_tpu/guard``
 fault-tolerance layer.
 
-Every training command (and ``serve-bench``) accepts ``--telemetry DIR``: the
-run executes under an ``orp_tpu.obs`` session and drops a telemetry bundle —
-``events.jsonl`` (schema-versioned span/counter events), ``metrics.prom``
-(Prometheus text exposition) and ``manifest.json`` (config fingerprint,
-jax/jaxlib versions, platform, git rev) — in DIR. Without the flag the
-instrumentation is the obs no-op path and costs nothing.
+Every training command (plus ``serve-bench`` and ``serve-gateway``) accepts
+``--telemetry DIR``: the run executes under an ``orp_tpu.obs`` session and
+drops a telemetry bundle — ``events.jsonl`` (schema-versioned span/counter/
+trace events, streamed live), ``metrics.prom`` (Prometheus text exposition,
+rewritten periodically and on SIGTERM — a killed process still leaves its
+numbers), ``manifest.json`` (config fingerprint, jax/jaxlib versions,
+platform, git rev) and ``flight.jsonl`` (the flight-recorder black box) —
+in DIR. Without the flag the instrumentation is the obs no-op path and
+costs nothing.
 """
 
 from __future__ import annotations
@@ -832,55 +846,80 @@ def cmd_serve_gateway(args):
     remove ``--ready-file``) or ``--max-seconds``; ``--ready-file`` drops
     ``host port`` once the socket is listening, for supervisors and
     loopback harnesses that need the bound port (``--port 0`` picks a free
-    one)."""
+    one). The telemetry plane is always on: the process keeps a live
+    registry (scrapeable in-band via the METRICS wire kind, and over plain
+    HTTP with ``--metrics-port``) even without ``--telemetry``; with
+    ``--telemetry DIR`` the registry, span events, flight ring and
+    manifest additionally export to DIR — flushed periodically and on
+    SIGTERM, not just at clean exit."""
+    import contextlib
     import pathlib
     import signal
     import threading
 
+    from orp_tpu import obs
     from orp_tpu.guard.serve import GuardPolicy
-    from orp_tpu.serve import ServeGateway, ServeHost
+    from orp_tpu.serve import MetricsServer, ServeGateway, ServeHost
 
     policy = None
     if args.deadline_ms is not None or args.watermark is not None:
         policy = GuardPolicy(deadline_ms=args.deadline_ms,
                              queue_watermark=args.watermark)
-    host = ServeHost(max_live_engines=args.max_live_engines)
-    host.add_tenant(args.tenant, args.bundle, policy=policy,
-                    max_pending=args.max_pending)
-    stop = threading.Event()
-    try:
-        with ServeGateway(host, addr=args.addr, port=args.port,
-                          default_tenant=args.tenant,
-                          frame_deadline_s=args.frame_deadline_s,
-                          max_inflight_replies=args.max_inflight) as gw:
-            if threading.current_thread() is threading.main_thread():
-                # supervisors send SIGTERM and expect a clean zero-loss
-                # shutdown, not an abort mid-frame; SIGINT (ctrl-C) takes
-                # the same path so by-hand runs drain identically
-                handler = (lambda signum, frame:
-                           _gateway_shutdown(gw, args.ready_file, stop))
-                signal.signal(signal.SIGTERM, handler)
-                signal.signal(signal.SIGINT, handler)
-            addr, port = gw.address
-            line = {"addr": addr, "port": port, "tenant": args.tenant,
-                    "bundle": args.bundle}
-            print(json.dumps(line) if args.json
-                  else f"serving {args.bundle} as tenant {args.tenant!r} "
-                       f"on {addr}:{port} (orp-ingest v1/v2; SIGTERM or "
-                       "ctrl-C to drain)",
-                  flush=True)
-            if args.ready_file:
-                pathlib.Path(args.ready_file).write_text(f"{addr} {port}\n")
-            try:
-                # parked, not polling: wakes at --max-seconds or the signal
-                stop.wait(args.max_seconds)
-            except KeyboardInterrupt:
-                _gateway_shutdown(gw, args.ready_file, stop)
-            if not stop.is_set() and args.ready_file:
-                # --max-seconds elapsed without a signal: same clean exit
-                pathlib.Path(args.ready_file).unlink(missing_ok=True)
-    finally:
-        host.close()
+    with contextlib.ExitStack() as stack:
+        if not obs.enabled():
+            # a gateway is a long-lived serving process: its counters and
+            # latency series must accumulate SOMEWHERE scrapeable even
+            # without --telemetry (which, when passed, already opened a
+            # session before this command ran — see main())
+            stack.enter_context(obs.active())
+        host = stack.enter_context(
+            ServeHost(max_live_engines=args.max_live_engines))
+        host.add_tenant(args.tenant, args.bundle, policy=policy,
+                        max_pending=args.max_pending)
+        stop = threading.Event()
+        gw = stack.enter_context(ServeGateway(
+            host, addr=args.addr, port=args.port,
+            default_tenant=args.tenant,
+            frame_deadline_s=args.frame_deadline_s,
+            max_inflight_replies=args.max_inflight))
+        mserver = None
+        if args.metrics_port is not None:
+            mserver = stack.enter_context(MetricsServer(
+                gw.metrics_text, health_fn=gw.health_report,
+                addr=args.addr, port=args.metrics_port))
+        if threading.current_thread() is threading.main_thread():
+            # supervisors send SIGTERM and expect a clean zero-loss
+            # shutdown, not an abort mid-frame; SIGINT (ctrl-C) takes
+            # the same path so by-hand runs drain identically. The drain
+            # exits the telemetry session normally, which flushes the
+            # bundle — no separate flush hook needed here
+            handler = (lambda signum, frame:
+                       _gateway_shutdown(gw, args.ready_file, stop))
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        addr, port = gw.address
+        line = {"addr": addr, "port": port, "tenant": args.tenant,
+                "bundle": args.bundle}
+        if mserver is not None:
+            line["metrics_port"] = mserver.address[1]
+        scrape_note = ("" if mserver is None else
+                       f"; metrics http://{mserver.address[0]}:"
+                       f"{mserver.address[1]}/metrics")
+        print(json.dumps(line) if args.json
+              else f"serving {args.bundle} as tenant {args.tenant!r} "
+                   f"on {addr}:{port} (orp-ingest v1/v2; SIGTERM or "
+                   f"ctrl-C to drain{scrape_note})",
+              flush=True)
+        if args.ready_file:
+            pathlib.Path(args.ready_file).write_text(f"{addr} {port}\n")
+        try:
+            # parked, not polling: wakes at --max-seconds or the signal
+            stop.wait(args.max_seconds)
+        except KeyboardInterrupt:
+            _gateway_shutdown(gw, args.ready_file, stop)
+        if not stop.is_set() and args.ready_file:
+            # --max-seconds elapsed without a signal: same clean exit
+            pathlib.Path(args.ready_file).unlink(missing_ok=True)
 
 
 def cmd_warm(args):
@@ -937,7 +976,7 @@ def cmd_doctor(args):
 
     rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
                         telemetry_dir=args.telemetry_dir,
-                        gateway=args.gateway,
+                        gateway=args.gateway, metrics=args.metrics,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -950,6 +989,93 @@ def cmd_doctor(args):
         print("healthy" if rep["ok"] else "NOT healthy")
     if not rep["ok"]:
         raise SystemExit(1)
+
+
+def cmd_top(args):
+    """Live serving dashboard off a running gateway: scrape the METRICS
+    wire kind (plus a HEALTH probe for queue depth / drain state), digest
+    into req/s, p99, shed/BUSY rates and the per-tenant table. Two scrapes
+    ``--interval`` seconds apart turn lifetime counters into rates; with
+    ``--watch`` the screen refreshes until ctrl-C."""
+    import time as _time
+
+    from orp_tpu.serve.gateway import GatewayClient
+    from orp_tpu.serve.scrape import render_top, top_snapshot
+
+    addr, _, port = str(args.gateway).rpartition(":")
+    addr = addr or "127.0.0.1"
+    target = f"{addr}:{port}"
+
+    def scrape(previous=None, interval=None):
+        # ONLY the network I/O sits in the caller's scrape-failure except:
+        # a render/print problem (BrokenPipeError from `orp top | head`,
+        # say) must not masquerade as a dead gateway
+        try:
+            with GatewayClient(addr, int(port),
+                               timeout_s=args.timeout_s) as client:
+                text = client.metrics()
+                health = client.health()
+        except (OSError, ValueError, RuntimeError) as e:
+            raise SystemExit(
+                f"error: could not scrape {target}: {e} — is an `orp "
+                "serve-gateway` listening there? (probe with `orp doctor "
+                f"--metrics {target}`)") from None
+        return top_snapshot(text, previous=previous, interval_s=interval,
+                            health=health)
+
+    try:
+        snap = scrape()
+        while True:
+            _time.sleep(args.interval)
+            snap = scrape(previous=snap, interval=args.interval)
+            if args.json:
+                print(json.dumps(snap))
+            else:
+                print(render_top(snap, target=target), flush=True)
+            if not args.watch:
+                return
+    except KeyboardInterrupt:
+        return  # --watch exits clean on ctrl-C, like top(1)
+
+
+def cmd_trace(args):
+    """Reconstruct one frame's span tree from a telemetry bundle's
+    ``events.jsonl``: ``orp trace <trace_id> --events DIR`` prints the
+    decode → queue → dispatch → resolve → encode chain the serving process
+    recorded under that trace id (stamp frames with
+    ``submit_block(..., trace=obs.new_trace())`` and run the gateway with
+    ``--telemetry DIR``)."""
+    from orp_tpu.obs.spans import parse_trace_id
+    from orp_tpu.obs.tracetree import format_trace_tree, load_trace
+
+    try:
+        parse_trace_id(args.trace_id)
+    except ValueError:
+        # validated SEPARATELY from the bundle read: a torn events.jsonl
+        # raises JSONDecodeError (a ValueError subclass), and blaming the
+        # trace id for a corrupt bundle sends the operator the wrong way
+        raise SystemExit(
+            f"error: {args.trace_id!r} is not a trace id — pass the "
+            "16-hex-digit id the producer stamped (obs.trace_hex)"
+        ) from None
+    try:
+        spans, roots, summary = load_trace(args.events, args.trace_id)
+    except FileNotFoundError as e:
+        raise SystemExit(f"error: {e}") from None
+    except ValueError as e:
+        raise SystemExit(
+            f"error: {args.events}: events.jsonl does not parse ({e}) — "
+            "torn bundle? (a killed gateway can leave a partial last "
+            "line; every complete line still parses)") from None
+    if not spans:
+        raise SystemExit(
+            f"error: no spans for trace {args.trace_id} in {args.events} — "
+            "wrong bundle, or the gateway ran without --telemetry")
+    if args.json:
+        print(json.dumps({"trace_id": args.trace_id, **summary,
+                          "tree": roots}))
+    else:
+        print(format_trace_tree(args.trace_id, roots, summary))
 
 
 def cmd_lint(args):
@@ -1383,6 +1509,13 @@ def build_parser():
                           "sequenced frames are refused with a BUSY frame "
                           "(backpressure — the producer resends; no rows "
                           "shed)")
+    pgw.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                     help="also serve plain-HTTP Prometheus scrape on this "
+                          "port (GET /metrics = the live exposition, GET "
+                          "/healthz = the JSON health doc; 0 picks a free "
+                          "port, reported in the startup line). The same "
+                          "exposition answers the in-band METRICS wire "
+                          "kind on the ingest port either way")
     pgw.add_argument("--max-seconds", type=float, default=None,
                      help="serve for this long then drain and exit "
                           "(default: until SIGTERM/ctrl-C — both run the "
@@ -1393,7 +1526,44 @@ def build_parser():
                           "--port 0 binding)")
     pgw.add_argument("--json", action="store_true",
                      help="emit the bound address as a JSON line")
+    _add_telemetry_flag(pgw)
     pgw.set_defaults(fn=cmd_serve_gateway)
+
+    pt = sub.add_parser(
+        "top",
+        help="live serving dashboard off a running gateway: scrape the "
+             "METRICS/HEALTH wire kinds and print req/s, p99, queue "
+             "depth, shed/BUSY rates and the per-tenant table",
+    )
+    pt.add_argument("--gateway", required=True, metavar="HOST:PORT",
+                    help="the running `orp serve-gateway` ingest address")
+    pt.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between the two scrapes that turn "
+                         "lifetime counters into rates (and the refresh "
+                         "period under --watch)")
+    pt.add_argument("--watch", action="store_true",
+                    help="keep refreshing until ctrl-C instead of one shot")
+    pt.add_argument("--timeout-s", type=float, default=5.0,
+                    help="bound on the scrape connect and every recv")
+    pt.add_argument("--json", action="store_true",
+                    help="emit the digested snapshot as one JSON line")
+    pt.set_defaults(fn=cmd_top)
+
+    ptr = sub.add_parser(
+        "trace",
+        help="reconstruct one frame's span tree (decode → queue → "
+             "dispatch → resolve → encode) from a telemetry bundle's "
+             "events.jsonl by trace id",
+    )
+    ptr.add_argument("trace_id",
+                     help="the trace id the producer stamped (16-hex-digit "
+                          "canonical spelling; 0x-hex and decimal accepted)")
+    ptr.add_argument("--events", required=True, metavar="DIR|FILE",
+                     help="the gateway's --telemetry DIR (or its "
+                          "events.jsonl directly)")
+    ptr.add_argument("--json", action="store_true",
+                     help="emit the span tree + segment summary as JSON")
+    ptr.set_defaults(fn=cmd_trace)
 
     pdoc = sub.add_parser(
         "doctor",
@@ -1417,6 +1587,12 @@ def build_parser():
     pdoc.add_argument("--gateway", default=None, metavar="HOST:PORT",
                       help="probe a running ingest gateway: TCP connect + "
                            "orp-ingest PING/PONG round trip")
+    pdoc.add_argument("--metrics", default=None, metavar="HOST:PORT",
+                      help="probe a gateway's LIVE scrape (METRICS wire "
+                           "kind): the exposition must parse and carry the "
+                           "core serve series (requests/latency, queue "
+                           "age, sheds); also triggers the serving "
+                           "process's flight-recorder dump")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "
@@ -1429,8 +1605,9 @@ def build_parser():
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
              "drift, key reuse, silent excepts, blocking dispatch loops, "
-             "single-device assumptions, per-row ingest work — rules "
-             "ORP001-ORP014); non-zero "
+             "single-device assumptions, per-row ingest work, unbounded "
+             "socket I/O, dynamic obs instrument names — rules "
+             "ORP001-ORP015); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,
@@ -1465,10 +1642,18 @@ def main(argv=None):
     if tdir:
         # one session around the whole command: the pipeline binds its config
         # fingerprint from inside (pipelines._bind_run_manifest), the session
-        # drops events.jsonl + metrics.prom + manifest.json in DIR at exit
+        # drops events.jsonl + metrics.prom + manifest.json + flight.jsonl
+        # in DIR. No longer exit-only: events stream live, metrics.prom is
+        # rewritten periodically, and the SIGTERM hook below flushes the
+        # bundle before a kill lands (SIGINT needs no hook — the
+        # KeyboardInterrupt unwinds this context manager, which exports).
+        # A command that installs its own SIGTERM handler afterwards
+        # (serve-gateway's graceful drain) wins, and exits the session
+        # cleanly anyway
         from orp_tpu import obs
 
         with obs.telemetry(tdir, manifest_extra={"cli_command": args.command}):
+            obs.install_signal_flush()
             return args.fn(args)
     args.fn(args)
 

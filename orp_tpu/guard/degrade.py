@@ -48,6 +48,7 @@ import numpy as np
 
 from orp_tpu.guard.serve import DeviceLostError, GuardPolicy
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 
 
 class _Tracked:
@@ -238,6 +239,8 @@ class DegradeManager:
         old_spec = self._spec
         from_devices = 1 if old_spec is None else (old_spec.n_devices or 1)
         obs_count("guard/device_loss", survivors=str(survivors))
+        flight.record("device_lost", survivors=survivors,
+                      from_devices=from_devices)
         new_spec = self._surviving_spec(survivors)
         to_devices = 1 if new_spec is None else new_spec.n_devices
         # rebuild FIRST and OUTSIDE every lock (ORP012): new traffic starts

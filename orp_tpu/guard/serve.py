@@ -43,6 +43,7 @@ import dataclasses
 import threading
 
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 
 
 class TransientDispatchError(RuntimeError):
@@ -186,6 +187,8 @@ class CircuitBreaker:
                 return False
             self._open.add(key)
         obs_count("guard/circuit_open", **{self.what: str(key)})
+        flight.record("circuit_open", key=str(key), what=self.what,
+                      threshold=self.threshold)
         return True
 
     def is_open(self, key) -> bool:

@@ -77,6 +77,17 @@ ORP014  unbounded socket I/O in serve-plane code: a ``recv``/``accept``/
         True`` loop with no deadline/timeout check inside ``*read*``/
         ``*recv*`` functions (the ``_read_exact``-polls-forever bug class).
         Sites whose socket is configured by the caller say so with a noqa.
+ORP015  dynamic obs instrument names / hot-path instrument construction:
+        the telemetry plane's whole export path (Prometheus exposition,
+        ``orp top``'s parser, the doctor ``--metrics`` probe) keys on
+        STABLE series names — an f-string name mints a new series per
+        value (unbounded registry growth, unprobeable exposition), and a
+        ``Counter``/``Gauge``/``Histogram``/``registry.*`` construction
+        inside a loop or a per-request/per-frame function under ``serve/``
+        or ``train/`` puts registry interning (a process-global lock) on
+        the hot path the zero-cost discipline keeps clean. Names must be
+        static lowercase slash-path literals (``[a-z0-9_]+(/[a-z0-9_]+)*``)
+        at the obs helper call sites; construction belongs at init time.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -968,6 +979,114 @@ def check_unbounded_socket_io(ctx: FileContext) -> Iterator[Finding]:
                     "stalled peer holds this handler forever; bound the "
                     "loop with a deadline",
                 )
+
+
+# -- ORP015 ------------------------------------------------------------------
+
+# the legal instrument-name shape: static lowercase slash-path segments
+_ORP015_NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_]+)*$")
+# the obs façade helpers whose FIRST argument is an instrument name. Matched
+# by unambiguous spellings only — the repo idiom `obs_count` alias or the
+# dotted `obs.count` — never a bare `count`/`observe` attribute (which would
+# collide with str.count / every Observer pattern ever written)
+_ORP015_HELPER_DOTTED = {"obs.count", "obs.observe", "obs.set_gauge",
+                         "obs.emit_record"}
+_ORP015_HELPER_TAILS = {"obs_count", "obs_observe", "obs_set_gauge",
+                        "obs_emit_record"}
+# registry façade methods + raw instrument constructors: literal names are
+# validated everywhere; non-literal names are allowed (module-level
+# constants like LATENCY_HISTOGRAM are the sanctioned indirection)
+_ORP015_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+_ORP015_CONSTRUCTORS = {"Counter", "Gauge", "Histogram"}
+# per-request / per-frame functions: the serve/train hot path where
+# instrument CONSTRUCTION (interning under the registry lock) is churn
+_ORP015_HOT_FN_RE = re.compile(
+    r"(^|_)(submit|handle|frame|reply|dispatch|admit|resolve|recv|send|"
+    r"step|evaluate)")
+# the obs plumbing itself forwards caller-supplied names by design
+_ORP015_EXEMPT_DIRS = ("obs/",)
+
+
+def _orp015_call_kind(node: ast.Call) -> str | None:
+    d = dotted(node.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    tail = parts[-1]
+    if d in _ORP015_HELPER_DOTTED or tail in _ORP015_HELPER_TAILS:
+        return "helper"
+    if (tail in _ORP015_REGISTRY_METHODS and len(parts) >= 2
+            and "reg" in parts[-2].lower()):
+        return "registry"
+    if isinstance(node.func, ast.Name) and tail in _ORP015_CONSTRUCTORS:
+        return "constructor"
+    return None
+
+
+def _orp015_in_loop(fdef: ast.AST, target: ast.Call) -> bool:
+    for loop in walk_scope(fdef):
+        if isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            if any(n is target for n in ast.walk(loop)):
+                return True
+    return False
+
+
+@rule("ORP015", "dynamic obs instrument name / hot-path construction")
+def check_instrument_hygiene(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if any("/" + d in path or path.startswith(d)
+           for d in _ORP015_EXEMPT_DIRS):
+        return
+    in_hot_tree = "serve/" in path or "train/" in path
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        hot_fn = in_hot_tree and _ORP015_HOT_FN_RE.search(fdef.name)
+        for node in walk_scope(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _orp015_call_kind(node)
+            if kind is None or not node.args:
+                continue
+            name_arg = node.args[0]
+            literal = (name_arg.value
+                       if isinstance(name_arg, ast.Constant)
+                       and isinstance(name_arg.value, str) else None)
+            if literal is not None and not _ORP015_NAME_RE.match(literal):
+                yield ctx.finding(
+                    node, "ORP015",
+                    f"instrument name {literal!r} is not a lowercase "
+                    "slash-path ([a-z0-9_]+(/[a-z0-9_]+)*) — the scrape "
+                    "plane (prometheus names, orp top, doctor --metrics) "
+                    "keys on the canonical shape",
+                )
+            elif literal is None and kind == "helper":
+                yield ctx.finding(
+                    node, "ORP015",
+                    f"dynamic instrument name at {dotted(node.func)}(...) "
+                    "— an f-string/variable name mints a new series per "
+                    "value (unbounded registry growth, unprobeable "
+                    "exposition); use a static literal with the variable "
+                    "as a LABEL, or noqa why the name set is bounded",
+                )
+            if kind in ("registry", "constructor") and in_hot_tree:
+                if hot_fn:
+                    yield ctx.finding(
+                        node, "ORP015",
+                        f"instrument construction ({dotted(node.func)}) in "
+                        f"per-request/per-frame function {fdef.name!r} — "
+                        "registry interning takes a process-global lock; "
+                        "intern at init time and keep the handle",
+                    )
+                elif _orp015_in_loop(fdef, node):
+                    yield ctx.finding(
+                        node, "ORP015",
+                        f"instrument construction ({dotted(node.func)}) "
+                        f"inside a loop in {fdef.name!r} — per-iteration "
+                        "registry interning is hot-path churn; hoist the "
+                        "instrument (or noqa why this is a lookup on a "
+                        "cold path)",
+                    )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

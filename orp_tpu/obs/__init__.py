@@ -11,40 +11,61 @@ instrumentation with one export spine; see PAPERS.md):
                   histograms with label support (``obs.REGISTRY`` default);
 - ``spans``     — nested device-complete span timers (TraceAnnotation +
                   wall time blocked on the result tree) with a ZERO-COST
-                  disabled mode: off by default, `span()` then returns one
-                  shared no-op — no allocation, no lock, no clock;
+                  disabled mode, PLUS the distributed-trace primitives:
+                  ``new_trace()`` mints the (trace_id, span_id) pair a
+                  gateway producer stamps into an ``orp-ingest`` frame, and
+                  ``emit_trace_span`` links serving-segment spans under it;
 - ``sink``      — schema-versioned JSONL event log (``orp-obs-v1``) +
                   Prometheus text exposition of the registry;
 - ``manifest``  — run manifests binding artifacts to the config
-                  fingerprint, jax/jaxlib versions, platform and git rev.
+                  fingerprint, jax/jaxlib versions, platform and git rev;
+- ``flight``    — the per-process flight recorder: a bounded ring of recent
+                  guard/serve events, dumped as a schema-versioned JSONL
+                  black box (``orp-flight-v1``) on guard trips, SIGTERM, or
+                  a doctor request — always on, even with no session;
+- ``tracetree`` — the read side of tracing: rebuild one frame's span tree
+                  from a bundle's ``events.jsonl`` (CLI ``orp trace``).
 
 The one-call entry point is the session::
 
     with obs.telemetry("runs/tonight"):
         european_hedge(...)           # pipelines bind their fingerprint +
                                       # emit sim/train/report spans
-    # -> runs/tonight/{events.jsonl, metrics.prom, manifest.json}
+    # -> runs/tonight/{events.jsonl, metrics.prom, manifest.json,
+    #                  flight.jsonl}
 
-which is exactly what the CLI's ``--telemetry DIR`` flag does. Instrumented
-call sites (``train/backward``, ``serve/engine``, ``serve/batcher``,
-``api/pipelines``) pay nothing until a session is active.
+which is exactly what the CLI's ``--telemetry DIR`` flag does. The session
+is no longer exit-only: ``events.jsonl`` streams live, ``metrics.prom`` is
+rewritten every ``flush_every_s`` seconds by a background flusher, and the
+CLI installs a SIGTERM hook (``install_signal_flush``) that flushes the
+bundle + dumps the flight ring before the process dies — a killed
+``orp serve-gateway`` leaves its telemetry behind. Instrumented call sites
+(``train/backward``, ``serve/engine``, ``serve/batcher``, ``api/pipelines``)
+still pay nothing until a session is active.
 """
 
 from __future__ import annotations
 
 import contextlib
 import pathlib
+import threading
 
+from orp_tpu.obs import flight
+from orp_tpu.obs.flight import (FLIGHT_FILE, FLIGHT_SCHEMA, FlightRecorder,
+                                read_flight, validate_flight_event)
 from orp_tpu.obs.manifest import (MANIFEST_SCHEMA, build_manifest,
                                   config_fingerprint, read_manifest,
                                   write_manifest)
 from orp_tpu.obs.registry import Counter, Gauge, Histogram, Registry
-from orp_tpu.obs.sink import (SCHEMA, JsonlSink, ListSink, prometheus_text,
-                              read_events, validate_event, write_prometheus)
+from orp_tpu.obs.sink import (EVENTS_FILE, METRICS_FILE, SCHEMA, JsonlSink,
+                              ListSink, prometheus_text, read_events,
+                              validate_event, write_prometheus)
 from orp_tpu.obs.spans import (NOOP_SPAN, ObsState, Span, active,
                                bind_manifest, count, disable, emit_record,
-                               enable, enabled, observe, set_gauge, span,
-                               spanned, state, timed)
+                               emit_trace_span, emit_trace_spans, enable,
+                               enabled, new_span_id, new_trace, observe,
+                               parse_trace_id, set_gauge, span, spanned,
+                               state, suspended, timed, trace_hex)
 
 #: a process-wide scratch registry for ad-hoc, session-independent
 #: instruments. NOTE: ``telemetry()`` exports its OWN per-session registry
@@ -53,23 +74,82 @@ from orp_tpu.obs.spans import (NOOP_SPAN, ObsState, Span, active,
 #: ``telemetry(registry=...)`` this one explicitly)
 REGISTRY = Registry()
 
-EVENTS_FILE = "events.jsonl"
-METRICS_FILE = "metrics.prom"
+
+def flush_active() -> None:
+    """Write the active session's exportable state NOW: ``metrics.prom``
+    re-rendered from the registry, the sink's buffer pushed to disk, and
+    the flight ring dumped next to them. No-op without an exporting session
+    — safe to call from a signal handler, a periodic flusher, or a drain
+    path at any time."""
+    st = state()
+    if st is None or st.export_dir is None:
+        return
+    d = pathlib.Path(st.export_dir)
+    write_prometheus(d / METRICS_FILE, st.registry)
+    if st.sink is not None and hasattr(st.sink, "flush"):
+        st.sink.flush()
+    flight.RECORDER.dump()
+
+
+def install_signal_flush() -> bool:
+    """Chain a SIGTERM hook that flushes the active bundle + flight ring
+    before the process dies, then hands the signal to the previous handler
+    (default: die, as a supervisor expects). Installed by the CLI for every
+    ``--telemetry`` run; main-thread only (the signal module's rule), and
+    never stomps a command's own custom handler — ``orp serve-gateway``
+    installs its drain handler AFTER this and wins, which is correct: its
+    graceful drain exits the telemetry session cleanly anyway. SIGINT needs
+    no hook: KeyboardInterrupt unwinds the ``telemetry()`` context manager,
+    which writes the bundle. Returns True when installed."""
+    import os
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    previous = signal.getsignal(signal.SIGTERM)
+
+    def _flush_then_die(signum, frame):
+        # the flush runs on a HELPER thread with a bounded join: the
+        # handler interrupts the main thread wherever it was, possibly
+        # mid-emit holding the sink/ring/instrument lock — flushing on
+        # this thread would self-deadlock on that non-reentrant lock and
+        # the supervisor's SIGKILL would lose the bundle. A helper that
+        # blocks on the held lock just times the join out, and the
+        # process still dies (with whatever the periodic flusher and the
+        # line-buffered event stream already persisted).
+        flusher = threading.Thread(target=flush_active,
+                                   name="orp-obs-sigterm-flush", daemon=True)
+        flusher.start()
+        flusher.join(timeout=5.0)
+        if callable(previous):
+            previous(signum, frame)
+        else:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    signal.signal(signal.SIGTERM, _flush_then_die)
+    return True
 
 
 @contextlib.contextmanager
 def telemetry(directory: str | pathlib.Path | None = None, *,
               registry: Registry | None = None,
               run_fingerprint: str | None = None,
-              manifest_extra: dict | None = None):
+              manifest_extra: dict | None = None,
+              flush_every_s: float | None = 30.0):
     """One telemetry session: enable the spine, export a bundle at exit.
 
     With ``directory`` set, drops ``events.jsonl`` (streamed live),
-    ``metrics.prom`` and ``manifest.json`` there; with ``directory=None``
-    events go to an in-memory ``ListSink`` (introspection without files).
-    The manifest's ``run_fingerprint`` can be passed here or bound from
-    inside the session by the pipeline (``obs.bind_manifest``) — the
-    pipeline's binding wins, since it knows the actual run config.
+    ``metrics.prom`` and ``manifest.json`` there, arms the flight recorder
+    at the same directory (``flight.jsonl`` on any guard trip / signal
+    flush / session exit), and runs a background flusher rewriting
+    ``metrics.prom`` every ``flush_every_s`` seconds (None disables) — so a
+    KILLED process still leaves its telemetry, not an empty dir. With
+    ``directory=None`` events go to an in-memory ``ListSink``
+    (introspection without files). The manifest's ``run_fingerprint`` can
+    be passed here or bound from inside the session by the pipeline
+    (``obs.bind_manifest``) — the pipeline's binding wins, since it knows
+    the actual run config.
     """
     reg = registry if registry is not None else Registry()
     sink = (JsonlSink(pathlib.Path(directory) / EVENTS_FILE)
@@ -79,9 +159,27 @@ def telemetry(directory: str | pathlib.Path | None = None, *,
         st.manifest_extra.setdefault("run_fingerprint", run_fingerprint)
     if manifest_extra:
         st.manifest_extra.update(manifest_extra)
+    stop = None
+    flusher = None
+    if directory is not None:
+        st.export_dir = pathlib.Path(directory)
+        flight.RECORDER.arm(st.export_dir)
+        if flush_every_s is not None and flush_every_s > 0:
+            stop = threading.Event()
+
+            def _flush_loop():
+                while not stop.wait(flush_every_s):
+                    flush_active()
+
+            flusher = threading.Thread(target=_flush_loop,
+                                       name="orp-obs-flusher", daemon=True)
+            flusher.start()
     try:
         yield st
     finally:
+        if stop is not None:
+            stop.set()
+            flusher.join(timeout=5.0)
         disable()
         if directory is not None:
             d = pathlib.Path(directory)
@@ -89,4 +187,6 @@ def telemetry(directory: str | pathlib.Path | None = None, *,
             fp = extra.pop("run_fingerprint", None)
             write_prometheus(d / METRICS_FILE, reg)
             write_manifest(d, run_fingerprint=fp, extra=extra)
+            flight.RECORDER.dump(d / FLIGHT_FILE)
+            flight.RECORDER.disarm()
         sink.close()

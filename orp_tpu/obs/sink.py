@@ -27,6 +27,11 @@ from orp_tpu.obs.registry import Counter, Gauge, Registry
 
 SCHEMA = "orp-obs-v1"
 
+#: the bundle's canonical file names (one source of truth — the telemetry
+#: session, the doctor probe and the trace viewer all resolve these)
+EVENTS_FILE = "events.jsonl"
+METRICS_FILE = "metrics.prom"
+
 # every event line must carry these; type-specific payloads ride alongside
 _REQUIRED = {"schema": str, "seq": int, "ts_unix": float, "type": str}
 _KNOWN_TYPES = ("span", "counter", "gauge", "manifest", "record")
@@ -80,10 +85,38 @@ class JsonlSink:
             self._seq += 1
             self._f.write(json.dumps(line) + "\n")
 
+    def emit_many(self, events) -> None:
+        """Emit a burst of events under ONE lock acquisition, one clock
+        read and one write — the trace plane emits a frame's segment spans
+        as a group, and per-event lock/stamp/write churn would put the
+        recorder inside the per-frame budget it documents."""
+        with self._lock:
+            if self._f.closed:
+                return
+            now = time.time()
+            out = []
+            for event in events:
+                line = dict(event)
+                line["schema"] = SCHEMA
+                line["seq"] = self._seq
+                line["ts_unix"] = now
+                self._seq += 1
+                out.append(json.dumps(line))
+            if out:
+                self._f.write("\n".join(out) + "\n")
+
     @property
     def emitted(self) -> int:
         with self._lock:
             return self._seq
+
+    def flush(self) -> None:
+        """Force buffered lines to disk (the SIGTERM flush path; writes are
+        line-buffered already, so this is belt-and-braces for a kill that
+        lands mid-line)."""
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
 
     def close(self) -> None:
         with self._lock:
@@ -113,6 +146,17 @@ class ListSink:
             line["seq"] = len(self.events)
             line["ts_unix"] = time.time()
             self.events.append(line)
+
+    def emit_many(self, events) -> None:
+        """The burst contract, in memory: one lock, one clock read."""
+        with self._lock:
+            now = time.time()
+            for event in events:
+                line = dict(event)
+                line["schema"] = SCHEMA
+                line["seq"] = len(self.events)
+                line["ts_unix"] = now
+                self.events.append(line)
 
     def close(self) -> None:
         pass

@@ -24,6 +24,9 @@ this module (pinned by tests/test_obs.py).
 from __future__ import annotations
 
 import contextlib
+import itertools
+import random
+import secrets
 import threading
 import time
 
@@ -39,6 +42,9 @@ class ObsState:
         self.registry = registry if registry is not None else Registry()
         self.sink = sink
         self.manifest_extra: dict = {}
+        # set by obs.telemetry when the session exports to disk: the dir
+        # mid-session flushes (periodic / SIGTERM) write into
+        self.export_dir = None
 
 
 _STATE: ObsState | None = None
@@ -73,6 +79,20 @@ def active(registry: Registry | None = None, sink=None):
         yield st
     finally:
         disable()
+
+
+@contextlib.contextmanager
+def suspended():
+    """Temporarily detach the active session (telemetry truly OFF inside),
+    restoring it — not just re-enabling a blank one — on exit. The bench's
+    enabled-vs-disabled overhead lanes need a genuine disabled mode even
+    when the whole bench runs under ``--telemetry``."""
+    global _STATE
+    prev, _STATE = _STATE, None
+    try:
+        yield
+    finally:
+        _STATE = prev
 
 
 class _NoopSpan:
@@ -260,6 +280,115 @@ def set_gauge(name: str, value: float, **labels) -> None:
     if st.sink is not None:
         st.sink.emit({"type": "gauge", "name": name, "value": float(value),
                       "labels": labels or {}})
+
+
+# -- distributed trace context (Dapper-style ids over the wire) ---------------
+#
+# A trace is a u64 ``trace_id`` stamped once by the PRODUCER (the gateway
+# client) and carried in-band through the ``orp-ingest-v2`` frame; every
+# process segment it crosses (decode -> queue -> dispatch -> resolve ->
+# encode) emits a span EVENT under that id, so one row's life reconstructs
+# from the serving process's events.jsonl (``orp trace <trace_id>``). Span
+# ids are process-unique: a random 32-bit base ORed with a monotonic
+# counter (itertools.count.__next__ is atomic under the GIL), so two
+# processes contributing to one trace cannot collide. On the JSON side the
+# u64s travel as 16-hex-digit STRINGS — a u64 does not survive a float64
+# JSON number (2^53 mantissa), and a silently-rounded trace id is a trace
+# that can never be found again.
+
+_SPAN_BASE = secrets.randbits(32) << 32
+_SPAN_IDS = itertools.count(1)
+# trace ids need uniqueness, not unpredictability: a PRNG seeded ONCE from
+# the CSPRNG gives both process-level independence and ~60ns draws — the
+# secrets module itself costs ~4µs per draw, which a per-frame stamp on the
+# ingest lane cannot afford (the overhead gate measures exactly this)
+_TRACE_RNG = random.Random(secrets.randbits(64))
+
+
+def new_span_id() -> int:
+    """A fresh process-unique span id (cheap: one counter increment)."""
+    return _SPAN_BASE | next(_SPAN_IDS)
+
+
+def new_trace() -> tuple[int, int]:
+    """A fresh ``(trace_id, root_span_id)`` pair for stamping an outbound
+    frame — the producer-side entry point of the distributed trace."""
+    return _TRACE_RNG.getrandbits(64) or 1, new_span_id()
+
+
+def trace_hex(trace_id: int) -> str:
+    """The canonical JSON/CLI spelling of a trace/span id."""
+    return f"{int(trace_id):016x}"
+
+
+def parse_trace_id(s) -> int:
+    """Accept the id as an int, hex (with or without ``0x``) or decimal —
+    the ``orp trace`` argument contract. The canonical spelling is the
+    16-hex-digit string ``trace_hex`` prints; an all-digit string parses as
+    hex first, because that is what this module emits."""
+    if isinstance(s, int):
+        return s
+    s = str(s).strip().lower()
+    if s.startswith("0x"):
+        return int(s, 16)
+    try:
+        # 16-hex-digit is the canonical spelling; plain digit strings that
+        # are valid hex parse as hex first (that is what we print)
+        return int(s, 16)
+    except ValueError:
+        return int(s, 10)
+
+
+def emit_trace_span(name: str, trace_id: int, parent_span: int,
+                    dur_s: float, *, span_id: int | None = None,
+                    attrs: dict | None = None) -> int | None:
+    """Emit one trace-linked span event on the active sink: a ``span``
+    event carrying ``trace_id``/``span_id``/``parent_span`` as hex strings
+    next to the usual ``dur_s``. Returns the span id used (None when
+    telemetry is off or sinkless — the zero-cost rule: untraced serving
+    pays one global load + None test)."""
+    st = _STATE
+    if st is None or st.sink is None:
+        return None
+    sid = new_span_id() if span_id is None else int(span_id)
+    event = {
+        "type": "span", "name": name, "dur_s": round(float(dur_s), 9),
+        "parent": None, "ok": True,
+        "trace_id": trace_hex(trace_id), "span_id": trace_hex(sid),
+        "parent_span": trace_hex(parent_span),
+    }
+    if attrs:
+        event["attrs"] = attrs
+    # sink-only on purpose: the event IS the trace artifact (`orp trace`
+    # reads it back); mirroring every segment into registry histograms
+    # would double the per-frame cost for series nobody scrapes — the
+    # scrape plane already carries the serving latency/queue-age series
+    st.sink.emit(event)
+    return sid
+
+
+def emit_trace_spans(trace_id: int, parent_span: int, segments) -> None:
+    """Emit a frame's segment spans as ONE sink burst: ``segments`` is an
+    iterable of ``(name, dur_s)``. The per-frame tracing budget lives or
+    dies here — the ids are hexed once, the sink is locked/stamped once
+    (``emit_many``), nothing touches the registry. Same zero-cost rule:
+    one global load + None test when telemetry is off or sinkless."""
+    st = _STATE
+    if st is None or st.sink is None:
+        return
+    tid = trace_hex(trace_id)
+    par = trace_hex(parent_span)
+    events = [{
+        "type": "span", "name": name, "dur_s": round(float(dur), 9),
+        "parent": None, "ok": True, "trace_id": tid,
+        "span_id": trace_hex(new_span_id()), "parent_span": par,
+    } for name, dur in segments]
+    emit_many = getattr(st.sink, "emit_many", None)
+    if emit_many is not None:
+        emit_many(events)
+    else:  # a foreign sink that only speaks emit(): same events, N locks
+        for event in events:
+            st.sink.emit(event)
 
 
 def bind_manifest(**fields) -> None:

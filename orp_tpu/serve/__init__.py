@@ -65,6 +65,8 @@ from orp_tpu.serve.ingest import (SERVED, SHED_DEADLINE, SHED_QUOTA,
                                   SHED_WATERMARK, STATUS_NAMES, BlockResult,
                                   concat_results)
 from orp_tpu.serve.metrics import ServingMetrics
+from orp_tpu.serve.scrape import (MetricsServer, parse_prometheus,
+                                  render_top, top_snapshot)
 
 __all__ = [
     "BlockResult",
@@ -74,6 +76,7 @@ __all__ = [
     "GatewayClient",
     "GatewayError",
     "HedgeEngine",
+    "MetricsServer",
     "MicroBatcher",
     "PendingEval",
     "PolicyBundle",
@@ -92,6 +95,9 @@ __all__ = [
     "doctor_report",
     "export_bundle",
     "load_bundle",
+    "parse_prometheus",
+    "render_top",
     "serve_bench",
+    "top_snapshot",
     "write_bench_record",
 ]

@@ -75,6 +75,7 @@ import numpy as np
 
 from orp_tpu.guard.serve import GuardPolicy, Rejection, TransientDispatchError
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 from orp_tpu.obs import observe as obs_observe
 from orp_tpu.obs import span
 from orp_tpu.serve.ingest import (SHED_DEADLINE, SHED_WATERMARK, Block,
@@ -357,7 +358,7 @@ class MicroBatcher:
         return fut
 
     def submit_block(self, date_idx: int, states, prices=None,
-                     deadlines=None) -> SlimFuture:
+                     deadlines=None, *, trace=None) -> SlimFuture:
         """Columnar ingest lane: admit N rows for ONE date under one lock
         pass with ONE future for the whole block. The future resolves to a
         :class:`~orp_tpu.serve.ingest.BlockResult` — contiguous ``phi``/
@@ -375,6 +376,15 @@ class MicroBatcher:
         expiry = one mask compare at admit; watermark = the tail rows past
         the row-counted watermark shed as a slice at submit), never as
         per-row ``Rejection`` objects.
+
+        ``trace``: an optional ``(trace_id, parent_span)`` distributed-trace
+        context (``obs.new_trace()`` / a decoded frame's stamp). A traced
+        block's admit/dispatch/device instants become ``trace/queue`` /
+        ``trace/dispatch`` / ``trace/resolve`` span events under that
+        trace_id, and its :class:`~orp_tpu.serve.ingest.BlockResult` carries
+        the ``(queue_age_s, dispatch_s)`` server-timing pair. ``None`` (the
+        default) costs one ``is not None`` test per block — the zero-cost
+        discipline, block-amortized.
         """
         feats = np.atleast_2d(np.ascontiguousarray(states))
         n = feats.shape[0]
@@ -395,7 +405,8 @@ class MicroBatcher:
             default = (None if self.policy.deadline_ms is None
                        else self.policy.deadline_ms / 1e3)
             dl = as_deadline_column(deadlines, n, now, default)
-        blk = Block(int(date_idx), feats, pr, SlimFuture(), now, dl)
+        blk = Block(int(date_idx), feats, pr, SlimFuture(), now, dl,
+                    trace=trace)
         n_wm = 0
         with self._cv:
             if self._closed:
@@ -449,6 +460,7 @@ class MicroBatcher:
         queued = time.perf_counter() - req.submitted_at
         obs_count("guard/shed", reason=reason)
         obs_observe("serve/queue_age_seconds", queued, outcome="shed")
+        flight.record("shed", reason=reason, queued_s=round(queued, 6))
         if req.future.set_running_or_notify_cancel():
             req.future.set_result(Rejection(
                 reason=reason, queued_s=queued,
@@ -525,6 +537,10 @@ class MicroBatcher:
                             continue
                         obs_observe("serve/queue_age_seconds",
                                     now - req.submitted_at, outcome="served")
+                        if req.trace is not None:
+                            # the queue segment ends here; `now` was read
+                            # anyway, so a traced block costs one store
+                            req.t_admit = now
                         batch.append(req)
                         rows += live
                         if window_end is None:
@@ -583,6 +599,9 @@ class MicroBatcher:
                 except Exception as e:  # orp: noqa[ORP009] -- delivered to the block's future by _resolve
                     g.error = e
                     continue
+                if req.trace is not None:
+                    # the dispatch segment ends at device submission
+                    req.t_dispatch = time.perf_counter()
                 obs_count("serve/batcher_dispatches")
                 obs_count("serve/ingest_block_rows", g.rows, sink_event=False)
                 if self.metrics is not None:
@@ -731,7 +750,8 @@ class MicroBatcher:
                 blk.future.set_exception(e)
             return
         done = time.perf_counter()
-        blk.resolve_served(phi, psi, value)
+        timing = blk.trace_report(done) if blk.trace is not None else None
+        blk.resolve_served(phi, psi, value, timing=timing)
         if self.metrics is not None:
             self.metrics.record(done - blk.submitted_at, g.rows)
 

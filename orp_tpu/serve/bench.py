@@ -218,6 +218,147 @@ def _columnar_level(engine, feats, bsz: int, top: int, max_wait_us: float,
     }
 
 
+TRACE_OVERHEAD_GATE_PCT = 5.0
+
+
+def _trace_overhead(engine, feats, max_wait_us: float,
+                    repeats: int = 15) -> dict:
+    """Tracing cost on the columnar lane — the number the zero-cost
+    discipline must PROVE, not assert. Three lanes over the same rows
+    through ``submit_block``:
+
+    - **disabled**  — telemetry genuinely off (``obs.suspended`` detaches
+      any ambient session): the production fast path, pinned zero-cost
+      since PR 4;
+    - **enabled, untraced** — a live in-memory session (ListSink —
+      measuring the spine, not the disk), no trace stamped: the serving
+      process's ambient instrumented state (engine/batcher spans,
+      registry counters);
+    - **enabled, traced** — every block stamped ``obs.new_trace()``: the
+      full tracing bill (stamp + admit/dispatch instants + three span
+      emissions + the server-timing pair on the result).
+
+    ``overhead_pct`` — what the CI gate (:data:`TRACE_OVERHEAD_GATE_PCT`)
+    judges — is the per-frame tracing BILL measured directly (the
+    stamp + segment-burst emission path in a tight loop, the only code
+    tracing adds to a frame's life) amortized over the block and divided
+    by the measured disabled-lane ns/row. Composing a tightly-measurable
+    numerator with a robust denominator is the only estimator that
+    resolves a few percent on a shared box: differencing two multi-ms
+    walls under scheduler noise measured −22%…+51% for IDENTICAL code,
+    so ``measured_delta_pct`` (the end-to-end traced-vs-untraced median
+    delta) is recorded for honesty but not gated. Lanes are measured at
+    the headline columnar shape (blocks of ``min(1024, rows)``, ≥ 32k
+    rows per timed window), untraced/traced runs interleaved in
+    alternating order so drift cancels from the recorded delta."""
+    rows = feats.shape[0]
+    # ALWAYS the headline columnar shape, whatever the sweep's block list:
+    # per-dispatch span cost amortizes over the block, and tiny blocks
+    # would measure batcher coalescing nondeterminism, not tracing
+    bsz = min(rows, 1024)
+    # ≥32k rows per timed window: a ~10ms wall per run, long enough that a
+    # scheduler spike is a fraction of the window instead of reading as a
+    # double-digit "overhead" on a 3ms one
+    passes = max(1, -(-32768 // rows))
+    total = rows * passes
+
+    offsets = [o for _ in range(passes) for o in range(0, rows, bsz)]
+
+    def run_once(traced: bool) -> float:
+        # open-loop, like the ingest lane itself: every block submitted,
+        # then one gather — the worker's trace emissions overlap the next
+        # block's device execution exactly as a pipelined producer's do
+        # (a serial submit-resolve loop would put the emission on the
+        # critical path no real producer serializes on)
+        with MicroBatcher(engine, max_batch=bsz,
+                          max_wait_us=max_wait_us) as mb:
+            t0 = time.perf_counter()
+            if traced:
+                futures = [mb.submit_block(0, feats[o:o + bsz],
+                                           trace=obs.new_trace())
+                           for o in offsets]
+            else:
+                futures = [mb.submit_block(0, feats[o:o + bsz])
+                           for o in offsets]
+            for f in futures:
+                f.result(timeout=120)
+            return time.perf_counter() - t0
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    from orp_tpu.obs.sink import ListSink
+
+    with obs.suspended():
+        off = med([run_once(False) for _ in range(repeats)])
+        pairs = []
+        with obs.active(sink=ListSink()):
+            run_once(True)  # warm both code paths off the record
+            for i in range(repeats):
+                # alternate the order within each pair so a monotone drift
+                # (thermal, background load) cancels out of the deltas
+                # instead of reading as tracing cost
+                if i % 2:
+                    t = run_once(True)
+                    u = run_once(False)
+                else:
+                    u = run_once(False)
+                    t = run_once(True)
+                pairs.append((u, t))
+    untraced = med([u for u, _ in pairs])
+    traced = med([t for _, t in pairs])
+    delta = med([t - u for u, t in pairs])
+    # the gated number: the per-frame tracing bill, measured in a tight
+    # loop over the exact code a traced frame adds (stamp + admit/dispatch
+    # instants + the one-burst segment emission), amortized per row
+    bill_s = _trace_bill_s(feats[:bsz])
+    disabled_ns = off / total * 1e9
+    overhead_pct = (bill_s / bsz * 1e9) / disabled_ns * 100.0
+    return {
+        "block": int(bsz),
+        "rows": int(total),
+        "repeats": int(repeats),
+        "disabled_ns_per_row": round(disabled_ns, 1),
+        "enabled_untraced_ns_per_row": round(untraced / total * 1e9, 1),
+        "enabled_ns_per_row": round(traced / total * 1e9, 1),
+        "spine_overhead_pct": round((untraced - off) / off * 100.0, 2),
+        "measured_delta_pct": round(delta / untraced * 100.0, 2),
+        "trace_bill_us_per_frame": round(bill_s * 1e6, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "gate_pct": TRACE_OVERHEAD_GATE_PCT,
+    }
+
+
+def _trace_bill_s(feats, iters: int = 2000) -> float:
+    """The wall of everything tracing ADDS to one frame's life through the
+    batcher, in a tight loop: ``obs.new_trace`` (the producer stamp), the
+    admit/dispatch perf_counter instants, ``Block.trace_report`` (the
+    one-burst segment emission + server-timing pair). Run under a live
+    ListSink session; median-of-3 batches."""
+    from orp_tpu.obs.sink import ListSink
+    from orp_tpu.serve.batcher import SlimFuture
+    from orp_tpu.serve.ingest import Block
+
+    # ONE block reused: its construction is paid by traced and untraced
+    # frames alike, so it is not part of the tracing bill
+    blk = Block(0, feats, None, SlimFuture(), time.perf_counter(), None,
+                trace=(1, 1))
+
+    def batch() -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            blk.trace = obs.new_trace()
+            blk.t_admit = time.perf_counter()
+            blk.t_dispatch = time.perf_counter()
+            blk.trace_report(time.perf_counter())
+        return (time.perf_counter() - t0) / iters
+
+    with obs.suspended(), obs.active(sink=ListSink()):
+        walls = sorted(batch() for _ in range(3))
+    return walls[1]
+
+
 def _gateway_level(client, feats, bsz: int, pin) -> dict:
     """One gateway-loopback point: encode → TCP → decode → submit_block →
     encode reply, serially per block — the full wire round trip the
@@ -322,6 +463,11 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
                 gateway = [_gateway_level(client, feats, bsz, _pin)
                            for bsz in block_sizes]
 
+    # tracing-overhead lane (always the 1024-row headline block shape —
+    # see _trace_overhead): the enabled-mode cost the telemetry plane
+    # commits to keeping under the gate, re-proven by every --ingest run
+    trace_overhead = _trace_overhead(engine, feats, max_wait_us)
+
     # the LARGEST block is the amortization headline — by value, not list
     # position, so an unsorted --ingest-blocks cannot flip the CLI gate
     best = max(columnar, key=lambda c: c["block"])
@@ -331,6 +477,7 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
         "per_request": per_request,
         "columnar": columnar,
         "gateway": gateway,
+        "trace_overhead": trace_overhead,
         "submit_ns_per_row": best["submit_ns_per_row"],
         "ingest_rows_per_s": max(c["ingest_rows_per_s"] for c in columnar),
         "submit_speedup_vs_per_request": round(
@@ -724,6 +871,13 @@ def serve_bench(
         # the amortized-submit headlines, first-class like p99/mttr
         record["submit_ns_per_row"] = ing["submit_ns_per_row"]
         record["ingest_rows_per_s"] = ing["ingest_rows_per_s"]
+        record["trace_overhead_pct"] = ing["trace_overhead"]["overhead_pct"]
+        if ing["trace_overhead"]["overhead_pct"] > TRACE_OVERHEAD_GATE_PCT:
+            raise RuntimeError(
+                "tracing overhead gate violated: enabled-mode ingest costs "
+                f"{ing['trace_overhead']['overhead_pct']}% over disabled "
+                f"(gate {TRACE_OVERHEAD_GATE_PCT}%) — the telemetry plane "
+                "crept into the hot path; do not commit this record")
     if sweep:
         record["sweep"] = sweep
         record["batcher_sustained_requests_per_s"] = best["requests_per_s"]

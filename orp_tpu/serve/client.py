@@ -49,6 +49,7 @@ import time
 from orp_tpu.guard import inject
 from orp_tpu.guard.serve import GuardPolicy
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 from orp_tpu.serve import wire
 from orp_tpu.serve.batcher import SlimFuture
 from orp_tpu.serve.gateway import (MAX_FRAME_BYTES, GatewayError, _LEN,
@@ -147,13 +148,21 @@ class ResilientGatewayClient:
 
     def submit_block_async(self, tenant: str, date_idx: int, states,
                            prices=None, deadlines=None, *,
-                           deadline_ms: float | None = None) -> SlimFuture:
+                           deadline_ms: float | None = None,
+                           trace=None) -> SlimFuture:
         """Enqueue one block; the future resolves to its
         :class:`~orp_tpu.serve.ingest.BlockResult` exactly once — across
         reconnects, replays, BUSY spells and gateway handoffs — or raises
         :class:`GatewayError` when the gateway refused the frame or the
         reconnect budget died. Blocks while the replay buffer is full (the
-        client-side backpressure bound)."""
+        client-side backpressure bound).
+
+        ``trace``: an optional ``(trace_id, parent_span)`` pair
+        (``obs.new_trace()``) stamped into the frame's trace extension.
+        The replay buffer keeps the encoded bytes, so a replayed frame
+        carries the SAME trace context — one trace id spans the frame's
+        whole delivery story, reconnects included — and the resolved
+        ``BlockResult.timing`` carries the gateway's server-timing pair."""
         with self._space:
             if self._closed:
                 raise RuntimeError("ResilientGatewayClient is closed")
@@ -173,7 +182,7 @@ class ResilientGatewayClient:
         # buffer bound is per-producer-tight, not global-exact)
         frame = wire.encode_request(tenant, date_idx, states, prices,
                                     deadlines, deadline_ms=deadline_ms,
-                                    seq=seq)
+                                    seq=seq, trace=trace)
         e = _Entry(seq, frame)
         with self._space:
             if self._closed:
@@ -185,10 +194,11 @@ class ResilientGatewayClient:
 
     def submit_block(self, tenant: str, date_idx: int, states, prices=None,
                      deadlines=None, *, deadline_ms: float | None = None,
-                     timeout_s: float | None = None):
+                     timeout_s: float | None = None, trace=None):
         """Synchronous convenience: ``submit_block_async(...).result()``."""
         fut = self.submit_block_async(tenant, date_idx, states, prices,
-                                      deadlines, deadline_ms=deadline_ms)
+                                      deadlines, deadline_ms=deadline_ms,
+                                      trace=trace)
         return fut.result(timeout=self.timeout_s if timeout_s is None
                           else timeout_s)
 
@@ -501,6 +511,9 @@ class ResilientGatewayClient:
             self.stats["reconnects"] += 1
             self.stats["replayed_frames"] += len(entries)
             obs_count("serve/client_reconnects")
+            flight.record("reconnect", attempt=attempt,
+                          target=f"{target[0]}:{target[1]}",
+                          replayed=len(entries))
             # replay in seq order: the session window admits them in order,
             # answering already-served ones from the reply cache
             for e in entries:
@@ -510,6 +523,8 @@ class ResilientGatewayClient:
                     self._drop_sock(sock)
                     break  # next loop iteration reconnects again
             return True
+        flight.record("client_dead", attempts=attempts,
+                      target=f"{self._target[0]}:{self._target[1]}")
         dead = GatewayError(
             f"reconnect budget exhausted after {attempts} attempts to "
             f"{self._target[0]}:{self._target[1]}: {last}")

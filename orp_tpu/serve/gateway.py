@@ -57,6 +57,8 @@ import time
 
 from orp_tpu.guard import inject
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import emit_trace_span, flight, prometheus_text
+from orp_tpu.obs import state as obs_state
 from orp_tpu.serve import wire
 from orp_tpu.serve.batcher import SlimFuture
 from orp_tpu.serve.ingest import BlockResult
@@ -228,6 +230,14 @@ class ServeGateway:
     :meth:`stats` (live per-connection ledgers) and :meth:`totals` (the
     cumulative ledger, retired connections included — two draining
     gateways' ``totals()["rows"]`` sum to the rows the fleet served).
+
+    The telemetry plane (PR 12): METRICS/HEALTH wire kinds answer the LIVE
+    Prometheus exposition (:meth:`metrics_text`) and the JSON health
+    document (:meth:`health_report` — which also dumps the armed flight
+    recorder, the doctor hook); trace-stamped frames (``FLAG_TRACE``)
+    leave decode/encode segment spans here and queue/dispatch/resolve
+    spans in the batcher, all under the producer's trace id, with the
+    compact server-timing block returned in the reply's trace extension.
     """
 
     def __init__(self, host, *, addr: str = "127.0.0.1", port: int = 0,
@@ -274,6 +284,17 @@ class ServeGateway:
         # poll fine enough that a stall is caught soon after its deadline
         self._poll_s = (0.25 if self.frame_deadline_s is None
                         else min(0.25, max(0.005, self.frame_deadline_s / 5)))
+        # pre-intern the core serve series into the host registry so a
+        # LIVE scrape (METRICS wire kind / --metrics-port) always carries
+        # them — a fresh gateway's exposition must be probe-able
+        # (`orp doctor --metrics`) before the first frame arrives
+        reg = host.registry
+        reg.counter("serve/gateway_rows")
+        reg.counter("guard/shed")
+        # labelled like the batcher's real observations (obs_observe with
+        # outcome="served") — an unlabeled twin would shadow the live
+        # series in label-free quantile lookups (`orp top`)
+        reg.histogram("serve/queue_age_seconds", {"outcome": "served"})
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((addr, int(port)))
@@ -326,6 +347,8 @@ class ServeGateway:
                     # handler — the stream offset is garbage past the tear
                     stats["errors"] += 1
                     obs_count("serve/gateway_errors", stage="stall")
+                    flight.record("wire_error", stage="stall",
+                                  peer=stats.get("peer"))
                     self._send_on(st, wire.encode_error(str(e)))
                     return
                 except wire.WireError as e:
@@ -333,6 +356,8 @@ class ServeGateway:
                     # oversized length prefix the stream offset is garbage
                     stats["errors"] += 1
                     obs_count("serve/gateway_errors", stage="transport")
+                    flight.record("wire_error", stage="transport",
+                                  peer=stats.get("peer"))
                     self._send_on(st, wire.encode_error(str(e)))
                     return
                 if frame is None:
@@ -382,6 +407,20 @@ class ServeGateway:
         obs_count("serve/gateway_frames", kind=str(kind), sink_event=False)
         if kind == wire.KIND_PING:
             return self._send_on(st, wire.encode_pong())
+        if kind == wire.KIND_METRICS:
+            # the live scrape — answered even mid-drain: a draining
+            # gateway's telemetry is exactly what an operator watches
+            return self._send_on(st, wire.encode_metrics(
+                self.metrics_text()))
+        if kind == wire.KIND_HEALTH:
+            try:
+                ask = wire.decode_health(frame)
+            except wire.WireError as e:
+                st.stats["errors"] += 1
+                obs_count("serve/gateway_errors", stage="decode")
+                return self._send_on(st, wire.encode_error(str(e)))
+            return self._send_on(st, wire.encode_health(self.health_report(
+                dump_flight=bool(ask.get("dump_flight")))))
         if kind == wire.KIND_HELLO:
             return self._handle_hello(frame, st)
         if kind != wire.KIND_REQUEST:
@@ -455,12 +494,19 @@ class ServeGateway:
         # decode BEFORE the window check: a fresh frame must be CLAIMED
         # (pending entry installed) inside the same lock hold that
         # classified it, and the claim needs the decoded date
+        t0 = time.perf_counter()
         try:
             req = wire.decode_request(frame)
         except wire.WireError as e:
             st.stats["errors"] += 1
             obs_count("serve/gateway_errors", stage="decode")
+            flight.record("wire_error", stage="decode", seq=seq)
             return self._send_on(st, wire.encode_error(str(e), seq=seq))
+        trace = req["trace"]
+        # decode wall captured now, EMITTED only for a FRESH frame (below):
+        # a replayed or BUSY-resent frame decodes again but must not
+        # duplicate its decode segment under the same trace id
+        decode_s = time.perf_counter() - t0
         tenant = req["tenant"] or self.default_tenant
         if tenant is None:
             st.stats["errors"] += 1
@@ -496,7 +542,7 @@ class ServeGateway:
                 else:
                     action = "fresh"
                     relay = SlimFuture()
-                    sess.pending[seq] = (relay, req["date_idx"])
+                    sess.pending[seq] = (relay, req["date_idx"], trace)
                     sess.last_seq = max(sess.last_seq, seq)
                     sess.frames += 1
         if action == "replay":
@@ -508,9 +554,10 @@ class ServeGateway:
                 return self._send_on(st, cached)
             # adopt the orphan: the frame was submitted on a connection
             # that died; its reply lands HERE when the block resolves
-            fut, date_idx = pending
+            fut, date_idx, a_trace = pending
             fut.add_done_callback(
-                lambda f: self._reply_ready(sess, seq, date_idx, st, f))
+                lambda f: self._reply_ready(sess, seq, date_idx, st, f,
+                                            trace=a_trace))
             return True
         if action == "evicted":
             st.stats["errors"] += 1
@@ -522,9 +569,14 @@ class ServeGateway:
         if action == "busy":
             # backpressure, not shedding: nothing was admitted, nothing died
             obs_count("serve/gateway_busy")
+            flight.record("busy", seq=seq)
             return self._send_on(st, wire.encode_busy(
                 seq, f"{self.max_inflight_replies} replies in flight on "
                      "this connection — wait for acks and resend"))
+        if trace is not None:
+            # the first serving-chain segment, once per ADMITTED frame
+            emit_trace_span("trace/decode", trace[0], trace[1], decode_s,
+                            attrs={"bytes": len(frame), "seq": seq})
         return self._submit_v2(req, seq, relay, sess, st)
 
     def _submit_v2(self, req: dict, seq: int, relay, sess: _Session,
@@ -533,14 +585,15 @@ class ServeGateway:
         the session's pending window (adoptable by replays), the host's
         block future chains into it."""
         date_idx = req["date_idx"]
+        trace = req["trace"]
         relay.add_done_callback(
             lambda f: self._reply_ready(sess, seq, date_idx, st, f,
-                                        claimer=True))
+                                        claimer=True, trace=trace))
         tenant = req["tenant"] or self.default_tenant
         try:
             fut = self.host.submit_block(tenant, date_idx,
                                          req["states"], req["prices"],
-                                         req["deadlines"])
+                                         req["deadlines"], trace=trace)
         except Exception as e:  # orp: noqa[ORP009] -- emitted: _reply_ready counts it AND ships it as an ERROR frame
             relay.set_exception(e)
             return True
@@ -563,7 +616,8 @@ class ServeGateway:
         return True
 
     def _reply_ready(self, sess: _Session, seq: int, date_idx: int,
-                     st: _Conn, fut, claimer: bool = False) -> None:
+                     st: _Conn, fut, claimer: bool = False,
+                     trace=None) -> None:
         """Done-callback of a sequenced block future: encode the reply ONCE
         into the session's cache, then hand it to ``st``'s writer thread (a
         dead connection just leaves it cached for the replay). Runs on the
@@ -577,13 +631,14 @@ class ServeGateway:
         with self._lock:
             self._replying += 1
         try:
-            self._reply_ready_inner(sess, seq, date_idx, st, fut, claimer)
+            self._reply_ready_inner(sess, seq, date_idx, st, fut, claimer,
+                                    trace)
         finally:
             with self._lock:
                 self._replying -= 1
 
     def _reply_ready_inner(self, sess: _Session, seq: int, date_idx: int,
-                           st: _Conn, fut, claimer: bool) -> None:
+                           st: _Conn, fut, claimer: bool, trace) -> None:
         err = fut.exception()
         if err is not None:
             reply = wire.encode_error(f"{type(err).__name__}: {err}",
@@ -591,8 +646,23 @@ class ServeGateway:
             n = 0
         else:
             result: BlockResult = fut.result()
-            reply = wire.encode_reply(result, date_idx=date_idx, seq=seq)
+            t0 = time.perf_counter()
+            timing = None
+            if trace is not None and result.timing is not None:
+                # the compact server-timing block rides the reply's trace
+                # extension back to the producer
+                timing = (trace[0], *result.timing)
+            reply = wire.encode_reply(result, date_idx=date_idx, seq=seq,
+                                      timing=timing)
             n = result.n_rows
+            if trace is not None and claimer:
+                # the last serving-chain segment: reply encode wall. Only
+                # the CLAIMER's callback emits it — an adopting replay's
+                # racing callback re-encodes the same frame and would
+                # duplicate the segment in the trace
+                emit_trace_span("trace/encode", trace[0], trace[1],
+                                time.perf_counter() - t0,
+                                attrs={"rows": n, "seq": seq})
         with sess.lock:
             first = seq in sess.pending
             if first:
@@ -637,12 +707,19 @@ class ServeGateway:
         dedup — a v1 producer that loses its connection cannot know which
         rows landed (exactly the gap the v2 handshake closes)."""
         stats = st.stats
+        t0 = time.perf_counter()
         try:
             req = wire.decode_request(frame)
         except wire.WireError as e:
             stats["errors"] += 1
             obs_count("serve/gateway_errors", stage="decode")
+            flight.record("wire_error", stage="decode")
             return self._send_on(st, wire.encode_error(str(e)))
+        trace = req["trace"]
+        if trace is not None:
+            emit_trace_span("trace/decode", trace[0], trace[1],
+                            time.perf_counter() - t0,
+                            attrs={"bytes": len(frame)})
         tenant = req["tenant"] or self.default_tenant
         if tenant is None:
             stats["errors"] += 1
@@ -653,7 +730,7 @@ class ServeGateway:
         try:
             fut = self.host.submit_block(tenant, req["date_idx"],
                                          req["states"], req["prices"],
-                                         req["deadlines"])
+                                         req["deadlines"], trace=trace)
             with self._lock:
                 self._submitted_frames += 1
             result: BlockResult = fut.result(timeout=self.reply_timeout_s)
@@ -665,8 +742,15 @@ class ServeGateway:
         n = result.n_rows
         stats["rows"] += n
         obs_count("serve/gateway_rows", n, sink_event=False)
-        return self._send_on(st, wire.encode_reply(result,
-                                                   date_idx=req["date_idx"]))
+        t0 = time.perf_counter()
+        timing = (None if trace is None or result.timing is None
+                  else (trace[0], *result.timing))
+        reply = wire.encode_reply(result, date_idx=req["date_idx"],
+                                  timing=timing)
+        if trace is not None:
+            emit_trace_span("trace/encode", trace[0], trace[1],
+                            time.perf_counter() - t0, attrs={"rows": n})
+        return self._send_on(st, reply)
 
     def _send_on(self, st: _Conn, frame: bytes) -> bool:
         """One frame onto the wire from the HANDLER thread (pongs, errors,
@@ -698,6 +782,7 @@ class ServeGateway:
             return True
         except OSError:
             obs_count("serve/gateway_errors", stage="send")
+            flight.record("wire_error", stage="send")
             st.dead = True
             try:
                 st.sock.close()
@@ -743,6 +828,46 @@ class ServeGateway:
                     self._replying -= 1
 
     # -- introspection / lifecycle -------------------------------------------
+
+    def metrics_text(self) -> str:
+        """The live Prometheus exposition this process can honestly serve:
+        the host registry (tenant serving series + the pre-interned core
+        gateway series) plus, when an obs session is active with a DIFFERENT
+        registry, that one too. This is what the METRICS wire kind and the
+        ``--metrics-port`` HTTP endpoint both answer — ``metrics.prom``
+        from the LIVE process, no clean exit required."""
+        regs = [self.host.registry]
+        st = obs_state()
+        if st is not None and st.registry is not regs[0]:
+            regs.append(st.registry)
+        return "".join(prometheus_text(r) for r in regs)
+
+    def health_report(self, *, dump_flight: bool = False) -> dict:
+        """Compact JSON health document (the HEALTH wire kind): draining
+        flag, session count, cumulative ledgers, per-tenant pending, and
+        the flight-ring state. ``dump_flight=True`` (a HEALTH request with
+        ``{"dump_flight": true}`` — what ``orp doctor --metrics`` sends)
+        additionally DUMPS the flight ring when the recorder is armed: a
+        probe against a sick gateway leaves the evidence on disk. A plain
+        probe (``orp top``'s per-refresh HEALTH) never writes — a
+        read-only dashboard must not cause disk I/O in the serving
+        process."""
+        dump = flight.RECORDER.dump() if dump_flight else None
+        with self._lock:
+            sessions = len(self._sessions)
+        tenants = {
+            name: {k: s[k] for k in ("live", "pending", "version")}
+            for name, s in self.host.stats().items()
+        }
+        return {
+            "draining": self._draining.is_set(),
+            "aborted": self.aborted.is_set(),
+            "sessions": sessions,
+            "totals": self.totals(),
+            "tenants": tenants,
+            "flight_recorded": flight.RECORDER.recorded,
+            "flight_dump": None if dump is None else str(dump),
+        }
 
     def stats(self) -> dict:
         """Live per-connection ledgers: ``{conn_id: {peer, frames, rows,
@@ -865,12 +990,18 @@ class GatewayClient:
 
     def submit_block(self, tenant: str, date_idx: int, states, prices=None,
                      deadlines=None, *,
-                     deadline_ms: float | None = None) -> BlockResult:
+                     deadline_ms: float | None = None,
+                     trace=None) -> BlockResult:
         """Ship one block and block on its columnar reply. Raises
         :class:`GatewayError` with the server's flag-speak message when the
-        server refused the frame (or the serve itself failed)."""
+        server refused the frame (or the serve itself failed). ``trace``:
+        an optional ``(trace_id, parent_span)`` pair (``obs.new_trace()``)
+        stamped into the frame — the serving process links its segment
+        spans under it and the returned :class:`BlockResult` carries the
+        server-timing pair in ``timing``."""
         frame = wire.encode_request(tenant, date_idx, states, prices,
-                                    deadlines, deadline_ms=deadline_ms)
+                                    deadlines, deadline_ms=deadline_ms,
+                                    trace=trace)
         reply = self._roundtrip(frame)
         if wire.decode_kind(reply) == wire.KIND_ERROR:
             raise GatewayError(wire.decode_error(reply))
@@ -880,6 +1011,26 @@ class GatewayClient:
         """One PING round trip — the doctor probe's liveness check."""
         reply = self._roundtrip(wire.encode_ping())
         return wire.decode_kind(reply) == wire.KIND_PONG
+
+    def metrics(self) -> str:
+        """Scrape the gateway's LIVE Prometheus exposition over the wire
+        (the METRICS kind) — what ``orp top`` and ``orp doctor --metrics``
+        read."""
+        reply = self._roundtrip(wire.encode_metrics())
+        if wire.decode_kind(reply) == wire.KIND_ERROR:
+            raise GatewayError(wire.decode_error(reply))
+        return wire.decode_metrics(reply)
+
+    def health(self, *, dump_flight: bool = False) -> dict:
+        """One HEALTH round trip: the gateway's JSON health document
+        (draining flag, ledgers, per-tenant pending). ``dump_flight=True``
+        asks the serving process to dump its flight recorder (when armed)
+        — the doctor's black-box hook; plain probes never cause writes."""
+        reply = self._roundtrip(wire.encode_health(
+            {"dump_flight": True} if dump_flight else None))
+        if wire.decode_kind(reply) == wire.KIND_ERROR:
+            raise GatewayError(wire.decode_error(reply))
+        return wire.decode_health(reply)
 
     def _roundtrip(self, frame: bytes) -> bytes:
         with self._lock:

@@ -32,6 +32,7 @@ from concurrent.futures import TimeoutError as _FutureTimeoutError
 
 from orp_tpu.guard.serve import WatchdogTrip
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 
 
 class _BlockWorker:
@@ -121,6 +122,9 @@ class DispatchWatchdog:
                     self._worker = None
             w.abandon()
             obs_count("guard/watchdog_trip", key=str(tag))
+            flight.record("watchdog_trip", tag=str(tag),
+                          hard_wall_ms=self.hard_wall_s * 1e3,
+                          trips=self.trips)
             if self.on_trip is not None:
                 self.on_trip(tag)
             raise WatchdogTrip(
@@ -165,7 +169,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
-                  telemetry_dir=None, gateway=None,
+                  telemetry_dir=None, gateway=None, metrics=None,
                   gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
@@ -183,7 +187,12 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     ``gateway``     — optionally probe a running ingest gateway
     (``"host:port"``): one TCP connect + ``orp-ingest`` PING/PONG round
     trip, the liveness check for a ``orp serve-gateway`` front.
-    ``gateway_timeout_s`` bounds the probe's connect AND every recv — a
+    ``metrics``     — optionally probe the LIVE scrape of a gateway
+    (``"host:port"``, the METRICS wire kind): the exposition must parse
+    and carry the core serve series (request/latency, queue age, sheds) —
+    a gateway that serves traffic but cannot be observed is a failing
+    check, fixed in flag-speak.
+    ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
     block.
@@ -287,4 +296,46 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                        "DIR --port N` (or fix the host:port); a connect "
                        "that hangs past the timeout is a dead-but-accepting "
                        "endpoint — restart it")
+    # 7) live metrics scrape: the exposition must parse AND carry the core
+    # serve series — an unobservable gateway fails its fleet (no health
+    # signal to drive REDIRECTs on), even while it serves
+    if metrics is not None:
+        from orp_tpu.serve.gateway import GatewayClient
+        from orp_tpu.serve.scrape import parse_prometheus
+
+        core = ("serve_gateway_rows", "serve_queue_age_seconds",
+                "guard_shed")
+        addr, _, port = str(metrics).rpartition(":")
+        try:
+            with GatewayClient(addr or "127.0.0.1", int(port),
+                               timeout_s=float(gateway_timeout_s)) as client:
+                text = client.metrics()
+                # the HEALTH probe rides along and EXPLICITLY requests the
+                # serving process's flight-recorder dump (when armed) — a
+                # doctor visit leaves the black box on disk; plain health
+                # probes (orp top) never write
+                health = client.health(dump_flight=True)
+            series = parse_prometheus(text)
+            missing = [n for n in core if n not in series]
+            flight_note = (
+                f"; flight ring {health.get('flight_recorded', 0)} event(s)"
+                + (f" dumped to {health['flight_dump']}"
+                   if health.get("flight_dump") else ""))
+            _check(checks, "metrics", not missing,
+                   (f"{metrics}: {len(series)} series, core present"
+                    f"{flight_note}"
+                    if not missing else
+                    f"{metrics}: exposition parsed but lacks core serve "
+                    f"series {missing}"),
+                   fix="the endpoint answers METRICS frames but not with "
+                       "the serve exposition — upgrade the gateway (`orp "
+                       "serve-gateway` from this build pre-interns the "
+                       "core series)")
+        except (OSError, ValueError, RuntimeError) as e:
+            _check(checks, "metrics", False,
+                   f"{metrics}: {type(e).__name__}: {e}"
+                   if not str(e) else f"{metrics}: {e}",
+                   fix="no live scrape at that address — probe the ingest "
+                       "port of a running `orp serve-gateway` (the METRICS "
+                       "wire kind shares it), or fix host:port")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}

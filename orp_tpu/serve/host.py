@@ -47,6 +47,7 @@ import numpy as np
 from orp_tpu.guard import inject as _inject
 from orp_tpu.guard.serve import GuardPolicy, Rejection
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import flight
 from orp_tpu.obs import state as obs_state
 from orp_tpu.obs.registry import Registry
 from orp_tpu.serve.batcher import MicroBatcher, SlimFuture
@@ -336,7 +337,7 @@ class ServeHost:
             self._release_claim(t)
 
     def submit_block(self, tenant: str, date_idx: int, states, prices=None,
-                     deadlines=None):
+                     deadlines=None, *, trace=None):
         """Columnar ingest lane through the host: one
         :meth:`~orp_tpu.serve.batcher.MicroBatcher.submit_block` per block,
         ONE future, quota counted in ROWS against the tenant's
@@ -345,7 +346,10 @@ class ServeHost:
         the returned :class:`~orp_tpu.serve.ingest.BlockResult`, zero queue
         age, never a per-row ``Rejection`` — and only the head rows consume
         batcher capacity. (The per-request lane counts the same budget in
-        requests; a mixed tenant's ``pending`` is requests + block rows.)"""
+        requests; a mixed tenant's ``pending`` is requests + block rows.)
+        ``trace`` is the optional distributed-trace context, passed through
+        to the batcher untouched (a quota-split block's admitted head
+        carries it; the merged result keeps its server timing)."""
         from orp_tpu.serve.ingest import (SHED_QUOTA, all_shed_result,
                                           merge_tail_shed)
 
@@ -376,7 +380,7 @@ class ServeHost:
             try:
                 inner = batcher.submit_block(
                     date_idx, feats[:keep],
-                    None if pr is None else pr[:keep], dl)
+                    None if pr is None else pr[:keep], dl, trace=trace)
             except BaseException:
                 self._rows_done(t, keep)  # reserved rows, never enqueued
                 raise
@@ -495,6 +499,7 @@ class ServeHost:
                 policy = load_bundle(policy)
             except (ValueError, OSError) as e:
                 obs_count("guard/canary_reject", tenant=name, stage="load")
+                flight.record("canary_reject", tenant=name, stage="load")
                 raise CanaryRejected(
                     f"tenant {name!r}: candidate bundle failed to load "
                     f"({e}); serving is untouched") from e
@@ -571,6 +576,7 @@ class ServeHost:
 
     def _canary_reject(self, name: str, why: str):
         obs_count("guard/canary_reject", tenant=name, stage="bits")
+        flight.record("canary_reject", tenant=name, stage="bits", why=why)
         warnings.warn(
             f"hot reload of tenant {name!r} REJECTED by the canary gate "
             f"({why}); the tenant keeps serving the previous bundle",
@@ -614,7 +620,7 @@ class ServeHost:
             slo = t.slo if t.slo is not None else default
             if slo is None:
                 continue
-            hist = self.registry.histogram(LATENCY_HISTOGRAM,
+            hist = self.registry.histogram(LATENCY_HISTOGRAM,  # orp: noqa[ORP015] -- slo_report is an operator read path: this interns an EXISTING per-tenant series (a dict lookup), not hot-path churn
                                            {"tenant": t.name})
             rate = burn_rate(hist, slo)
             out[t.name] = {

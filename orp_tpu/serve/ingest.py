@@ -36,6 +36,7 @@ import time
 import numpy as np
 
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import emit_trace_spans, flight
 from orp_tpu.obs import observe as obs_observe
 
 # per-row status codes (the BlockResult.status column / the wire's status
@@ -66,13 +67,17 @@ class BlockResult:
 
     ``phi``/``psi``: ``(n,)`` hedge ratios; ``value``: ``(n,)`` portfolio
     values or None when the block carried no prices; ``status``: ``(n,)``
-    uint8 of status codes (:data:`STATUS_NAMES`).
+    uint8 of status codes (:data:`STATUS_NAMES`); ``timing``: the compact
+    server-timing block of a TRACED block — ``(queue_age_s, dispatch_s)``,
+    None on every untraced path (the wire carries it back to the producer
+    as the reply's 16-byte trace extension).
     """
 
     phi: np.ndarray
     psi: np.ndarray
     value: np.ndarray | None
     status: np.ndarray
+    timing: tuple[float, float] | None = None
 
     @property
     def n_rows(self) -> int:
@@ -139,6 +144,7 @@ def merge_tail_shed(head: BlockResult, n_tail: int, code: int) -> BlockResult:
         value=(None if head.value is None
                else np.concatenate([head.value, tail.value])),
         status=np.concatenate([head.status, tail.status]),
+        timing=head.timing,
     )
 
 
@@ -157,10 +163,11 @@ class Block:
     """
 
     __slots__ = ("date_idx", "features", "prices", "future", "submitted_at",
-                 "deadlines", "status", "n")
+                 "deadlines", "status", "n", "trace", "t_admit",
+                 "t_dispatch")
 
     def __init__(self, date_idx: int, features, prices, future,
-                 submitted_at: float, deadlines):
+                 submitted_at: float, deadlines, trace=None):
         self.date_idx = int(date_idx)
         self.features = features            # (n, n_features), contiguous
         self.prices = prices                # (n, k) or None
@@ -169,6 +176,13 @@ class Block:
         self.deadlines = deadlines          # (n,) float64 absolute, or None
         self.n = int(features.shape[0])
         self.status = np.zeros(self.n, np.uint8)
+        # distributed-trace context: (trace_id, parent_span) stamped by the
+        # producer and carried through the batcher so the admit/dispatch/
+        # resolve instants can be attributed. None (the untraced default)
+        # keeps every stamp behind ONE `is not None` test per block
+        self.trace = trace
+        self.t_admit = None
+        self.t_dispatch = None
 
     @property
     def n_live(self) -> int:
@@ -216,6 +230,8 @@ class Block:
                   lane="block")
         obs_observe("serve/queue_age_seconds",
                     time.perf_counter() - self.submitted_at, outcome="shed")
+        flight.record("shed", reason=_SHED_REASON[code], rows=int(n_rows),
+                      lane="block")
 
     def resolve_shed_only(self) -> None:
         """Resolve a block none of whose rows survived to dispatch (all
@@ -231,13 +247,37 @@ class Block:
                 status=self.status,
             ))
 
-    def resolve_served(self, phi, psi, value) -> None:
+    def trace_report(self, done: float) -> tuple[float, float]:
+        """TRACED blocks only: emit the queue/dispatch/resolve trace spans
+        (``obs.emit_trace_span`` — no-ops without a sink) and return the
+        compact server-timing block ``(queue_age_s, dispatch_s)`` the
+        reply's trace extension carries back to the producer. The segment
+        walls are the batcher's own instants: submit → admit is the queue,
+        admit → device submit is the dispatch stage, device submit →
+        device-complete is the resolve (the stage whose job is to block)."""
+        tid, parent = self.trace
+        t_admit = self.t_admit if self.t_admit is not None \
+            else self.submitted_at
+        t_disp = self.t_dispatch if self.t_dispatch is not None else t_admit
+        queue_s = max(0.0, t_admit - self.submitted_at)
+        dispatch_s = max(0.0, done - t_disp)
+        # ONE sink burst for the whole frame: the per-frame tracing budget
+        # (BENCH_serve trace_overhead gate) is paid right here
+        emit_trace_spans(tid, parent, (
+            ("trace/queue", queue_s),
+            ("trace/dispatch", max(0.0, t_disp - t_admit)),
+            ("trace/resolve", dispatch_s),
+        ))
+        return (queue_s, dispatch_s)
+
+    def resolve_served(self, phi, psi, value, timing=None) -> None:
         """Scatter the dispatched (live-row) results back into full-size
         columns and resolve the block's one future. The nothing-shed fast
-        path hands the engine's arrays through untouched."""
+        path hands the engine's arrays through untouched. ``timing`` is the
+        traced block's server-timing pair (None untraced)."""
         if self.n_live == self.n:
             out = BlockResult(phi=phi, psi=psi, value=value,
-                              status=self.status)
+                              status=self.status, timing=timing)
         else:
             live = self.status == SERVED
             full_phi = np.zeros(self.n, phi.dtype)
@@ -249,7 +289,7 @@ class Block:
                 full_value = np.zeros(self.n, value.dtype)
                 full_value[live] = value
             out = BlockResult(phi=full_phi, psi=full_psi, value=full_value,
-                              status=self.status)
+                              status=self.status, timing=timing)
         if self.future.set_running_or_notify_cancel():
             self.future.set_result(out)
 

@@ -13,10 +13,13 @@ v1 frame layout (all little-endian, no padding)::
     magic      4s   b"ORPI"
     version    u1   1 or 2
     kind       u1   REQUEST | REPLY | ERROR | PING | PONG
-                    | HELLO | WELCOME | BUSY | REDIRECT (v2)
+                    | HELLO | WELCOME | BUSY | REDIRECT
+                    | METRICS | HEALTH (v2)
     dtype_tag  u1   1 = float32 value columns
-    flags      u1   REQUEST: bit0 prices, bit1 per-row deadlines
-                    REPLY:   bit0 value column present
+    flags      u1   REQUEST: bit0 prices, bit1 per-row deadlines,
+                             bit2 trace context present
+                    REPLY:   bit0 value column present,
+                             bit2 server-timing block present
     tenant     16s  NUL-padded ASCII tenant name (REQUEST; else zeros)
     date_idx   i4
     n_rows     u4
@@ -31,13 +34,26 @@ A **v2** header is the v1 header plus a 16-byte delivery extension::
                     BUSY/REDIRECT: the seq of the frame being refused)
     reserved   u8   zero
 
-followed by the payload columns, in order:
+followed by the payload, in order. With flag bit2 set (either direction) a
+16-byte **trace extension** sits FIRST, between header and columns —
+REQUEST: ``<u8 trace_id, u8 parent_span>`` (the Dapper context the
+producer stamps; ``obs.new_trace()``); REPLY: ``<u8 trace_id,
+f4 queue_age_s, f4 dispatch_s>`` (the compact server-timing block).
+Flag-gated so an untraced frame — every v1 frame, every seq-only v2
+frame — stays byte-identical to the pre-trace wire. Then:
 
 - REQUEST: features ``f4[n_rows, n_features]``, prices ``f4[n_rows,
   n_prices]`` (flag bit0), deadlines ``f8[n_rows]`` (flag bit1 —
   per-row budgets in SECONDS, overriding ``deadline_ms``);
 - REPLY: status ``u1[n_rows]``, phi ``f4[n_rows]``, psi ``f4[n_rows]``,
   value ``f4[n_rows]`` (flag bit0);
+- METRICS: empty = a live-scrape request; else the UTF-8 Prometheus text
+  exposition of the serving process's registry;
+- HEALTH: empty (or a JSON options object — ``{"dump_flight": true}``
+  additionally dumps the gateway's armed flight recorder, the doctor
+  hook; a plain probe never writes) = a request; the answer is a JSON
+  health document (draining flag, session count, ledgers, flight-ring
+  state);
 - ERROR: the UTF-8 message (flag-speak: it names the field to fix);
 - PING/PONG: empty;
 - HELLO: the 16-byte session token to RESUME (empty = new session);
@@ -64,6 +80,9 @@ gateway; this module sees complete frame buffers.
 
 from __future__ import annotations
 
+import json
+import struct
+
 import numpy as np
 
 from orp_tpu.serve.ingest import BlockResult
@@ -82,13 +101,17 @@ KIND_HELLO = 6
 KIND_WELCOME = 7
 KIND_BUSY = 8
 KIND_REDIRECT = 9
+KIND_METRICS = 10
+KIND_HEALTH = 11
 
 _KIND_NAMES = {KIND_REQUEST: "request", KIND_REPLY: "reply",
                KIND_ERROR: "error", KIND_PING: "ping", KIND_PONG: "pong",
                KIND_HELLO: "hello", KIND_WELCOME: "welcome",
-               KIND_BUSY: "busy", KIND_REDIRECT: "redirect"}
+               KIND_BUSY: "busy", KIND_REDIRECT: "redirect",
+               KIND_METRICS: "metrics", KIND_HEALTH: "health"}
 #: kinds that exist only in the v2 protocol (always seq-bearing frames)
-_V2_KINDS = frozenset({KIND_HELLO, KIND_WELCOME, KIND_BUSY, KIND_REDIRECT})
+_V2_KINDS = frozenset({KIND_HELLO, KIND_WELCOME, KIND_BUSY, KIND_REDIRECT,
+                       KIND_METRICS, KIND_HEALTH})
 
 DTYPE_F32 = 1
 _DTYPES = {DTYPE_F32: np.dtype("<f4")}
@@ -96,6 +119,17 @@ _DTYPES = {DTYPE_F32: np.dtype("<f4")}
 FLAG_PRICES = 1     # request: a prices column follows the features
 FLAG_DEADLINES = 2  # request: a per-row f8 deadline column closes the frame
 FLAG_VALUE = 1      # reply: the value column is present
+#: bit 2, both directions: a 16-byte trace extension sits between the
+#: header and the payload columns. REQUEST: ``<u8 trace_id, u8 parent_span>``
+#: (the Dapper context the producer stamps). REPLY: ``<u8 trace_id,
+#: f4 queue_age_s, f4 dispatch_s>`` — the compact server-timing block the
+#: gateway returns. Flag-gated: an untraced frame is BYTE-IDENTICAL to the
+#: pre-trace wire (v1 and seq-only v2 encodes unchanged).
+FLAG_TRACE = 4
+
+_TRACE_REQ = struct.Struct("<QQ")    # trace_id, parent_span
+_TRACE_REPLY = struct.Struct("<Qff")  # trace_id, queue_age_s, dispatch_s
+TRACE_BYTES = _TRACE_REQ.size         # 16, both directions
 
 TENANT_BYTES = 16
 #: session tokens are fixed-width like the tenant field: 16 ASCII bytes
@@ -167,18 +201,27 @@ def _header(kind: int, *, dtype_tag: int = DTYPE_F32, flags: int = 0,
 
 def encode_request(tenant: str, date_idx: int, states, prices=None,
                    deadlines=None, *, deadline_ms: float | None = None,
-                   seq: int | None = None) -> bytes:
+                   seq: int | None = None,
+                   trace: tuple[int, int] | None = None) -> bytes:
     """One request block as a frame: columns in, bytes out — no per-row
     work. ``deadlines`` (per-row budgets, seconds) ships as an f8 column;
     ``deadline_ms`` is the cheaper block-level budget when every row shares
     one. ``seq`` (v2): the per-connection frame id a handshaken producer
-    stamps — ``None`` emits a v1 frame, byte-identical to the old wire."""
+    stamps — ``None`` emits a v1 frame, byte-identical to the old wire.
+    ``trace``: an optional ``(trace_id, parent_span)`` pair of u64s
+    (``obs.new_trace()``) carried in-band as a 16-byte extension between
+    header and columns — the Dapper context the serving chain links its
+    spans under. ``None`` adds no bytes and no flag."""
     feats = np.ascontiguousarray(np.atleast_2d(np.asarray(states)),
                                  dtype="<f4")
     n, f = feats.shape
     parts = [feats.tobytes()]
     flags = 0
     n_prices = 0
+    if trace is not None:
+        flags |= FLAG_TRACE
+        parts.insert(0, _TRACE_REQ.pack(int(trace[0]) & (1 << 64) - 1,
+                                        int(trace[1]) & (1 << 64) - 1))
     if prices is not None:
         pr = np.ascontiguousarray(np.atleast_2d(np.asarray(prices)),
                                   dtype="<f4")
@@ -204,11 +247,15 @@ def encode_request(tenant: str, date_idx: int, states, prices=None,
 
 
 def encode_reply(result: BlockResult, *, date_idx: int = 0,
-                 seq: int | None = None) -> bytes:
+                 seq: int | None = None,
+                 timing: tuple[int, float, float] | None = None) -> bytes:
     """A BlockResult as a frame: the status column plus the contiguous
     phi/psi(/value) columns, straight ``tobytes``. ``seq`` echoes the
     request's frame id (v2) so a pipelining producer can ack out of
-    order."""
+    order. ``timing``: the compact server-timing block of a TRACED frame —
+    ``(trace_id, queue_age_s, dispatch_s)``, 16 bytes between header and
+    columns (flag-gated; ``None`` leaves the frame byte-identical to the
+    pre-trace wire)."""
     n = result.n_rows
     flags = FLAG_VALUE if result.value is not None else 0
     parts = [
@@ -218,6 +265,11 @@ def encode_reply(result: BlockResult, *, date_idx: int = 0,
     ]
     if result.value is not None:
         parts.append(np.ascontiguousarray(result.value, "<f4").tobytes())
+    if timing is not None:
+        flags |= FLAG_TRACE
+        parts.insert(0, _TRACE_REPLY.pack(int(timing[0]) & (1 << 64) - 1,
+                                          float(timing[1]),
+                                          float(timing[2])))
     head = _header(KIND_REPLY, flags=flags, date_idx=date_idx, n_rows=n,
                    seq=seq)
     return b"".join([head, *parts])
@@ -271,6 +323,24 @@ def encode_redirect(host: str, port: int, *, seq: int = 0) -> bytes:
     ``host:port`` (the successor gateway) and replay there."""
     return _header(KIND_REDIRECT, seq=int(seq)) + \
         f"{host}:{int(port)}".encode("utf-8")
+
+
+def encode_metrics(text: str = "") -> bytes:
+    """The live-scrape kind: an empty payload ASKS the gateway for its
+    metrics; the answer carries the Prometheus text exposition of the
+    serving process's registry — ``metrics.prom`` from the LIVE process,
+    no exit required."""
+    return _header(KIND_METRICS, seq=0) + text.encode("utf-8")
+
+
+def encode_health(payload: dict | None = None) -> bytes:
+    """The health kind: an empty payload ASKS; the answer is a compact
+    JSON health document (draining flag, session count, cumulative
+    ledgers, flight-ring state). A HEALTH request also triggers the
+    gateway's flight-recorder dump when one is armed — the ``orp doctor``
+    black-box hook."""
+    body = b"" if payload is None else json.dumps(payload).encode("utf-8")
+    return _header(KIND_HEALTH, seq=0) + body
 
 
 # -- decode -------------------------------------------------------------------
@@ -369,10 +439,16 @@ def decode_request(buf) -> dict:
         raise WireError(f"n_prices={k} without the prices flag — set flag "
                         "bit0 or zero the count")
     has_deadlines = bool(flags & FLAG_DEADLINES)
-    expected = (off0 + 4 * n * f + (4 * n * k if has_prices else 0)
+    has_trace = bool(flags & FLAG_TRACE)
+    expected = (off0 + (TRACE_BYTES if has_trace else 0) + 4 * n * f
+                + (4 * n * k if has_prices else 0)
                 + (8 * n if has_deadlines else 0))
     _expect(buf, expected, "request")
     off = off0
+    trace = None
+    if has_trace:
+        trace = _TRACE_REQ.unpack_from(buf, off)
+        off += TRACE_BYTES
     states = np.frombuffer(buf, dt, count=n * f, offset=off).reshape(n, f)
     off += 4 * n * f
     prices = None
@@ -400,6 +476,7 @@ def decode_request(buf) -> dict:
         "prices": prices,
         "deadlines": deadlines,
         "seq": int(h["seq"]) if off0 == HEADER_V2_BYTES else 0,
+        "trace": trace,
     }
 
 
@@ -416,8 +493,15 @@ def decode_reply(buf) -> BlockResult:
     if not 1 <= n <= MAX_ROWS:
         raise WireError(f"n_rows={n} outside [1, {MAX_ROWS}]")
     has_value = bool(int(h["flags"]) & FLAG_VALUE)
-    expected = off + n * (1 + 4 + 4 + (4 if has_value else 0))
+    has_trace = bool(int(h["flags"]) & FLAG_TRACE)
+    expected = (off + (TRACE_BYTES if has_trace else 0)
+                + n * (1 + 4 + 4 + (4 if has_value else 0)))
     _expect(buf, expected, "reply")
+    timing = None
+    if has_trace:
+        _tid, queue_s, dispatch_s = _TRACE_REPLY.unpack_from(buf, off)
+        timing = (float(queue_s), float(dispatch_s))
+        off += TRACE_BYTES
     status = np.frombuffer(buf, "u1", count=n, offset=off)
     off += n
     phi = np.frombuffer(buf, "<f4", count=n, offset=off)
@@ -426,7 +510,8 @@ def decode_reply(buf) -> BlockResult:
     off += 4 * n
     value = (np.frombuffer(buf, "<f4", count=n, offset=off)
              if has_value else None)
-    return BlockResult(phi=phi, psi=psi, value=value, status=status)
+    return BlockResult(phi=phi, psi=psi, value=value, status=status,
+                       timing=timing)
 
 
 def _payload(buf, kind: int, what: str) -> bytes:
@@ -473,6 +558,33 @@ def decode_busy(buf) -> tuple[int, str]:
         raise WireError(
             f"expected a busy frame, got {_KIND_NAMES[int(h['kind'])]}")
     return int(h["seq"]), bytes(buf[off:]).decode("utf-8", errors="replace")
+
+
+def decode_metrics(buf) -> str:
+    """The Prometheus text of a METRICS frame (empty = a scrape request)."""
+    return _payload(buf, KIND_METRICS, "metrics").decode("utf-8",
+                                                         errors="replace")
+
+
+def decode_health(buf) -> dict:
+    """The JSON health document of a HEALTH frame (``{}`` = a probe
+    request). A payload that does not parse as a JSON object refuses with
+    :class:`WireError` like every other malformation — never a raw
+    JSONDecodeError out of the codec (the fuzz contract)."""
+    body = _payload(buf, KIND_HEALTH, "health")
+    if not body:
+        return {}
+    try:
+        doc = json.loads(body.decode("utf-8", errors="replace"))
+    except json.JSONDecodeError:
+        raise WireError(
+            "health payload is not valid JSON — corrupt frame or a "
+            "non-orp endpoint") from None
+    if not isinstance(doc, dict):
+        raise WireError(
+            f"health payload decodes to {type(doc).__name__}, expected a "
+            "JSON object")
+    return doc
 
 
 def decode_redirect(buf) -> tuple[str, int, int]:

@@ -153,6 +153,65 @@ def test_wire_v2_sequenced_roundtrip_and_handshake_frames():
         wire.decode_kind(bytes(bad))
 
 
+def test_wire_trace_extension_roundtrip_and_byte_identity():
+    """The PR-12 trace extension: flag-gated 16 bytes between header and
+    columns. Untraced encodes — every v1 frame, every seq-only v2 frame —
+    are BYTE-IDENTICAL to the pre-trace wire (the flag is the only gate);
+    traced frames round-trip the (trace_id, parent_span) context and the
+    reply's compact server-timing block."""
+    feats = _rows(5, 2, seed=4)
+    # byte identity: trace=None adds nothing, sets no flag
+    assert wire.encode_request("t", 1, feats) == \
+        wire.encode_request("t", 1, feats, trace=None)
+    v2 = wire.encode_request("t", 1, feats, seq=7)
+    assert len(v2) == wire.HEADER_V2_BYTES + feats.nbytes
+    tid, parent = (1 << 63) | 0xFEED, 0x17
+    tr = wire.encode_request("t", 1, feats, seq=7, trace=(tid, parent))
+    assert len(tr) == len(v2) + wire.TRACE_BYTES
+    req = wire.decode_request(tr)
+    assert req["trace"] == (tid, parent) and req["seq"] == 7
+    np.testing.assert_array_equal(req["states"], feats)
+    assert wire.decode_request(v2)["trace"] is None
+    # v1 frames may carry trace too (GatewayClient is a v1 producer)
+    r1 = wire.decode_request(wire.encode_request("t", 1, feats,
+                                                 trace=(tid, parent)))
+    assert r1["trace"] == (tid, parent) and r1["seq"] == 0
+    # reply timing block
+    res = BlockResult(phi=feats[:, 0], psi=feats[:, 1], value=None,
+                      status=np.zeros(5, np.uint8))
+    plain = wire.encode_reply(res, date_idx=1, seq=7)
+    timed = wire.encode_reply(res, date_idx=1, seq=7,
+                              timing=(tid, 0.002, 0.011))
+    assert len(timed) == len(plain) + wire.TRACE_BYTES
+    out = wire.decode_reply(timed)
+    assert out.timing == pytest.approx((0.002, 0.011), rel=1e-6)
+    np.testing.assert_array_equal(out.phi, feats[:, 0])
+    assert wire.decode_reply(plain).timing is None
+    # a truncated trace extension refuses like any other malformation
+    with pytest.raises(wire.WireError, match="truncated|expected"):
+        wire.decode_request(tr[:-feats.nbytes - 8])
+
+
+def test_wire_metrics_and_health_kinds():
+    """The live-scrape kinds: METRICS round-trips the exposition text,
+    HEALTH round-trips a JSON document and refuses non-JSON payloads with
+    WireError (never a raw JSONDecodeError out of the codec)."""
+    assert wire.decode_metrics(wire.encode_metrics()) == ""
+    text = "# TYPE serve_rows_total counter\nserve_rows_total 42\n"
+    assert wire.decode_metrics(wire.encode_metrics(text)) == text
+    assert wire.decode_health(wire.encode_health()) == {}
+    doc = {"draining": False, "sessions": 3}
+    assert wire.decode_health(wire.encode_health(doc)) == doc
+    bad = wire.encode_health() + b"not json {"
+    with pytest.raises(wire.WireError, match="JSON"):
+        wire.decode_health(bad)
+    # both are v2-only kinds: a v1-stamped METRICS frame is refused
+    raw = bytearray(wire.encode_metrics())
+    raw[4] = 1
+    with pytest.raises(wire.WireError, match="orp-ingest-v2"):
+        wire.decode_kind(bytes(raw))
+
+
 def _frame_corpus():
     """Valid v1 AND v2 frames of every kind — the fuzz seed set."""
     feats = _rows(6, 3, seed=21)
@@ -165,8 +224,15 @@ def _frame_corpus():
         wire.encode_request("desk", 2, feats, prices,
                             np.full(6, 0.5), deadline_ms=100.0),
         wire.encode_request("desk", 2, feats, seq=5),
+        # trace-carrying frames (both directions, v1 and sequenced v2):
+        # the PR-12 extension rides the same mutation gauntlet
+        wire.encode_request("desk", 2, feats, trace=(0xABCDEF, 7)),
+        wire.encode_request("desk", 2, feats, prices, np.full(6, 0.5),
+                            seq=5, trace=(1 << 63, 1)),
         wire.encode_reply(res, date_idx=2),
         wire.encode_reply(res, date_idx=2, seq=5),
+        wire.encode_reply(res, date_idx=2, seq=5,
+                          timing=(0xABCDEF, 0.002, 0.011)),
         wire.encode_error("a refusal"),
         wire.encode_error("a refusal", seq=5),
         wire.encode_ping(),
@@ -176,6 +242,12 @@ def _frame_corpus():
         wire.encode_welcome(tok, 9),
         wire.encode_busy(4, "slow"),
         wire.encode_redirect("127.0.0.1", 7000, seq=4),
+        # the live-scrape kinds: request and reply forms of each
+        wire.encode_metrics(),
+        wire.encode_metrics("# TYPE serve_rows_total counter\n"
+                            "serve_rows_total 42\n"),
+        wire.encode_health(),
+        wire.encode_health({"draining": False, "sessions": 2}),
     ]
 
 
@@ -197,6 +269,10 @@ def _decode_any(buf):
         wire.decode_busy(buf)
     elif kind == wire.KIND_REDIRECT:
         wire.decode_redirect(buf)
+    elif kind == wire.KIND_METRICS:
+        wire.decode_metrics(buf)
+    elif kind == wire.KIND_HEALTH:
+        wire.decode_health(buf)
 
 
 def test_wire_fuzz_mutated_frames_never_crash_or_hang():
@@ -280,7 +356,8 @@ def test_gateway_fuzz_mutated_frames_answered_within_deadline(trained):
                             assert wire.decode_kind(body) in (
                                 wire.KIND_ERROR, wire.KIND_REPLY,
                                 wire.KIND_PONG, wire.KIND_WELCOME,
-                                wire.KIND_BUSY)
+                                wire.KIND_BUSY, wire.KIND_METRICS,
+                                wire.KIND_HEALTH)
                 finally:
                     s.close()
             # the gateway survived the fuzz barrage: a clean client serves

@@ -26,7 +26,7 @@ def codes(src, **kw):
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
-                             "ORP014"})
+                             "ORP014", "ORP015"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -963,6 +963,109 @@ def test_orp014_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/gateway.py") == []
+
+
+# -- ORP015: obs instrument-name hygiene --------------------------------------
+
+ORP015_POS = """
+    from orp_tpu.obs import count as obs_count
+    from orp_tpu.obs import set_gauge as obs_set_gauge
+
+    def handle_frame(registry, kind):
+        # dynamic name: one new series PER kind value
+        obs_count(f"serve/frames_{kind}")
+        # bad literal shape: uppercase + dots are not the canonical form
+        obs_set_gauge("Serve.QueueDepth", 3)
+        # construction in a per-frame function under serve/
+        c = registry.counter("serve/frames")
+        c.inc()
+
+    def report(registry, tenants):
+        for t in tenants:
+            # construction in a loop under serve/
+            registry.histogram("serve/lat", {"tenant": t})
+"""
+
+ORP015_NEG = """
+    from orp_tpu.obs import count as obs_count
+
+    LATENCY = "serve/request_latency"
+
+    class Facade:
+        def __init__(self, registry):
+            # init-time interning with a module-constant name: sanctioned
+            self._hist = registry.histogram(LATENCY)
+            self._rows = registry.counter("serve/rows")
+
+        def record(self, v):
+            self._hist.observe(v)
+
+    def handle_frame(kind):
+        # static literal name, the dynamic part as a LABEL: the shape the
+        # rule exists to steer toward
+        obs_count("serve/gateway_frames", kind=str(kind))
+
+    def tally(xs):
+        # str.count is NOT an obs helper — no collision
+        return sum(x.count(",") for x in xs)
+"""
+
+
+def test_orp015_flags_dynamic_names_and_hot_construction():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP015_POS),
+                                       path="orp_tpu/serve/gateway.py")]
+    # f-string name, bad literal, per-frame construction, loop construction
+    assert got.count("ORP015") == 4
+
+
+def test_orp015_clean_negative():
+    assert lint_source(textwrap.dedent(ORP015_NEG),
+                       path="orp_tpu/serve/gateway.py") == []
+
+
+def test_orp015_name_shape_checked_everywhere_construction_only_in_hot_tree():
+    # the bad-literal check applies outside serve/train too...
+    bad_name = """
+        from orp_tpu.obs import count as obs_count
+
+        def note():
+            obs_count("Bad.Name")
+    """
+    got = [f.rule for f in lint_source(textwrap.dedent(bad_name),
+                                       path="orp_tpu/risk/surface.py")]
+    assert got == ["ORP015"]
+    # ...but loop/hot-fn CONSTRUCTION is scoped to serve/ and train/
+    loop_src = """
+        def report(registry, tenants):
+            for t in tenants:
+                registry.histogram("serve/lat", {"tenant": t})
+    """
+    assert lint_source(textwrap.dedent(loop_src),
+                       path="orp_tpu/risk/surface.py") == []
+    got = [f.rule for f in lint_source(textwrap.dedent(loop_src),
+                                       path="orp_tpu/train/backward.py")]
+    assert got == ["ORP015"]
+
+
+def test_orp015_exempts_obs_plumbing():
+    # the registry/spans modules forward caller-supplied names by design
+    src = """
+        def count(name, n=1):
+            _STATE.registry.counter(name).inc(n)
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/obs/spans.py") == []
+
+
+def test_orp015_noqa_suppresses():
+    src = """
+        from orp_tpu.obs import set_gauge as obs_set_gauge
+
+        def stamp(key, v):
+            obs_set_gauge(f"aot_{key}", v)  # orp: noqa[ORP015] -- bounded two-element key set
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/aot/compile.py") == []
 
 
 # -- suppressions ------------------------------------------------------------

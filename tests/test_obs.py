@@ -235,6 +235,74 @@ def test_span_stack_survives_exceptions():
     assert by_name["after"]["parent"] is None  # stack fully unwound
 
 
+# -- distributed-trace primitives --------------------------------------------
+
+
+def test_trace_ids_are_hex_strings_and_roundtrip():
+    # u64 ids must travel as 16-hex-digit strings: a u64 does not survive
+    # a float64 JSON number, and a rounded trace id is unfindable
+    tid, sid = obs.new_trace()
+    assert 1 <= tid < (1 << 64) and sid
+    h = obs.trace_hex(tid)
+    assert len(h) == 16 and int(h, 16) == tid
+    assert obs.parse_trace_id(h) == tid
+    assert obs.parse_trace_id(f"0x{h}") == tid
+    assert obs.parse_trace_id(tid) == tid
+    # span ids are process-unique and monotone within the process
+    a, b = obs.new_span_id(), obs.new_span_id()
+    assert a != b
+
+
+def test_emit_trace_spans_one_burst_one_stamp():
+    sink = ListSink()
+    with obs.active(sink=sink):
+        tid, sid = obs.new_trace()
+        obs.emit_trace_spans(tid, sid, (("trace/queue", 0.001),
+                                        ("trace/dispatch", 0.002),
+                                        ("trace/resolve", 0.003)))
+    assert len(sink.events) == 3
+    # one clock read for the burst (emit_many), seqs still unique/ordered
+    assert len({e["ts_unix"] for e in sink.events}) == 1
+    assert [e["seq"] for e in sink.events] == [0, 1, 2]
+    for e in sink.events:
+        assert obs.validate_event(e) == [], e
+        assert e["trace_id"] == obs.trace_hex(tid)
+        assert e["parent_span"] == obs.trace_hex(sid)
+    assert len({e["span_id"] for e in sink.events}) == 3
+    # zero-cost rule: disabled (or sinkless) emits return before any work
+    obs.disable()
+    obs.emit_trace_spans(1, 2, (("trace/queue", 0.001),))
+    assert obs.emit_trace_span("trace/decode", 1, 2, 0.001) is None
+
+
+def test_jsonl_sink_emit_many_matches_emit_contract(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with JsonlSink(path) as sink:
+        sink.emit({"type": "counter", "name": "a", "inc": 1, "labels": {}})
+        sink.emit_many([
+            {"type": "span", "name": "s1", "dur_s": 0.1, "parent": None,
+             "ok": True},
+            {"type": "span", "name": "s2", "dur_s": 0.2, "parent": None,
+             "ok": True},
+        ])
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [x["seq"] for x in lines] == [0, 1, 2]
+    for line in lines:
+        assert obs.validate_event(line) == []
+
+
+def test_suspended_detaches_and_restores_session():
+    sink = ListSink()
+    with obs.active(sink=sink) as st:
+        with obs.suspended():
+            assert not obs.enabled()
+            obs.count("x")              # the true disabled no-op
+        assert obs.state() is st        # restored, not re-created
+        obs.count("y", sink_event=False)
+        assert st.registry.counter("y").value == 1
+    assert not obs.enabled()
+
+
 # -- end-to-end emission contract (the tier-1 overhead-budget gate) ----------
 
 

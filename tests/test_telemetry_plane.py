@@ -1,0 +1,508 @@
+"""Fleet-grade telemetry plane (PR 12): wire-propagated distributed
+tracing, live scrape, and the guard flight recorder.
+
+The acceptance pins: one traced frame submitted through
+``ResilientGatewayClient`` against a live gateway reconstructs — via
+``orp trace <trace_id>`` over the gateway's ``events.jsonl`` — a span
+chain covering decode → queue → dispatch → resolve → encode whose segment
+walls sum to within the measured frame round trip; trace-carrying frames
+are bitwise-identical in served values to untraced ones; the live METRICS
+scrape (wire kind + HTTP sidecar) parses and carries the core serve
+series during a concurrent serve storm; and a killed-process-shaped exit
+still leaves its telemetry (periodic flush, flight-recorder dump)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from orp_tpu import obs
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.obs import flight, tracetree
+from orp_tpu.serve import (
+    GatewayClient,
+    HedgeEngine,
+    MetricsServer,
+    MicroBatcher,
+    ResilientGatewayClient,
+    ServeGateway,
+    ServeHost,
+    parse_prometheus,
+    top_snapshot,
+)
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Telemetry disabled and the flight ring empty on both sides of every
+    test — the plane is process-global state."""
+    obs.disable()
+    flight.RECORDER.reset()
+    flight.RECORDER.disarm()
+    yield
+    obs.disable()
+    flight.RECORDER.reset()
+    flight.RECORDER.disarm()
+
+
+def _rows(n, nf=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return (1.0 + 0.1 * rng.standard_normal((n, nf))).astype(np.float32)
+
+
+# -- distributed tracing ------------------------------------------------------
+
+
+SEGMENTS = {"trace/decode", "trace/queue", "trace/dispatch",
+            "trace/resolve", "trace/encode"}
+
+
+def test_traced_frame_reconstructs_span_chain_within_rtt(trained, tmp_path):
+    """THE tracing acceptance pin: a traced frame through a live gateway
+    leaves all five segments in events.jsonl under its trace id, their
+    walls sum to within the client-measured round trip, and the served
+    values are BITWISE what the untraced frame serves."""
+    feats = _rows(16, seed=3)
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        with ServeHost() as host:
+            host.add_tenant("desk", trained)
+            with ServeGateway(host, port=0, default_tenant="desk") as gw:
+                addr, port = gw.address
+                with ResilientGatewayClient(addr, port) as client:
+                    plain = client.submit_block("desk", 0, feats)
+                    assert plain.timing is None
+                    tid, sid = obs.new_trace()
+                    t0 = time.perf_counter()
+                    traced = client.submit_block("desk", 0, feats,
+                                                 trace=(tid, sid))
+                    rtt = time.perf_counter() - t0
+    # tracing never changes answers: bitwise across traced/untraced
+    np.testing.assert_array_equal(traced.phi, plain.phi)
+    np.testing.assert_array_equal(traced.psi, plain.psi)
+    np.testing.assert_array_equal(traced.status, plain.status)
+    # the server-timing block came back and is consistent
+    q_s, d_s = traced.timing
+    assert 0.0 <= q_s <= rtt and 0.0 <= d_s <= rtt
+    # reconstruction from the bundle (what `orp trace` reads)
+    spans, roots, summary = tracetree.load_trace(tmp_path,
+                                                 obs.trace_hex(tid))
+    assert {s["name"] for s in spans} == SEGMENTS
+    assert all(s["trace_id"] == obs.trace_hex(tid) for s in spans)
+    assert all(s["parent_span"] == obs.trace_hex(sid) for s in spans)
+    # segment walls are disjoint sub-intervals of the round trip
+    assert 0.0 < summary["sum_s"] <= rtt + 1e-3
+    # the untraced frame left NO trace spans
+    all_spans = [e for e in obs.read_events(tmp_path / "events.jsonl")
+                 if e.get("type") == "span" and "trace_id" in e]
+    assert {s["trace_id"] for s in all_spans} == {obs.trace_hex(tid)}
+
+
+def test_trace_cli_renders_tree_and_json(trained, tmp_path):
+    feats = _rows(4, seed=5)
+    tid, sid = obs.new_trace()
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        with ServeHost() as host:
+            host.add_tenant("d", trained)
+            with ServeGateway(host, port=0, default_tenant="d") as gw:
+                with GatewayClient(*gw.address) as client:
+                    client.submit_block("d", 0, feats, trace=(tid, sid))
+    from orp_tpu.cli import main as cli_main
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli_main(["trace", obs.trace_hex(tid), "--events", str(tmp_path),
+                  "--json"])
+    doc = json.loads(buf.getvalue().strip())
+    assert doc["spans"] == 5
+    assert set(doc["segments"]) == SEGMENTS
+    # human rendering mentions every segment once
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli_main(["trace", obs.trace_hex(tid), "--events", str(tmp_path)])
+    text = buf.getvalue()
+    for name in SEGMENTS:
+        assert name in text
+    # an unknown trace id fails in flag-speak, not a stack trace
+    with pytest.raises(SystemExit, match="no spans"):
+        cli_main(["trace", "00000000deadbeef", "--events", str(tmp_path)])
+
+
+def test_trace_reader_tolerates_torn_final_line(trained, tmp_path):
+    """A killed gateway dies mid-line in the live-streamed events.jsonl —
+    exactly when `orp trace` gets used. The viewer drops ONLY the torn
+    final line; corruption anywhere else still raises."""
+    feats = _rows(4, seed=5)
+    tid, sid = obs.new_trace()
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        with ServeHost() as host:
+            host.add_tenant("d", trained)
+            with ServeGateway(host, port=0, default_tenant="d") as gw:
+                with GatewayClient(*gw.address) as client:
+                    client.submit_block("d", 0, feats, trace=(tid, sid))
+    events_path = tmp_path / "events.jsonl"
+    with open(events_path, "a") as f:
+        f.write('{"type": "span", "name": "torn')  # the kill, mid-write
+    spans, _, summary = tracetree.load_trace(tmp_path, obs.trace_hex(tid))
+    assert {s["name"] for s in spans} == SEGMENTS
+    # mid-file corruption is a different animal: fail loudly
+    lines = events_path.read_text().splitlines()
+    lines[0] = '{"broken'
+    events_path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        tracetree.load_trace(tmp_path, obs.trace_hex(tid))
+
+
+def test_trace_survives_reconnect_replay(trained, tmp_path):
+    """A frame replayed after a torn send keeps its ORIGINAL trace context
+    (the replay buffer is the encoded bytes): the trace still reconstructs
+    and the result still carries server timing."""
+    from orp_tpu import guard
+
+    feats = _rows(8, seed=11)
+    tid, sid = obs.new_trace()
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        with ServeHost() as host:
+            host.add_tenant("d", trained)
+            with ServeGateway(host, port=0, default_tenant="d",
+                              frame_deadline_s=0.5) as gw:
+                addr, port = gw.address
+                with ResilientGatewayClient(addr, port) as client:
+                    plan = guard.FaultPlan(torn_send={"client/send": 1})
+                    with guard.faults(plan):
+                        res = client.submit_block("d", 0, feats,
+                                                  trace=(tid, sid),
+                                                  timeout_s=60.0)
+                    assert client.stats["reconnects"] >= 1
+                    assert res.timing is not None
+    spans, _, summary = tracetree.load_trace(tmp_path, obs.trace_hex(tid))
+    names = sorted(s["name"] for s in spans)
+    # each segment EXACTLY once — a replayed frame must not duplicate its
+    # decode (or any other) segment under the trace id
+    assert names == sorted(SEGMENTS)
+    # the reconnect landed in the flight ring
+    kinds = [e["kind"] for e in flight.RECORDER.snapshot()]
+    assert "reconnect" in kinds
+
+
+def test_batcher_trace_without_gateway(trained):
+    """The in-process lane: submit_block(trace=...) emits the queue/
+    dispatch/resolve segments and returns timing, with no wire involved —
+    and an untraced block alongside emits nothing."""
+    engine = HedgeEngine(trained)
+    feats = _rows(6, seed=9)
+    sink = obs.ListSink()
+    with obs.active(sink=sink):
+        with MicroBatcher(engine, max_wait_us=50_000.0) as mb:
+            tid, sid = obs.new_trace()
+            traced = mb.submit_block(0, feats, trace=(tid, sid))
+            plain = mb.submit_block(0, feats)
+            r_traced = traced.result(timeout=30)
+            r_plain = plain.result(timeout=30)
+    assert r_traced.timing is not None and r_plain.timing is None
+    np.testing.assert_array_equal(r_traced.phi, r_plain.phi)
+    names = [e["name"] for e in sink.events
+             if e.get("type") == "span" and "trace_id" in e]
+    assert sorted(names) == ["trace/dispatch", "trace/queue",
+                             "trace/resolve"]
+
+
+# -- live scrape --------------------------------------------------------------
+
+
+def test_metrics_wire_kind_and_doctor_probe(trained):
+    """The METRICS/HEALTH wire kinds answer from the LIVE process with the
+    core serve series pre-interned (scrapeable before the first frame),
+    and `orp doctor --metrics` validates exactly that."""
+    from orp_tpu.serve.health import doctor_report
+
+    with ServeHost() as host:
+        host.add_tenant("desk", trained)
+        with ServeGateway(host, port=0, default_tenant="desk") as gw:
+            addr, port = gw.address
+            with GatewayClient(addr, port) as client:
+                text = client.metrics()   # BEFORE any request frame
+                series = parse_prometheus(text)
+                for core in ("serve_gateway_rows",
+                             "serve_queue_age_seconds", "guard_shed"):
+                    assert core in series, core
+                client.submit_block("desk", 0, _rows(5))
+                text2 = client.metrics()
+                h = client.health()
+            assert h["draining"] is False and h["tenants"]["desk"]["live"]
+            s2 = parse_prometheus(text2)
+            assert s2["serve_requests_total"][0][1] >= 1
+            rep = doctor_report(metrics=f"{addr}:{port}",
+                                gateway_timeout_s=5.0)
+            row = [c for c in rep["checks"] if c["check"] == "metrics"][0]
+            assert row["ok"], row
+    # against a dead port the probe fails in flag-speak within the budget
+    rep = doctor_report(metrics=f"{addr}:{port}", gateway_timeout_s=1.0)
+    row = [c for c in rep["checks"] if c["check"] == "metrics"][0]
+    assert not row["ok"] and "fix" in row
+
+
+def test_metrics_http_sidecar(trained):
+    with ServeHost() as host:
+        host.add_tenant("desk", trained)
+        with ServeGateway(host, port=0, default_tenant="desk") as gw:
+            with MetricsServer(gw.metrics_text,
+                               health_fn=gw.health_report) as ms:
+                addr, port = ms.address
+                with urllib.request.urlopen(
+                        f"http://{addr}:{port}/metrics", timeout=5) as r:
+                    assert r.status == 200
+                    assert "version=0.0.4" in r.headers["Content-Type"]
+                    body = r.read().decode()
+                assert "serve_gateway_rows" in body
+                with urllib.request.urlopen(
+                        f"http://{addr}:{port}/healthz", timeout=5) as r:
+                    doc = json.loads(r.read())
+                assert doc["draining"] is False
+                with pytest.raises(urllib.error.HTTPError):
+                    urllib.request.urlopen(
+                        f"http://{addr}:{port}/nope", timeout=5)
+
+
+def test_orp_top_cli_snapshot(trained):
+    import contextlib
+    import io
+
+    from orp_tpu.cli import main as cli_main
+
+    # mirror `orp serve-gateway`: the process keeps a registry-backed obs
+    # session, so the gateway counters (serve/gateway_rows) are live
+    with obs.active(), ServeHost() as host:
+        host.add_tenant("desk", trained)
+        with ServeGateway(host, port=0, default_tenant="desk") as gw:
+            addr, port = gw.address
+            with GatewayClient(addr, port) as client:
+                client.submit_block("desk", 0, _rows(8))
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                cli_main(["top", "--gateway", f"{addr}:{port}",
+                          "--interval", "0.1", "--json"])
+            snap = json.loads(buf.getvalue().strip().splitlines()[-1])
+            assert snap["gateway_rows"] >= 8
+            assert "requests_per_s" in snap["rates"]
+            assert snap["tenants"]["desk"]["pending"] == 0
+            # the REAL queue-age series, not the pre-interned empty twin:
+            # served rows aged in the queue, so the p99 must be positive
+            assert snap["queue_age_p99_ms"] is not None
+            assert snap["queue_age_p99_ms"] > 0
+            # human table renders without error
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                cli_main(["top", "--gateway", f"{addr}:{port}",
+                          "--interval", "0.1"])
+            assert "desk" in buf.getvalue()
+    # dead gateway: flag-speak, not a traceback
+    with pytest.raises(SystemExit, match="serve-gateway"):
+        cli_main(["top", "--gateway", f"{addr}:{port}",
+                  "--interval", "0.1", "--timeout-s", "1.0"])
+
+
+def test_parse_prometheus_label_escape_roundtrip():
+    """Label values survive the render→parse round trip, including the
+    nasty ones: a literal backslash followed by 'n' must NOT decode to a
+    newline (the chained-replace bug class)."""
+    reg = obs.Registry()
+    nasty = "C:\\new\\dir"          # backslash+'n' inside
+    quoted = 'say "hi"\nbye'        # quote and a REAL newline
+    reg.counter("weird", {"p": nasty}).inc(2)
+    reg.counter("weird", {"p": quoted}).inc(3)
+    series = parse_prometheus(obs.prometheus_text(reg))
+    got = {labels["p"]: v for labels, v in series["weird"]}
+    assert got == {nasty: 2.0, quoted: 3.0}
+
+
+def test_concurrent_scrape_never_tears_during_serve_storm(trained):
+    """The scrape-concurrency satellite: prometheus_text(registry) hammered
+    from scraper threads during a multi-threaded serve storm never raises,
+    never returns a malformed exposition, and never drops a series that
+    was present in an earlier scrape."""
+    engine = HedgeEngine(trained)
+    reg = obs.Registry()
+    with obs.active(registry=reg):
+        host = ServeHost(registry=reg)
+        host.add_tenant("desk", trained)
+        errors: list = []
+        final_seen: list = []
+        stop = threading.Event()
+
+        def scraper():
+            # per-thread baseline: registered series must never DISAPPEAR
+            # between two scrapes taken by the SAME observer (a shared set
+            # across scrapers would race its own bookkeeping, not the
+            # registry)
+            seen: set = set()
+            try:
+                while not stop.is_set():
+                    text = obs.prometheus_text(reg)
+                    series = set(parse_prometheus(text))
+                    missing = seen - series
+                    if missing:
+                        errors.append(AssertionError(
+                            f"scrape dropped series {missing}"))
+                        return
+                    seen.update(series)
+            except Exception as e:  # noqa: BLE001 — re-raised on the test thread
+                errors.append(e)
+            finally:
+                final_seen.append(seen)
+
+        def storm(tid):
+            try:
+                for i in range(40):
+                    host.submit_block("desk", i % engine.n_dates,
+                                      _rows(4, seed=tid * 100 + i)
+                                      ).result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — re-raised on the test thread
+                errors.append(e)
+
+        scrapers = [threading.Thread(target=scraper, daemon=True)
+                    for _ in range(2)]
+        stormers = [threading.Thread(target=storm, args=(t,), daemon=True)
+                    for t in range(3)]
+        for t in scrapers + stormers:
+            t.start()
+        for t in stormers:
+            t.join(timeout=120)
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=10)
+        host.close()
+    assert not errors, errors[0]
+    assert any("serve_requests_total" in s for s in final_seen)
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump_schema(tmp_path):
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("shed", reason="deadline", i=i)
+    snap = rec.snapshot()
+    assert len(snap) == 4 and snap[0]["i"] == 6  # oldest 6 evicted
+    assert rec.recorded == 10
+    path = rec.dump(tmp_path / "flight.jsonl")
+    lines = flight.read_flight(path)
+    assert lines[0]["kind"] == "flight_dump"
+    assert lines[0]["retained"] == 4 and lines[0]["recorded"] == 10
+    for e in lines:
+        assert flight.validate_flight_event(e) == [], e
+    # the validator actually rejects malformed lines
+    assert flight.validate_flight_event({"kind": "x"})
+    assert flight.validate_flight_event(
+        {**lines[1], "schema": "orp-flight-v0"})
+    # disarmed dump with no path is a no-op, never an error
+    assert rec.dump() is None
+
+
+def test_flight_trip_autodumps_when_armed(tmp_path):
+    flight.RECORDER.arm(tmp_path)
+    flight.record("shed", reason="deadline")
+    assert not (tmp_path / "flight.jsonl").exists()  # shed is not a trip
+    flight.record("watchdog_trip", tag="bucket:64")
+    dumped = flight.read_flight(tmp_path / "flight.jsonl")
+    assert [e["kind"] for e in dumped] == ["flight_dump", "shed",
+                                           "watchdog_trip"]
+
+
+def test_guard_trips_reach_the_ring():
+    from orp_tpu.guard import CircuitBreaker
+
+    br = CircuitBreaker(threshold=2, what="aot_bucket")
+    br.record_failure(64)
+    assert br.record_failure(64) is True
+    kinds = [e["kind"] for e in flight.RECORDER.snapshot()]
+    assert "circuit_open" in kinds
+    # shed decisions from the block lane land too
+    from orp_tpu.serve.ingest import SHED_WATERMARK, Block
+    from orp_tpu.serve.batcher import SlimFuture
+
+    blk = Block(0, _rows(4), None, SlimFuture(), time.perf_counter(), None)
+    blk.shed_tail(1, SHED_WATERMARK)
+    blk.emit_shed(SHED_WATERMARK, 3)
+    kinds = [e["kind"] for e in flight.RECORDER.snapshot()]
+    assert kinds.count("shed") == 1
+
+
+def test_health_probe_dumps_armed_flight_ring(trained, tmp_path):
+    """The `orp doctor` hook: a HEALTH probe against a live gateway dumps
+    the serving process's ring to the armed directory."""
+    flight.RECORDER.arm(tmp_path)
+    flight.record("shed", reason="deadline")
+    with ServeHost() as host:
+        host.add_tenant("d", trained)
+        with ServeGateway(host, port=0, default_tenant="d") as gw:
+            with GatewayClient(*gw.address) as client:
+                # a PLAIN probe (orp top's shape) never writes: a
+                # read-only dashboard must not cause serving-process I/O
+                plain = client.health()
+                assert plain["flight_dump"] is None
+                assert not (tmp_path / "flight.jsonl").exists()
+                h = client.health(dump_flight=True)
+    assert h["flight_dump"] == str(tmp_path / "flight.jsonl")
+    dumped = flight.read_flight(tmp_path / "flight.jsonl")
+    assert any(e["kind"] == "shed" for e in dumped)
+
+
+# -- exit-only telemetry fixed ------------------------------------------------
+
+
+def test_periodic_flush_writes_bundle_mid_session(tmp_path):
+    with obs.telemetry(tmp_path, flush_every_s=0.05):
+        obs.count("serve/gateway_rows", 7)
+        flight.record("shed", reason="quota")
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            if (tmp_path / "metrics.prom").exists() and \
+                    (tmp_path / "flight.jsonl").exists():
+                break
+            time.sleep(0.02)
+        # the bundle exists while the process is STILL RUNNING — a SIGKILL
+        # after this instant leaves telemetry behind, not an empty dir
+        prom = (tmp_path / "metrics.prom").read_text()
+        assert "serve_gateway_rows 7" in prom
+        assert flight.read_flight(tmp_path / "flight.jsonl")
+
+
+def test_flush_active_and_signal_hook_flush_bundle(tmp_path):
+    """flush_active() (the SIGTERM handler's body) writes metrics.prom +
+    flight.jsonl on demand; the handler itself chains to the previous
+    SIGTERM disposition."""
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        obs.count("serve/gateway_rows", 3)
+        flight.record("shed", reason="quota")
+        assert not (tmp_path / "metrics.prom").exists()
+        obs.flush_active()
+        assert "serve_gateway_rows 3" in (tmp_path / "metrics.prom").read_text()
+        assert (tmp_path / "flight.jsonl").exists()
+    # outside a session flush_active is a no-op, not an error
+    obs.flush_active()
+
+
+def test_telemetry_bundle_includes_flight_jsonl(tmp_path):
+    with obs.telemetry(tmp_path, flush_every_s=None):
+        flight.record("shed", reason="deadline")
+    for name in ("events.jsonl", "metrics.prom", "manifest.json",
+                 "flight.jsonl"):
+        assert (tmp_path / name).exists(), name
+    dumped = flight.read_flight(tmp_path / "flight.jsonl")
+    assert any(e["kind"] == "shed" for e in dumped)
