@@ -258,6 +258,36 @@ def _maybe_export(result: "PipelineResult", export_dir) -> "PipelineResult":
     return result
 
 
+def _attach_baseline(result: "PipelineResult", features,
+                     validation=None) -> "PipelineResult":
+    """Attach the model-health baseline (orp_tpu/obs/quality.py) the export
+    bakes into the bundle: the per-feature sketch of the TRAINING features
+    (what serve-time drift is measured against), the pinned validation
+    scenario set when the pipeline has one, and the training-time
+    hedge-error level — ``cv_std`` (the learned-hedge control variate's
+    residual std, Buehler's hedge-error objective measured in-sample) in
+    the walk's normalised units, else the residual-P&L std."""
+    from orp_tpu.obs.quality import FeatureSketch
+
+    result.feature_sketch = FeatureSketch.from_features(
+        np.asarray(features, np.float32))
+    result.validation = validation
+    rep = result.report
+    if getattr(rep, "cv_std", None) is not None:
+        result.hedge_error_baseline = (
+            float(rep.cv_std) / float(result.adjustment_factor))
+    else:
+        stats = getattr(rep, "residual_stats", None) or {}
+        if stats.get("std") is not None:
+            # residual_stats are ADJUSTED (build_report scales the ledgers
+            # by adjustment_factor) — divide back so the baked baseline is
+            # in the same normalised units as cv_std's branch above and the
+            # validation-set estimate
+            result.hedge_error_baseline = (
+                float(stats["std"]) / float(result.adjustment_factor))
+    return result
+
+
 def _backward_cfg(t: TrainConfig, dual_mode: str | None = None) -> BackwardConfig:
     return BackwardConfig(
         epochs_first=t.epochs_first,
@@ -306,6 +336,16 @@ class PipelineResult:
     model: HedgeMLP | None = None   # the hedge net this run trained/replayed —
     # what a serve bundle must reconstruct at load (serve/bundle.py); every
     # pipeline sets it
+    # model-health baseline (orp_tpu/obs/quality.py) the export bakes into
+    # the bundle: the per-feature training-feature sketch (serve-time drift
+    # monitoring compares live traffic against it), the pinned validation
+    # scenario set (the quality canary gate's scenario source — risk-neutral
+    # pipelines only; the pension/basket systems have no single-instrument
+    # validation kind yet) and the training-time hedge-error level in the
+    # walk's normalised units
+    feature_sketch: object | None = None       # obs.quality.FeatureSketch
+    validation: object | None = None           # obs.quality.ValidationSpec
+    hedge_error_baseline: float | None = None
 
     @property
     def v0(self) -> float:
@@ -363,9 +403,10 @@ def european_hedge(
     e_payoff_n = float(jnp.mean(payoff)) / s0
     bias = (e_payoff_n,) if euro.constrain_self_financing else (e_payoff_n, 0.0)
 
+    features = (s / s0)[:, :, None]
     res = backward_induction(
         model,
-        (s / s0)[:, :, None],
+        features,
         s / s0,
         b / s0,
         payoff / s0,
@@ -386,15 +427,21 @@ def european_hedge(
         )
         _attach_cv_price(report, res, s, payoff, euro.r, times,
                          strike_over_s0=euro.strike / euro.s0)
-    return _maybe_export(
-        PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                       sim_seed=sim.seed_fund,
-                       dual_mode=train.dual_mode,
-                       holdings_combine=train.holdings_combine,
-                       cost_of_capital=train.cost_of_capital,
-                       model=model),
-        export_dir,
-    )
+    from orp_tpu.obs.quality import ValidationSpec
+
+    result = PipelineResult(report=report, backward=res, times=times,
+                            adjustment_factor=s0,
+                            sim_seed=sim.seed_fund,
+                            dual_mode=train.dual_mode,
+                            holdings_combine=train.holdings_combine,
+                            cost_of_capital=train.cost_of_capital,
+                            model=model)
+    _attach_baseline(result, features, ValidationSpec(
+        kind="gbm", s0=euro.s0, r=euro.r, sigma=euro.sigma,
+        strike=euro.strike, option_type=euro.option_type, T=sim.T,
+        n_steps=sim.n_steps, rebalance_every=sim.rebalance_every,
+        n_paths=min(sim.n_paths, 2048)))
+    return _maybe_export(result, export_dir)
 
 
 def european_oos(
@@ -521,15 +568,23 @@ def heston_hedge(
         )
         _attach_cv_price(report, res, s, payoff, h.r, times,
                          strike_over_s0=h.strike / h.s0)
-    return _maybe_export(
-        PipelineResult(report=report, backward=res, times=times, adjustment_factor=s0,
-                       sim_seed=sim.seed_fund,
-                       dual_mode=train.dual_mode,
-                       holdings_combine=train.holdings_combine,
-                       cost_of_capital=train.cost_of_capital,
-                       model=model),
-        export_dir,
-    )
+    from orp_tpu.obs.quality import ValidationSpec
+
+    result = PipelineResult(report=report, backward=res, times=times,
+                            adjustment_factor=s0,
+                            sim_seed=sim.seed_fund,
+                            dual_mode=train.dual_mode,
+                            holdings_combine=train.holdings_combine,
+                            cost_of_capital=train.cost_of_capital,
+                            model=model)
+    scheme = resolve_heston_scheme(h.scheme, sim.engine, "heston_hedge")
+    _attach_baseline(result, features, ValidationSpec(
+        kind=f"heston-{scheme}", s0=h.s0, r=h.r, v0=h.v0, kappa=h.kappa,
+        theta=h.theta, xi=h.xi, rho=h.rho, strike=h.strike,
+        option_type=h.option_type, T=sim.T, n_steps=sim.n_steps,
+        rebalance_every=sim.rebalance_every,
+        n_paths=min(sim.n_paths, 2048)))
+    return _maybe_export(result, export_dir)
 
 
 def heston_oos(
@@ -699,9 +754,10 @@ def basket_hedge(
         ) + (0.0,)
     else:
         bias = (e_payoff_n, 0.0)
+    features = s / jnp.asarray(basket.s0, dtype)  # (n, knots, A) moneyness
     res = backward_induction(
         model,
-        s / jnp.asarray(basket.s0, dtype),  # (n, knots, A) per-asset moneyness
+        features,
         hedge_prices,
         b / norm,
         payoff / norm,
@@ -714,15 +770,16 @@ def basket_hedge(
             basket, sim, res, s, w, bkt, coarse, b, payoff, norm, vector,
             quantile_method,
         )
-    return _maybe_export(
-        PipelineResult(report=report, backward=res, times=times, adjustment_factor=norm,
-                       sim_seed=sim.seed_fund,
-                       dual_mode=train.dual_mode,
-                       holdings_combine=train.holdings_combine,
-                       cost_of_capital=train.cost_of_capital,
-                       model=model),
-        export_dir,
-    )
+    result = PipelineResult(report=report, backward=res, times=times,
+                            adjustment_factor=norm,
+                            sim_seed=sim.seed_fund,
+                            dual_mode=train.dual_mode,
+                            holdings_combine=train.holdings_combine,
+                            cost_of_capital=train.cost_of_capital,
+                            model=model)
+    # sketch only (per-asset moneyness features); no basket validation kind
+    _attach_baseline(result, features)
+    return _maybe_export(result, export_dir)
 
 
 # ---------------------------------------------------------------------------
@@ -859,16 +916,18 @@ def pension_hedge(
             adjustment_factor=adjustment,
             quantile_method=quantile_method,
         )
-    return _maybe_export(
-        PipelineResult(
-            report=report, backward=res, times=times, adjustment_factor=adjustment,
-            sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
-            holdings_combine=cfg.train.holdings_combine,
-            cost_of_capital=cfg.train.cost_of_capital,
-            model=model,
-        ),
-        export_dir,
+    result = PipelineResult(
+        report=report, backward=res, times=times, adjustment_factor=adjustment,
+        sim_seed=cfg.sim.seed, dual_mode=cfg.train.dual_mode,
+        holdings_combine=cfg.train.holdings_combine,
+        cost_of_capital=cfg.train.cost_of_capital,
+        model=model,
     )
+    # sketch only: the pension system has no single-instrument validation
+    # kind yet, so the quality canary gate needs an explicit spec there —
+    # the drift monitor works from the sketch alone
+    _attach_baseline(result, features)
+    return _maybe_export(result, export_dir)
 
 
 

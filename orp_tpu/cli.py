@@ -60,6 +60,10 @@ output for scripting. Commands mirror the reference's four entry shapes:
 - ``trace``     reconstruct one frame's span tree (decode → queue →
                 dispatch → resolve → encode) from a telemetry bundle's
                 ``events.jsonl`` by trace id
+- ``report``    render a telemetered walk's training-convergence record
+                (per-date loss trajectories, epochs/GN iterations, the
+                trainer-ladder rung each date finished on, GN Gram
+                conditioning) from a ``--telemetry DIR`` bundle
 - ``warm``      pre-populate the persistent XLA compile cache for training:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
@@ -68,9 +72,12 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 fingerprint, persistent-cache dir writable, bundle format/
                 digest/AOT-topology coverage, obs sink writable — every
                 failing check prints its fix in flag-speak; the first
-                thing to run on a broken pod
+                thing to run on a broken pod. ``--quality BUNDLE`` probes
+                the model-health plumbing: baked baseline sketch +
+                validation-set fingerprint present, quality record
+                parseable with a nonzero RQMC CI
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP015 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP016 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
@@ -78,7 +85,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 assumptions in mesh-reachable code, engine rebuild/swap
                 work under a lock, per-row Python work in ingest-path
                 code, unbounded socket I/O, dynamic obs instrument names /
-                hot-path instrument construction); exits non-zero
+                hot-path instrument construction, numeric acceptance gates
+                that never record their measurement); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -977,6 +985,7 @@ def cmd_doctor(args):
     rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
                         telemetry_dir=args.telemetry_dir,
                         gateway=args.gateway, metrics=args.metrics,
+                        quality=args.quality,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -1076,6 +1085,28 @@ def cmd_trace(args):
                           "tree": roots}))
     else:
         print(format_trace_tree(args.trace_id, roots, summary))
+
+
+def cmd_report(args):
+    """Render the training-convergence record of a telemetered walk: per
+    date, the final fit loss/mae, the epochs (or GN iterations) consumed,
+    the trainer-ladder rung that produced the committed columns (the NaN
+    sentinel's ``guard/degrade`` events overlay the configured optimizer)
+    and — for Gauss-Newton walks — the GN Gram condition number."""
+    from orp_tpu.obs.report import format_report, load_convergence
+
+    try:
+        rec = load_convergence(args.events)
+    except FileNotFoundError as e:
+        raise SystemExit(f"error: {e}") from None
+    except ValueError as e:
+        raise SystemExit(
+            f"error: {args.events}: events.jsonl does not parse ({e}) — "
+            "torn bundle?") from None
+    if args.json:
+        print(json.dumps(rec))
+    else:
+        print(format_report(rec))
 
 
 def cmd_lint(args):
@@ -1434,7 +1465,11 @@ def build_parser():
                           "--ingest-blocks size, bits pinned equal across "
                           "lanes; promotes submit_ns_per_row / "
                           "ingest_rows_per_s to record fields and fails if "
-                          "columnar does not beat the per-request path")
+                          "columnar does not beat the per-request path. "
+                          "Also measures + gates (≤5%) the trace_overhead "
+                          "AND drift_overhead per-block bills, and embeds "
+                          "the bundle's orp-quality-v1 hedge-error record "
+                          "when it bakes a validation set")
     psb.add_argument("--ingest-rows", type=int, default=4096,
                      help="total rows per ingest lane (must divide by every "
                           "block size)")
@@ -1593,6 +1628,14 @@ def build_parser():
                            "core serve series (requests/latency, queue "
                            "age, sheds); also triggers the serving "
                            "process's flight-recorder dump")
+    pdoc.add_argument("--quality", default=None, metavar="BUNDLE",
+                      help="probe a bundle's model-health plumbing: baked "
+                           "per-feature baseline sketch + pinned "
+                           "validation-set fingerprint present, and a "
+                           "shrunken hedge-quality estimate produces a "
+                           "parseable orp-quality-v1 record with a nonzero "
+                           "RQMC confidence interval (the preflight for "
+                           "drift monitoring and reload quality_band gates)")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "
@@ -1601,13 +1644,28 @@ def build_parser():
                       help="machine-readable report")
     pdoc.set_defaults(fn=cmd_doctor)
 
+    prep = sub.add_parser(
+        "report",
+        help="render a telemetered walk's training-convergence record "
+             "(per-date loss trajectory, epochs/GN iterations, "
+             "trainer-ladder rung, GN Gram conditioning) from a "
+             "--telemetry bundle",
+    )
+    prep.add_argument("--events", required=True, metavar="DIR|FILE",
+                      help="the training run's --telemetry DIR (or its "
+                           "events.jsonl directly)")
+    prep.add_argument("--json", action="store_true",
+                      help="emit the merged record as one JSON line")
+    prep.set_defaults(fn=cmd_report)
+
     pl = sub.add_parser(
         "lint",
         help="JAX/TPU-aware static analysis (recompiles, host syncs, x64 "
              "drift, key reuse, silent excepts, blocking dispatch loops, "
              "single-device assumptions, per-row ingest work, unbounded "
-             "socket I/O, dynamic obs instrument names — rules "
-             "ORP001-ORP015); non-zero "
+             "socket I/O, dynamic obs instrument names, unrecorded "
+             "numeric acceptance gates — rules "
+             "ORP001-ORP016); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

@@ -88,6 +88,15 @@ ORP015  dynamic obs instrument names / hot-path instrument construction:
         the hot path the zero-cost discipline keeps clean. Names must be
         static lowercase slash-path literals (``[a-z0-9_]+(/[a-z0-9_]+)*``)
         at the obs helper call sites; construction belongs at init time.
+ORP016  numeric acceptance gates that never record their measurement: a
+        compare-then-raise/return on a measured float under ``serve/`` or
+        ``guard/`` (the canary quality band, the bench overhead gates, a
+        watermark verdict) IS the system deciding something operationally
+        load-bearing — and a verdict whose measured value never reached obs
+        is a silent rollback nobody can post-mortem. Validation raises
+        (ValueError & co) are input checking, not verdicts, and are out of
+        scope; a gate records through obs_count/obs_observe/obs_set_gauge/
+        flight.record (or the promotion chain) BEFORE raising.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -1087,6 +1096,118 @@ def check_instrument_hygiene(ctx: FileContext) -> Iterator[Finding]:
                         "instrument (or noqa why this is a lookup on a "
                         "cold path)",
                     )
+
+
+# -- ORP016 ------------------------------------------------------------------
+
+# argument/config-validation exception types: a compare-then-raise of one of
+# these is input checking, not a measured acceptance verdict. WireError is
+# the wire plane's ValueError (it subclasses it): a malformed-frame bounds
+# check is input validation, answered as a structured ERROR frame with
+# serve/gateway_errors counted at the catch site. TimeoutError is the
+# deadline MECHANISM (the ORP014-sanctioned bounded-loop shape), whose
+# catcher owns the response — the rule targets verdicts, not signals
+_ORP016_VALIDATION_EXCS = {"ValueError", "TypeError", "KeyError",
+                           "IndexError", "NotImplementedError",
+                           "AssertionError", "SystemExit", "WireError",
+                           "TimeoutError"}
+# obs emission spellings that count as "the measurement was recorded": the
+# repo-idiom aliases, the dotted façade, the flight recorder, the chain
+_ORP016_EMIT_DOTTED = {"obs.count", "obs.observe", "obs.set_gauge",
+                       "obs.emit_record", "flight.record", "obs_count",
+                       "obs_observe", "obs_set_gauge", "obs_emit_record",
+                       "chain_append", "_chain_verdict", "_canary_reject"}
+# a gate may also RETURN its rejection instead of raising
+_ORP016_REJECT_RE = re.compile(r"(Rejection|Rejected)$")
+
+
+def _orp016_is_emission(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    if d is None:
+        return False
+    tail = d.split(".")[-1]
+    return (d in _ORP016_EMIT_DOTTED or tail in _ORP016_EMIT_DOTTED
+            or d.endswith(".flight.record"))
+
+
+def _orp016_measured_compare(test: ast.expr) -> bool:
+    """An ordering comparison (>, <, >=, <=) with at least one non-constant
+    side — the compare-a-measured-float shape (equality/identity tests and
+    constant-vs-constant never are)."""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Gt, ast.Lt, ast.GtE, ast.LtE))
+                   for op in node.ops):
+            continue
+        sides = [node.left, *node.comparators]
+        if any(not isinstance(s, ast.Constant) for s in sides):
+            return True
+    return False
+
+
+def _orp016_verdicts(body_stmts):
+    """The verdict statements inside a gate's body: ``raise`` of a
+    non-validation exception, or ``return`` of a ``*Rejection`` object.
+    Nested function bodies are pruned (deferred code is not the gate)."""
+    stack = list(body_stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Raise):
+            exc = n.exc
+            callee = exc.func if isinstance(exc, ast.Call) else exc
+            name = (dotted(callee) or "").split(".")[-1] if callee else ""
+            if name and name not in _ORP016_VALIDATION_EXCS:
+                yield n, name
+        elif isinstance(n, ast.Return) and isinstance(n.value, ast.Call):
+            name = (dotted(n.value.func) or "").split(".")[-1]
+            if _ORP016_REJECT_RE.search(name):
+                yield n, name
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@rule("ORP016", "numeric acceptance gate that never records its measurement")
+def check_unrecorded_gate(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path and "guard/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        emit_lines = [n.lineno for n in walk_scope(fdef)
+                      if isinstance(n, ast.Call) and _orp016_is_emission(n)]
+        for node in walk_scope(fdef):
+            if not isinstance(node, ast.If):
+                continue
+            if not _orp016_measured_compare(node.test):
+                continue
+            # the gate's branches: body plus a plain else (an elif chain in
+            # orelse is its own If node with its own test — walk_scope
+            # visits it separately, so including it here would double-flag)
+            branches = list(node.body)
+            if node.orelse and not (len(node.orelse) == 1
+                                    and isinstance(node.orelse[0], ast.If)):
+                branches += node.orelse
+            for verdict, name in _orp016_verdicts(branches):
+                # satisfied when an obs emission precedes the verdict —
+                # earlier in the function (the measurement was recorded as
+                # it was taken) or inside the gate body before the raise
+                if any(ln < verdict.lineno for ln in emit_lines):
+                    continue
+                word = "raises" if isinstance(verdict, ast.Raise) \
+                    else "returns"
+                yield ctx.finding(
+                    verdict, "ORP016",
+                    f"acceptance gate in {fdef.name!r} compares a measured "
+                    f"float and {word} {name} without recording the "
+                    "measurement through obs first — a tripped gate nobody "
+                    "can see in telemetry is a silent rollback; emit the "
+                    "value (obs_count/obs_observe/obs_set_gauge/"
+                    "flight.record) before the verdict",
+                )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

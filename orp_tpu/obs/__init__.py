@@ -18,7 +18,18 @@ instrumentation with one export spine; see PAPERS.md):
 - ``sink``      — schema-versioned JSONL event log (``orp-obs-v1``) +
                   Prometheus text exposition of the registry;
 - ``manifest``  — run manifests binding artifacts to the config
-                  fingerprint, jax/jaxlib versions, platform and git rev;
+                  fingerprint, jax/jaxlib versions, platform and git rev,
+                  PLUS the hash-linked promotions chain (``chain_append`` /
+                  ``chain_verify``) every ``reload_tenant`` verdict lands on;
+- ``quality``   — the MODEL-health plane: the Owen-scrambled RQMC
+                  hedge-quality estimator over pinned validation scenario
+                  sets (``orp-quality-v1`` records, the quantitative canary
+                  gate's measure), export-time feature-baseline sketches and
+                  the serve-time per-tenant drift monitor
+                  (``quality/drift_*`` gauges, ``drift_trip`` flight TRIPs);
+- ``report``    — the read side of training convergence telemetry
+                  (``orp report``): per-date loss trajectories, ladder
+                  rungs, GN Gram conditioning merged from one bundle;
 - ``flight``    — the per-process flight recorder: a bounded ring of recent
                   guard/serve events, dumped as a schema-versioned JSONL
                   black box (``orp-flight-v1``) on guard trips, SIGTERM, or
@@ -53,9 +64,13 @@ import threading
 from orp_tpu.obs import flight
 from orp_tpu.obs.flight import (FLIGHT_FILE, FLIGHT_SCHEMA, FlightRecorder,
                                 read_flight, validate_flight_event)
-from orp_tpu.obs.manifest import (MANIFEST_SCHEMA, build_manifest,
-                                  config_fingerprint, read_manifest,
-                                  write_manifest)
+from orp_tpu.obs.manifest import (CHAIN_FILE, CHAIN_SCHEMA, MANIFEST_SCHEMA,
+                                  build_manifest, chain_append, chain_verify,
+                                  config_fingerprint, read_chain,
+                                  read_manifest, write_manifest)
+from orp_tpu.obs.quality import (DEFAULT_DRIFT_BAND, QUALITY_SCHEMA,
+                                 DriftMonitor, FeatureSketch, ValidationSpec,
+                                 evaluate_quality, validate_quality_record)
 from orp_tpu.obs.registry import Counter, Gauge, Histogram, Registry
 from orp_tpu.obs.sink import (EVENTS_FILE, METRICS_FILE, SCHEMA, JsonlSink,
                               ListSink, prometheus_text, read_events,

@@ -37,9 +37,13 @@ FLIGHT_SCHEMA = "orp-flight-v1"
 FLIGHT_FILE = "flight.jsonl"
 
 #: event kinds that auto-dump an armed recorder — the "something tripped,
-#: preserve the evidence NOW" class (a later SIGTERM may never come)
+#: preserve the evidence NOW" class (a later SIGTERM may never come).
+#: ``drift_trip`` is the model-health plane's entry: a tenant's live
+#: feature distribution breached its baked baseline band
+#: (``orp_tpu/obs/quality.py::DriftMonitor``) — the drifted window in the
+#: ring IS the post-mortem evidence
 TRIP_KINDS = frozenset({"watchdog_trip", "circuit_open", "device_lost",
-                        "canary_reject"})
+                        "canary_reject", "drift_trip"})
 
 # every dumped line must carry these; kind-specific fields ride alongside
 _REQUIRED = {"schema": str, "seq": int, "ts_unix": float, "kind": str}

@@ -681,16 +681,44 @@ def simulate_gbm_basket(
     return s0 * jnp.exp(traj)
 
 
-def heston_sim_fn(scheme: str):
-    """The ONE scheme-name -> Heston kernel mapping, shared by every
-    scheme-parameterized consumer (``risk/surface.py``, ``train/lsm.py``,
-    ``tools/heston_scheme_ladder.py``) so adding a scheme cannot leave the
-    consumers accepting different sets. (``api/pipelines
-    .resolve_heston_scheme`` layers the ``None``-default on top of this for
-    the pipeline configs.)"""
+#: THE shared scenario-name -> kernel table (the "sim-fn resolver"): every
+#: consumer that selects a scenario model by name — the Heston pipelines via
+#: :func:`heston_sim_fn`, the model-health validation sets
+#: (``orp_tpu/obs/quality.py`` resolves its pinned scenario kind here) —
+#: goes through this one mapping, so adding a scenario model makes it
+#: available to ALL of them at once instead of leaving the consumers
+#: accepting different sets
+_SIM_FNS = {
+    "gbm": simulate_gbm_log,
+    "gbm-arith": simulate_gbm_arithmetic,
+    "heston-qe": simulate_heston_qe,
+    "heston-euler": simulate_heston_log,
+    "pension": simulate_pension,
+    "basket": simulate_gbm_basket,
+}
+
+
+def resolve_sim_fn(kind: str):
+    """Resolve a scenario-kind name to its simulation kernel (see
+    :data:`_SIM_FNS`). Unknown kinds fail loudly with the full menu."""
     try:
-        return {"qe": simulate_heston_qe, "euler": simulate_heston_log}[scheme]
+        return _SIM_FNS[kind]
     except KeyError:
         raise ValueError(
-            f"unknown Heston scheme {scheme!r} (expected 'qe' or 'euler')"
+            f"unknown scenario kind {kind!r} (known: {sorted(_SIM_FNS)})"
         ) from None
+
+
+def heston_sim_fn(scheme: str):
+    """The scheme-name -> Heston kernel mapping, shared by every
+    scheme-parameterized consumer (``risk/surface.py``, ``train/lsm.py``,
+    ``tools/heston_scheme_ladder.py``) so adding a scheme cannot leave the
+    consumers accepting different sets. A thin view over
+    :func:`resolve_sim_fn` (``heston-<scheme>``); ``api/pipelines
+    .resolve_heston_scheme`` layers the ``None``-default on top for the
+    pipeline configs."""
+    if scheme not in ("qe", "euler"):
+        raise ValueError(
+            f"unknown Heston scheme {scheme!r} (expected 'qe' or 'euler')"
+        )
+    return resolve_sim_fn(f"heston-{scheme}")

@@ -359,6 +359,45 @@ def _trace_bill_s(feats, iters: int = 2000) -> float:
     return walls[1]
 
 
+DRIFT_OVERHEAD_GATE_PCT = 5.0
+
+
+def _drift_overhead(feats, disabled_ns_per_row: float) -> dict:
+    """Model-health monitoring cost on the columnar lane — the per-block
+    drift-sketch bill (``obs.quality.DriftMonitor.update``: one column-sum +
+    one column-sum-of-squares + the gauge writes, everything the block lane
+    adds per ADMITTED block) measured in a tight loop at the headline block
+    shape, amortized per row and gated against the measured disabled-lane
+    ns/row — the same tight-numerator / robust-denominator estimator the
+    ``trace_overhead`` phase uses (differencing two end-to-end walls on a
+    shared box cannot resolve single-digit percents)."""
+    from orp_tpu.obs.quality import DriftMonitor, FeatureSketch
+    from orp_tpu.obs.registry import Registry
+
+    bsz = min(feats.shape[0], 1024)
+    block = np.ascontiguousarray(feats[:bsz])
+    monitor = DriftMonitor(FeatureSketch.from_features(block),
+                           registry=Registry(), tenant="bench")
+    iters = 2000
+
+    def batch() -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            monitor.update(block)
+        return (time.perf_counter() - t0) / iters
+
+    walls = sorted(batch() for _ in range(3))
+    bill_s = walls[1]
+    overhead_pct = (bill_s / bsz * 1e9) / disabled_ns_per_row * 100.0
+    return {
+        "block": int(bsz),
+        "drift_bill_us_per_block": round(bill_s * 1e6, 3),
+        "disabled_ns_per_row": round(disabled_ns_per_row, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "gate_pct": DRIFT_OVERHEAD_GATE_PCT,
+    }
+
+
 def _gateway_level(client, feats, bsz: int, pin) -> dict:
     """One gateway-loopback point: encode → TCP → decode → submit_block →
     encode reply, serially per block — the full wire round trip the
@@ -467,6 +506,11 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
     # see _trace_overhead): the enabled-mode cost the telemetry plane
     # commits to keeping under the gate, re-proven by every --ingest run
     trace_overhead = _trace_overhead(engine, feats, max_wait_us)
+    # drift-monitoring bill per admitted block, amortized over the same
+    # measured disabled-lane denominator (the model-health plane's cost
+    # commitment, gated like tracing's)
+    drift_overhead = _drift_overhead(
+        feats, trace_overhead["disabled_ns_per_row"])
 
     # the LARGEST block is the amortization headline — by value, not list
     # position, so an unsorted --ingest-blocks cannot flip the CLI gate
@@ -478,6 +522,7 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
         "columnar": columnar,
         "gateway": gateway,
         "trace_overhead": trace_overhead,
+        "drift_overhead": drift_overhead,
         "submit_ns_per_row": best["submit_ns_per_row"],
         "ingest_rows_per_s": max(c["ingest_rows_per_s"] for c in columnar),
         "submit_speedup_vs_per_request": round(
@@ -752,7 +797,12 @@ def serve_bench(
     loopback over the same rows at each block size, with every lane's bits
     pinned against a direct evaluation (the phase raises on a flipped bit),
     and promotes ``submit_ns_per_row`` / ``ingest_rows_per_s`` to
-    first-class record fields.
+    first-class record fields. It also measures and GATES (≤5% each) the
+    per-frame tracing bill (``trace_overhead``) and the per-block
+    drift-sketch bill (``drift_overhead``), and embeds the bundle's
+    ``orp-quality-v1`` hedge-error record (``record["quality"]``) when the
+    bundle bakes a validation set — BENCH_serve.json carries the model's
+    health next to the system's.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
     synchronous-tier baseline forward as ``batcher_before``."""
     engine = HedgeEngine(policy, mesh=mesh)
@@ -872,12 +922,37 @@ def serve_bench(
         record["submit_ns_per_row"] = ing["submit_ns_per_row"]
         record["ingest_rows_per_s"] = ing["ingest_rows_per_s"]
         record["trace_overhead_pct"] = ing["trace_overhead"]["overhead_pct"]
+        record["drift_overhead_pct"] = ing["drift_overhead"]["overhead_pct"]
         if ing["trace_overhead"]["overhead_pct"] > TRACE_OVERHEAD_GATE_PCT:
+            # the measured value is already recorded (the record dict +
+            # obs.emit_record below never runs on this path, so count the
+            # trip through obs HERE before the verdict — ORP016)
+            obs.count("quality/gate_trip", gate="trace_overhead")
             raise RuntimeError(
                 "tracing overhead gate violated: enabled-mode ingest costs "
                 f"{ing['trace_overhead']['overhead_pct']}% over disabled "
                 f"(gate {TRACE_OVERHEAD_GATE_PCT}%) — the telemetry plane "
                 "crept into the hot path; do not commit this record")
+        if ing["drift_overhead"]["overhead_pct"] > DRIFT_OVERHEAD_GATE_PCT:
+            obs.count("quality/gate_trip", gate="drift_overhead")
+            raise RuntimeError(
+                "drift-monitoring overhead gate violated: the per-block "
+                f"sketch bill costs {ing['drift_overhead']['overhead_pct']}% "
+                f"of the disabled columnar lane (gate "
+                f"{DRIFT_OVERHEAD_GATE_PCT}%) — the model-health plane "
+                "crept into the hot path; do not commit this record")
+        # the model-health record rides the same --ingest run: the bundle's
+        # pinned validation set (orp export bakes one) through the
+        # hedge-quality estimator — BENCH_serve.json carries the
+        # orp-quality-v1 hedge-error numbers with their RQMC CIs next to
+        # the latency numbers they complement
+        if getattr(policy, "validation", None) is not None:
+            from orp_tpu.obs.quality import evaluate_quality
+
+            # the BENCHED engine (mesh and all): the quality numbers must
+            # describe the same configuration as the latency numbers
+            # beside them, and reusing it skips a second bundle/AOT build
+            record["quality"] = evaluate_quality(policy, engine=engine)
     if sweep:
         record["sweep"] = sweep
         record["batcher_sustained_requests_per_s"] = best["requests_per_s"]

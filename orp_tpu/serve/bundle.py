@@ -73,6 +73,15 @@ class PolicyBundle:
     aot_dir: pathlib.Path | None = None  # bundle dir holding serialized
     # serving executables (orp export --aot → <dir>/aot/); the engine
     # deserializes them at construction (orp_tpu/aot/bundle_exec.py)
+    # model-health baseline (orp_tpu/obs/quality.py), baked at export:
+    # per-feature training-feature sketch (the serve-time drift monitor's
+    # reference), the pinned validation scenario set (the quality canary
+    # gate's scenario source) and the training-time hedge-error level.
+    # None on pre-quality bundles — everything downstream degrades
+    # gracefully (no drift monitor, quality gate refuses in flag-speak)
+    feature_sketch: object | None = None       # obs.quality.FeatureSketch
+    validation: object | None = None           # obs.quality.ValidationSpec
+    hedge_error_baseline: float | None = None  # normalised units
 
     @property
     def n_dates(self) -> int:
@@ -152,6 +161,19 @@ def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
         "cost_of_capital": float(result.cost_of_capital),
         "sim_seed": result.sim_seed,
     }
+    # model-health baseline (optional, additive — the fingerprint covers the
+    # POLICY identity, not the baseline; a re-export refreshes it freely):
+    # every pipeline attaches its training-feature sketch, the risk-neutral
+    # ones also a pinned validation scenario set + hedge-error level
+    sketch = getattr(result, "feature_sketch", None)
+    validation = getattr(result, "validation", None)
+    err0 = getattr(result, "hedge_error_baseline", None)
+    if sketch is not None or validation is not None:
+        meta["baseline"] = {
+            "sketch": None if sketch is None else sketch.to_meta(),
+            "validation": None if validation is None else validation.to_meta(),
+            "hedge_error": None if err0 is None else float(err0),
+        }
     # atomic: bundle.json is what load_bundle trusts to rebuild the model —
     # a torn write must leave the previous (complete) metadata or nothing
     atomic_write_text(meta_file, json.dumps(meta, indent=1, sort_keys=True))
@@ -170,6 +192,8 @@ def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
         dual_mode=result.dual_mode, holdings_combine=result.holdings_combine,
         cost_of_capital=float(result.cost_of_capital),
         sim_seed=result.sim_seed, fingerprint=fp,
+        feature_sketch=sketch, validation=validation,
+        hedge_error_baseline=None if err0 is None else float(err0),
     )
 
 
@@ -213,6 +237,16 @@ def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
     # recording the dir (not deserializing here) keeps loading cheap and
     # leaves the fingerprint check to the engine that will actually execute
     has_aot = (d / "aot" / "aot.json").exists()
+    sketch = validation = err0 = None
+    baseline = meta.get("baseline")
+    if baseline:
+        from orp_tpu.obs.quality import FeatureSketch, ValidationSpec
+
+        if baseline.get("sketch"):
+            sketch = FeatureSketch.from_meta(baseline["sketch"])
+        if baseline.get("validation"):
+            validation = ValidationSpec.from_meta(baseline["validation"])
+        err0 = baseline.get("hedge_error")
     return PolicyBundle(
         model=model,
         backward=BackwardResult.from_policy_state(state),
@@ -224,4 +258,6 @@ def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
         sim_seed=meta["sim_seed"],
         fingerprint=fp,
         aot_dir=d if has_aot else None,
+        feature_sketch=sketch, validation=validation,
+        hedge_error_baseline=None if err0 is None else float(err0),
     )

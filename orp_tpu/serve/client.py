@@ -256,7 +256,7 @@ class ResilientGatewayClient:
             def handshake_wall():
                 self._check_interrupt()
                 if time.perf_counter() - t0 > self.timeout_s:
-                    raise OSError(
+                    raise OSError(  # orp: noqa[ORP016] -- the reconnect loop that catches this counts client/reconnects + flight-records the failure with its wall
                         f"no WELCOME within {self.timeout_s}s — the "
                         "endpoint accepts connections but does not speak "
                         "orp-ingest (dead-but-accepting)")

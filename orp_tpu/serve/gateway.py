@@ -100,7 +100,7 @@ def _recv_exact(sock: socket.socket, n: int, closed, clock=None,
         if (clock is not None and clock["t0"] is not None
                 and clock["wall"] is not None
                 and time.perf_counter() - clock["t0"] > clock["wall"]):
-            raise FrameStall(
+            raise FrameStall(  # orp: noqa[ORP016] -- the catcher emits: the handler's stall eviction counts serve/gateway_errors{stage=stall} + the flight record with the stall wall
                 f"partial frame stalled past the {clock['wall'] * 1e3:.0f}ms "
                 "frame deadline — resetting the connection (a sequenced "
                 "client replays the frame on reconnect)")
@@ -777,7 +777,7 @@ class ServeGateway:
                     off += st.sock.send(view[off:])  # orp: noqa[ORP014] -- poll timeout set at accept; the loop carries its own reply_timeout_s deadline
                 except socket.timeout:
                     if time.perf_counter() > deadline:
-                        raise OSError(
+                        raise OSError(  # orp: noqa[ORP016] -- the enclosing except OSError emits serve/gateway_errors{stage=send} + the flight record three lines down
                             "reply send exceeded reply_timeout_s") from None
             return True
         except OSError:

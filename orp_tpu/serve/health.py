@@ -170,7 +170,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                   telemetry_dir=None, gateway=None, metrics=None,
-                  gateway_timeout_s: float = 5.0) -> dict:
+                  quality=None, gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
     row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
@@ -192,6 +192,14 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     and carry the core serve series (request/latency, queue age, sheds) —
     a gateway that serves traffic but cannot be observed is a failing
     check, fixed in flag-speak.
+    ``quality``     — optionally probe a bundle's MODEL-HEALTH plumbing
+    (``orp doctor --quality DIR``): the bundle must carry the baked
+    per-feature baseline sketch + pinned validation-set fingerprint
+    (``orp export`` bakes both), and a shrunken hedge-quality estimate
+    (``obs.quality.evaluate_quality``) must produce a parseable
+    ``orp-quality-v1`` record with a nonzero RQMC confidence interval —
+    the preflight for serve-time drift monitoring and the
+    ``reload_tenant(quality_band=...)`` canary gate.
     ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
@@ -264,13 +272,65 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                        fix="re-export the executables for this topology: "
                            "`orp export --aot --aot-mesh "
                            f"{1 if mesh in (None, 0) else int(mesh)}`")
-    # 5) obs sink target
+    # 5) model-health plumbing: baseline sketch + validation fingerprint
+    # baked, quality record parseable with an honest (nonzero) CI
+    if quality is not None:
+        from orp_tpu.obs.quality import (evaluate_quality,
+                                         validate_quality_record)
+        from orp_tpu.serve.bundle import load_bundle
+
+        _refix = ("re-export with the current code: `orp export --out DIR` "
+                  "bakes the per-feature baseline sketch and the pinned "
+                  "validation set the drift monitor and the "
+                  "quality_band canary gate need")
+        try:
+            qb = load_bundle(quality)
+        except (ValueError, OSError) as e:
+            _check(checks, "quality", False, f"{quality}: {e}", fix=_refix)
+        else:
+            if qb.feature_sketch is None or qb.validation is None:
+                missing = [w for w, v in (("baseline sketch",
+                                           qb.feature_sketch),
+                                          ("validation set", qb.validation))
+                           if v is None]
+                _check(checks, "quality", False,
+                       f"{quality}: bundle bakes no {' or '.join(missing)} "
+                       "(pre-quality export)", fix=_refix)
+            else:
+                try:
+                    rec = evaluate_quality(
+                        qb, n_paths=min(qb.validation.n_paths, 256),
+                        replicates=2)
+                except (ValueError, RuntimeError) as e:
+                    _check(checks, "quality", False,
+                           f"{quality}: quality estimate failed ({e})",
+                           fix=_refix)
+                else:
+                    problems = validate_quality_record(rec)
+                    he = rec.get("hedge_error", {})
+                    if not problems and not he.get("ci95", 0.0) > 0.0:
+                        problems = ["ci95 is zero — replicates collapsed "
+                                    "(identical scrambles?)"]
+                    base = qb.hedge_error_baseline
+                    _check(checks, "quality", not problems,
+                           (f"{quality}: hedge_error {he.get('mean', 0):.5g}"
+                            f" ± {he.get('ci95', 0):.2g} (RQMC, "
+                            f"{rec.get('replicates')} replicates)"
+                            + (f"; training baseline {base:.5g}"
+                               if base is not None else "")
+                            + f"; validation "
+                              f"{qb.validation.fingerprint()[:48]}…"
+                            if not problems else
+                            f"{quality}: quality record invalid: "
+                            f"{problems}"),
+                           fix=_refix)
+    # 6) obs sink target
     if telemetry_dir is not None:
         ok, detail = _dir_writable(telemetry_dir)
         _check(checks, "telemetry_sink", ok, detail,
                fix="--telemetry DIR must name a writable directory "
                    "(events.jsonl streams live)")
-    # 6) ingest gateway liveness: connect + PING/PONG over orp-ingest-v1
+    # 7) ingest gateway liveness: connect + PING/PONG over orp-ingest-v1
     if gateway is not None:
         from orp_tpu.serve.gateway import GatewayClient
 
@@ -296,7 +356,7 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                        "DIR --port N` (or fix the host:port); a connect "
                        "that hangs past the timeout is a dead-but-accepting "
                        "endpoint — restart it")
-    # 7) live metrics scrape: the exposition must parse AND carry the core
+    # 8) live metrics scrape: the exposition must parse AND carry the core
     # serve series — an unobservable gateway fails its fleet (no health
     # signal to drive REDIRECTs on), even while it serves
     if metrics is not None:

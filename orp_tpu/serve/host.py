@@ -33,6 +33,19 @@ top of the continuous batcher:
   error-budget consumption ratio (>1 means the budget is burning faster
   than it accrues; alert). No second bookkeeping path — the Dapper spine
   (PR 4) records, the host reads.
+- **model health** (``orp_tpu/obs/quality.py``) — a tenant whose bundle
+  carries a baked training-feature sketch gets a per-tenant
+  :class:`~orp_tpu.obs.quality.DriftMonitor`: the columnar block lane
+  folds each ADMITTED block into a vectorized online sketch (one update
+  per block, never per row) and publishes
+  ``quality/drift_score{tenant,feature}`` through the same registry the
+  scrape plane serves; a band breach emits ``quality/drift_trip`` and a
+  flight-recorder TRIP. :meth:`ServeHost.reload_tenant` grows the
+  QUANTITATIVE canary gate (``quality_band=``): candidate and incumbent
+  replay the bundle's pinned validation scenario set off-traffic, and a
+  hedge-error regression outside the band rejects exactly like a bitwise
+  canary failure — while every verdict (promote AND reject) appends to the
+  hash-linked promotions chain (``obs.chain_append``/``chain_verify``).
 """
 
 from __future__ import annotations
@@ -86,9 +99,17 @@ def burn_rate(histogram, slo: SloPolicy) -> float:
 
 class CanaryRejected(RuntimeError):
     """A hot bundle reload failed its canary gate: the candidate engine did
-    not reproduce the serving tenant's pinned probe rows (or went
-    non-finite). The tenant was NOT touched — it keeps serving the old
-    bundle's bits; the reject is the rollback."""
+    not reproduce the serving tenant's pinned probe rows, went non-finite,
+    or regressed past the hedge-error quality band on the pinned validation
+    set. The tenant was NOT touched — it keeps serving the old bundle's
+    bits; the reject is the rollback."""
+
+
+#: tenants already warned about a finiteness-only promotion path
+#: (``require_same_bits=False`` with no ``quality_band``) — warn ONCE per
+#: tenant per process; the ``guard/canary_unguarded`` counter fires every
+#: time
+_UNGUARDED_WARNED: set = set()
 
 
 class _Tenant:
@@ -96,9 +117,10 @@ class _Tenant:
 
     __slots__ = ("name", "source", "policy", "max_pending", "slo",
                  "engine", "batcher", "metrics", "pending", "activations",
-                 "last_used", "build_lock", "in_submit", "version")
+                 "last_used", "build_lock", "in_submit", "version",
+                 "drift", "drift_band")
 
-    def __init__(self, name, source, policy, max_pending, slo):
+    def __init__(self, name, source, policy, max_pending, slo, drift_band):
         self.name = name
         self.source = source          # bundle dir (str/Path) or policy object
         self.policy = policy
@@ -113,6 +135,12 @@ class _Tenant:
         self.in_submit = 0            # submits between claim and enqueue —
         # eviction never unlinks a tenant mid-submit (host-lock guarded)
         self.version = 1              # bumped by every canary-passed reload
+        # model-health drift monitor (obs/quality.py), built at first
+        # activation when the policy carries a baked feature sketch; like
+        # metrics it SURVIVES eviction — the sketch describes the tenant's
+        # traffic, not one engine incarnation
+        self.drift = None
+        self.drift_band = drift_band
         # serializes THIS tenant's engine build without the host lock: a
         # cold start (bundle load + engine construction + possible jit
         # compiles) must never head-of-line-block other tenants' submits
@@ -135,11 +163,17 @@ class ServeHost:
     def __init__(self, *, max_live_engines: int = 4,
                  registry: Registry | None = None,
                  engine_kwargs: dict | None = None,
-                 batcher_kwargs: dict | None = None):
+                 batcher_kwargs: dict | None = None,
+                 promotion_chain=None):
         if max_live_engines < 1:
             raise ValueError(
                 f"max_live_engines={max_live_engines} must be >= 1")
         self.max_live_engines = int(max_live_engines)
+        # the promotions manifest chain (obs/manifest.py) reload_tenant
+        # appends its verdicts to; None = resolve per reload from the active
+        # telemetry session's export dir (still None -> no chain, verdicts
+        # observable via counters/flight only)
+        self.promotion_chain = promotion_chain
         st = obs_state()
         self.registry = (registry if registry is not None
                          else st.registry if st is not None else Registry())
@@ -163,21 +197,27 @@ class ServeHost:
     def add_tenant(self, name: str, source, *,
                    policy: GuardPolicy | None = None,
                    max_pending: int | None = None,
-                   slo: SloPolicy | None = None) -> None:
+                   slo: SloPolicy | None = None,
+                   drift_band: float | None = None) -> None:
         """Register a tenant. ``source`` is a bundle directory (loaded
         lazily on first use, reloaded after an eviction) or an in-memory
         policy (``PolicyBundle`` / trained ``PipelineResult`` — retained,
         only the engine is rebuilt). Registration is cheap: no engine is
-        built until the first submit."""
+        built until the first submit. ``drift_band`` overrides the default
+        feature-drift trip band (``obs.quality.DEFAULT_DRIFT_BAND``) for a
+        policy whose bundle bakes a feature sketch; monitoring is skipped
+        entirely for policies without one."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending={max_pending} must be >= 1")
+        if drift_band is not None and drift_band <= 0:
+            raise ValueError(f"drift_band={drift_band} must be > 0")
         with self._lock:
             if self._closed:
                 raise RuntimeError("ServeHost is closed")
             if name in self._tenants:
                 raise ValueError(f"tenant {name!r} already registered")
             self._tenants[name] = _Tenant(name, source, policy, max_pending,
-                                          slo)
+                                          slo, drift_band)
 
     def _activate(self, name: str):
         """Touch ``name`` in the LRU, building its engine/batcher if cold.
@@ -214,6 +254,9 @@ class ServeHost:
                 engine = HedgeEngine(source, **self.engine_kwargs)
                 metrics = ServingMetrics(registry=self.registry,
                                          labels={"tenant": t.name})
+                drift = t.drift
+                if drift is None:
+                    drift = self._build_drift(t, source)
                 batcher = MicroBatcher(engine, metrics=metrics,
                                        policy=t.policy, **self.batcher_kwargs)
                 with self._lock:
@@ -224,11 +267,30 @@ class ServeHost:
                         raise RuntimeError("ServeHost is closed")
                     t.engine = engine
                     t.metrics = metrics
+                    t.drift = drift
                     t.batcher = batcher
                     t.activations += 1
                     evicted = self._sweep_locked(t)
                 obs_count("serve/tenant_activate", tenant=t.name)
         return t, batcher, evicted
+
+    def _build_drift(self, t: _Tenant, policy):
+        """The one definition of a tenant's drift monitor: built from the
+        policy's baked feature sketch (None without one — monitoring is
+        skipped, never faked), banded by the tenant's ``drift_band``
+        override, publishing into the host registry the scrape plane
+        serves. Shared by cold activation and hot reload so the two paths
+        can never configure monitors differently."""
+        sketch = getattr(policy, "feature_sketch", None)
+        if sketch is None:
+            return None
+        from orp_tpu.obs.quality import DEFAULT_DRIFT_BAND, DriftMonitor
+
+        return DriftMonitor(
+            sketch,
+            band=(t.drift_band if t.drift_band is not None
+                  else DEFAULT_DRIFT_BAND),
+            registry=self.registry, tenant=t.name)
 
     def _sweep_locked(self, current: _Tenant) -> list:
         """Unlink LRU tenants until the live-engine count is back at the
@@ -367,6 +429,18 @@ class ServeHost:
             if n_quota:
                 obs_count("guard/shed", n_quota, reason="quota",
                           tenant=t.name, lane="block")
+            if keep and t.drift is not None:
+                # model-health sketch: ONE vectorized fold of the admitted
+                # head per block (never per row — the monitoring twin of
+                # the ORP013 discipline); the drift_overhead bench phase
+                # gates this bill ≤ 5% of the columnar lane. FAIL-OPEN: a
+                # monitor error must never break the submit path (the
+                # pending quota above is already reserved, and serving
+                # outranks observing)
+                try:
+                    t.drift.update(feats[:keep])
+                except Exception:  # orp: noqa[ORP009] -- counted below; monitoring is advisory and must never take down the ingest lane
+                    obs_count("quality/drift_monitor_error", tenant=t.name)
             if keep == 0:
                 fut = SlimFuture()
                 fut.set_result(all_shed_result(
@@ -417,7 +491,9 @@ class ServeHost:
     # -- hot reload ----------------------------------------------------------
 
     def reload_tenant(self, name: str, source=None, *, canary_rows: int = 8,
-                      require_same_bits: bool = True) -> dict:
+                      require_same_bits: bool = True,
+                      quality_band: float | None = None,
+                      validation=None) -> dict:
         """Versioned hot bundle swap with a canary gate; the tenant never
         stops serving.
 
@@ -434,9 +510,30 @@ class ServeHost:
         tenant keeps serving the old bundle's bits untouched (the reject IS
         the rollback — nothing was swapped).
 
-        ``require_same_bits=False`` relaxes the gate to finiteness only —
-        the knob for rolling a genuinely RETRAINED policy, where different
-        bits are the point.
+        ``require_same_bits=False`` relaxes the bitwise pin — the knob for
+        rolling a genuinely RETRAINED policy, where different bits are the
+        point. Alone it leaves only the finiteness check, which accepts ANY
+        finite policy however wrong its hedges — so doing it without a
+        ``quality_band`` warns once per tenant and emits
+        ``guard/canary_unguarded`` (the silently-relaxed gate is now
+        observable).
+
+        ``quality_band`` — the QUANTITATIVE acceptance gate: candidate and
+        incumbent each replay the pinned validation scenario set
+        (``validation=`` or the candidate bundle's baked
+        ``ValidationSpec``) OFF-TRAFFIC through
+        :func:`orp_tpu.obs.quality.evaluate_quality` — same scrambles for
+        both, so the comparison is paired and Monte-Carlo noise cancels —
+        and a candidate whose aggregate hedge error regresses more than
+        ``quality_band`` (relative: 0.05 = +5%) is rejected
+        (``guard/canary_reject{stage="quality"}``) with the incumbent's
+        bits untouched. This is the gate a retrained policy must pass:
+        different bits allowed, worse hedging not.
+
+        Every verdict — promote and reject — appends to the promotions
+        manifest chain (``obs.chain_append``; ``promotion_chain`` ctor arg,
+        else the active telemetry session's bundle dir), so the serving
+        history is an auditable hash-linked ledger.
 
         On a pass: the new batcher is installed atomically (the swap waits
         for in-flight submit claims, so no request lands on a dead
@@ -444,10 +541,39 @@ class ServeHost:
         still resolve through the old engine, shed policies still apply —
         and the tenant's version bumps (``serve/bundle_swap``).
         """
+        if quality_band is not None and quality_band < 0:
+            raise ValueError(f"quality_band={quality_band} must be >= 0 "
+                             "(0 = no regression tolerated at all)")
+        if validation is not None and quality_band is None:
+            # the caller clearly wants the quality gate — dropping their
+            # validation set silently and promoting on finiteness alone is
+            # exactly the surprise this gate exists to remove
+            raise ValueError(
+                "validation= was passed without quality_band= — the "
+                "validation set is only consumed by the quality gate; pass "
+                "quality_band=<max relative hedge-error regression> to arm "
+                "it")
         with self._lock:
             if name not in self._tenants:
                 raise KeyError(f"unknown tenant {name!r}; registered: "
                                f"{sorted(self._tenants)}")
+        if not require_same_bits and quality_band is None:
+            # the finiteness-only promotion path: legal (a retrain may have
+            # no validation set yet) but no longer SILENT — the gate that
+            # accepts any finite policy is itself an observable event
+            obs_count("guard/canary_unguarded", tenant=name)
+            flight.record("canary_unguarded", tenant=name)
+            if name not in _UNGUARDED_WARNED:
+                _UNGUARDED_WARNED.add(name)
+                warnings.warn(
+                    f"reload_tenant({name!r}, require_same_bits=False) "
+                    "without a quality_band: the canary gate is relaxed to "
+                    "FINITENESS ONLY — any finite candidate passes, however "
+                    "wrong its hedge ratios. Pass quality_band= (the "
+                    "hedge-error regression gate over the bundle's pinned "
+                    "validation set) for retrained policies",
+                    stacklevel=2,
+                )
         # the OLD engine's bits are the canary pin: activate if cold, then
         # CLAIM the tenant (in_submit, the same token a submit holds) so a
         # concurrent activation's LRU sweep cannot evict it — and null
@@ -498,11 +624,20 @@ class ServeHost:
             try:
                 policy = load_bundle(policy)
             except (ValueError, OSError) as e:
-                obs_count("guard/canary_reject", tenant=name, stage="load")
-                flight.record("canary_reject", tenant=name, stage="load")
-                raise CanaryRejected(
-                    f"tenant {name!r}: candidate bundle failed to load "
-                    f"({e}); serving is untouched") from e
+                self._canary_reject(
+                    name, f"candidate bundle failed to load ({e})",
+                    stage="load", cause=e)
+        quality = None
+        spec = None
+        if quality_band is not None:
+            spec = validation if validation is not None else getattr(
+                policy, "validation", None)
+            if spec is None:
+                raise ValueError(
+                    f"tenant {name!r}: quality_band={quality_band} needs a "
+                    "pinned validation set — pass validation="
+                    "ValidationSpec(...) or re-export the candidate bundle "
+                    "with the current code (`orp export` bakes one)")
         inj = _inject.active()
         if inj is not None:
             # chaos harness (guard/inject.py): bundle corruption mid-reload
@@ -515,15 +650,75 @@ class ServeHost:
                 phi, psi, _v = engine.evaluate(d, probe)
                 if not (np.isfinite(phi).all() and np.isfinite(psi).all()):
                     self._canary_reject(name, f"non-finite outputs at date "
-                                              f"{d}")
+                                              f"{d}", stage="finiteness")
                 if require_same_bits and not (
                         np.array_equal(phi, pphi)
                         and np.array_equal(psi, ppsi)):
                     self._canary_reject(
                         name, f"probe bits diverged at date {d} "
                               "(corrupted or foreign candidate)")
-            batcher = MicroBatcher(engine, metrics=t.metrics,
-                                   policy=t.policy, **self.batcher_kwargs)
+        if quality_band is not None:
+            from orp_tpu.obs.quality import evaluate_quality
+
+            # OUTSIDE the build lock: the full RQMC replays take seconds,
+            # and a concurrent cold re-activation of this tenant serializes
+            # on build_lock — only engine construction belongs under it.
+            # Both replays run AFTER the cheap gates (load, finiteness,
+            # bits) so a candidate they already reject never bills the
+            # expensive evaluation. The incumbent publishes its gauges into
+            # the live registry (it IS the serving policy); the candidate's
+            # go to a THROWAWAY registry — a possibly-rejected candidate's
+            # numbers must never land in the live scrape as the tenant's
+            # serving series (the chain/exception carry them for audit).
+            # The spec usually comes from the CANDIDATE, so a retrain that
+            # changed the rebalance grid or feature count fails at the
+            # incumbent's evaluation — a failed promotion, recorded like
+            # every other verdict
+            try:
+                inc_rec = evaluate_quality(engine=old_engine, spec=spec,
+                                           registry=self.registry,
+                                           tenant=name)
+            except (ValueError, RuntimeError) as e:
+                self._canary_reject(
+                    name, "the candidate's pinned validation set does not "
+                          f"fit the serving incumbent ({e})",
+                    stage="quality", cause=e)
+            try:
+                cand_rec = evaluate_quality(engine=engine, spec=spec,
+                                            registry=Registry())
+            except (ValueError, RuntimeError) as e:
+                # spec mismatch OR a runtime failure of the candidate's own
+                # dispatch (the doctor probe catches the same pair): either
+                # way a failed promotion, recorded like every other verdict
+                self._canary_reject(
+                    name, f"candidate cannot run the pinned validation set "
+                          f"({e})", stage="quality", cause=e)
+            inc_err = inc_rec["hedge_error"]["mean"]
+            cand_err = cand_rec["hedge_error"]["mean"]
+            regression = (cand_err - inc_err) / max(inc_err, 1e-12)
+            quality = {
+                "band": float(quality_band),
+                "validation_fingerprint": spec.fingerprint(),
+                "incumbent": inc_rec["hedge_error"],
+                "candidate": cand_rec["hedge_error"],
+                "regression": round(float(regression), 6),
+            }
+            if regression > quality_band:
+                self._canary_reject(
+                    name,
+                    f"hedge-error regression {regression:+.2%} exceeds "
+                    f"the quality band {quality_band:+.2%} (incumbent "
+                    f"{inc_err:.6g} -> candidate {cand_err:.6g} ± "
+                    f"{cand_rec['hedge_error']['ci95']:.2g} on the "
+                    "pinned validation set)",
+                    stage="quality", quality=quality)
+        batcher = MicroBatcher(engine, metrics=t.metrics,
+                               policy=t.policy, **self.batcher_kwargs)
+        # a promoted candidate's baked sketch is the NEW drift baseline (a
+        # retrain's training distribution is the reference its serving
+        # traffic should be compared against); a sketch-less candidate
+        # keeps the old monitor — stale beats blind
+        new_drift = self._build_drift(t, policy)
         stalled = False
         evicted2: list = []
         with self._lock:
@@ -548,6 +743,8 @@ class ServeHost:
                     t.batcher = batcher
                     t.engine = engine
                     t.source = new_source
+                    if new_drift is not None:
+                        t.drift = new_drift
                     t.version += 1
                     version = t.version
                     # the tenant may have been EVICTED between the canary
@@ -564,25 +761,84 @@ class ServeHost:
                 "5s swap window; reload aborted (the tenant keeps serving "
                 "the previous bundle — retry the reload)")
         obs_count("serve/bundle_swap", tenant=name)
+        if quality is not None:
+            # the live quality gauges must describe the SERVING policy:
+            # re-publish the promoted candidate's record over the retired
+            # incumbent's numbers
+            from orp_tpu.obs.quality import publish_quality
+
+            publish_quality(cand_rec, self.registry, tenant=name)
+        self._chain_verdict(name, action="promote", version=version,
+                            require_same_bits=bool(require_same_bits),
+                            source=str(new_source),
+                            **({"quality": quality} if quality else {}))
         for victim in (*evicted2, *(() if old_batcher is None
                                     else (old_batcher,))):
             # drain OUTSIDE every lock: the old queue resolves through the
             # old engine (guard sheds still apply), done-callbacks may
             # re-enter the host
             victim.close()
-        return {"tenant": name, "version": version, "swapped": True,
-                "canary_rows": int(canary_rows), "canary_dates": dates,
-                "require_same_bits": bool(require_same_bits)}
+        out = {"tenant": name, "version": version, "swapped": True,
+               "canary_rows": int(canary_rows), "canary_dates": dates,
+               "require_same_bits": bool(require_same_bits)}
+        if quality is not None:
+            out["quality"] = quality
+        return out
 
-    def _canary_reject(self, name: str, why: str):
-        obs_count("guard/canary_reject", tenant=name, stage="bits")
-        flight.record("canary_reject", tenant=name, stage="bits", why=why)
+    def _chain_path(self):
+        """Resolve where promotion verdicts chain to: the ctor arg, else the
+        active telemetry session's bundle dir, else nowhere (None)."""
+        if self.promotion_chain is not None:
+            return self.promotion_chain
+        st = obs_state()
+        if st is not None and getattr(st, "export_dir", None) is not None:
+            import pathlib
+
+            from orp_tpu.obs.manifest import CHAIN_FILE
+
+            return pathlib.Path(st.export_dir) / CHAIN_FILE
+        return None
+
+    def _chain_verdict(self, name: str, **record) -> None:
+        """Append one promotion verdict to the manifest chain (no-op when
+        no chain is configured and no telemetry session exports). A chain
+        WRITE failure must never change a reload's outcome — the promote
+        path runs after the swap already took traffic, and a reject must
+        surface as CanaryRejected, not as the audit log's OSError — so it
+        degrades to a warning + counter instead of raising."""
+        path = self._chain_path()
+        if path is None:
+            return
+        from orp_tpu.obs.manifest import chain_append
+
+        try:
+            chain_append(path, {"tenant": name, **record})
+        except OSError as e:
+            obs_count("quality/chain_error", tenant=name)
+            warnings.warn(
+                f"promotions chain {path}: append failed ({e}) — the "
+                f"{record.get('action', 'verdict')} itself is unaffected, "
+                "but the audit ledger is missing this entry",
+                stacklevel=3,
+            )
+
+    def _canary_reject(self, name: str, why: str, *, stage: str = "bits",
+                       quality: dict | None = None, cause=None):
+        """The ONE reject path every canary stage (load, bits, finiteness,
+        quality) routes through: counter + flight record + chain verdict +
+        warning + ``CanaryRejected`` (chained from ``cause`` when the
+        reject wraps an underlying exception)."""
+        obs_count("guard/canary_reject", tenant=name, stage=stage)
+        flight.record("canary_reject", tenant=name, stage=stage, why=why)
+        self._chain_verdict(name, action="reject", stage=stage, why=why,
+                            **({"quality": quality} if quality else {}))
         warnings.warn(
             f"hot reload of tenant {name!r} REJECTED by the canary gate "
             f"({why}); the tenant keeps serving the previous bundle",
             stacklevel=3,
         )
-        raise CanaryRejected(f"tenant {name!r}: {why}; serving is untouched")
+        raise CanaryRejected(
+            f"tenant {name!r}: {why}; serving is untouched") from cause
 
     def evaluate(self, tenant: str, date_idx: int, states, prices=None):
         """Synchronous convenience: ``submit(...).result()``."""
@@ -603,6 +859,8 @@ class ServeHost:
                     "version": t.version,
                     **({"summary": t.metrics.summary()}
                        if t.metrics is not None else {}),
+                    **({"drift": t.drift.scores()}
+                       if t.drift is not None else {}),
                 }
                 for t in self._tenants.values()
             }

@@ -159,6 +159,12 @@ def top_snapshot(text: str, *, previous: dict | None = None,
     for labels, value in series.get("serve_rows_total", ()):
         key = labels.get("tenant") or labels.get("phase") or ""
         tenants.setdefault(key, {})["rows"] = value
+    # model-health drift (quality/drift_max{tenant} gauges set by the block
+    # lane's per-tenant DriftMonitor): a tenant serving perfect p99 with a
+    # drifted input distribution shows it HERE, not in the latency columns
+    for labels, value in series.get("quality_drift_max", ()):
+        key = labels.get("tenant") or ""
+        tenants.setdefault(key, {})["drift"] = round(value, 3)
     for key, t in tenants.items():
         want = ({"tenant": key} if any(
             lb.get("tenant") == key
@@ -218,7 +224,8 @@ def render_top(snap: dict, *, target: str = "") -> str:
     tenants = snap.get("tenants") or {}
     if tenants:
         lines.append(f"{'tenant':<16}{'requests':>12}{'rows':>12}"
-                     f"{'pending':>9}{'p50 ms':>10}{'p99 ms':>10}")
+                     f"{'pending':>9}{'p50 ms':>10}{'p99 ms':>10}"
+                     f"{'drift':>8}")
         for name in sorted(tenants):
             t = tenants[name]
 
@@ -231,5 +238,6 @@ def render_top(snap: dict, *, target: str = "") -> str:
                 f"{cell(t.get('rows'), ',.0f'):>12}"
                 f"{cell(t.get('pending'), ',.0f'):>9}"
                 f"{cell(t.get('p50_ms'), '.3f'):>10}"
-                f"{cell(t.get('p99_ms'), '.3f'):>10}")
+                f"{cell(t.get('p99_ms'), '.3f'):>10}"
+                f"{cell(t.get('drift'), '.3f'):>8}")
     return "\n".join(lines)

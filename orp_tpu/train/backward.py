@@ -53,7 +53,9 @@ from orp_tpu.guard import inject as _inject
 from orp_tpu.guard import sentinel as _sentinel
 from orp_tpu.models.mlp import HedgeMLP
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import emit_record as obs_emit_record
 from orp_tpu.obs import enabled as obs_enabled
+from orp_tpu.obs import set_gauge as obs_set_gauge
 from orp_tpu.obs import span as obs_span
 from orp_tpu.obs import spanned as obs_spanned
 from orp_tpu.utils.precision import highest_matmul_precision
@@ -712,7 +714,51 @@ def backward_induction(
         sp.set_result(res.values)
     for name, delta in audit.deltas().items():
         obs_count("train/xla_compiles", delta, fn=name)
+    _emit_convergence(res, cfg, model, features, y_prices, b_prices)
     return res
+
+
+def _emit_convergence(res: "BackwardResult", cfg: BackwardConfig, model,
+                      features, y_prices, b_prices) -> None:
+    """Training-side convergence telemetry (obs-enabled walks only): ONE
+    ``train/convergence`` record into the session sink carrying the
+    per-date loss/mae/mape trajectories, the epochs-or-iterations each
+    date's fit consumed, the configured trainer rung (the sentinel's
+    ``guard/degrade{date,to}`` counter events overlay any per-date ladder
+    demotions — ``orp report`` merges the two), and — for Gauss-Newton
+    walks — the per-date GN Gram condition number at the FITTED params
+    (``train/gn.gram_cond``; also ``train/gram_cond{date}`` gauges), the
+    number that explains a stalled LM trajectory without a rerun. Rendered
+    by ``orp report``."""
+    payload = {
+        "optimizer": cfg.optimizer,
+        "dual_mode": cfg.dual_mode,
+        "fused": bool(cfg.fused),
+        "nan_guard": bool(cfg.nan_guard),
+        "n_dates": int(res.train_loss.shape[0]),
+        "train_loss": [float(x) for x in res.train_loss],
+        "train_mae": [float(x) for x in res.train_mae],
+        "train_mape": [float(x) for x in res.train_mape],
+        "epochs_ran": [int(x) for x in res.epochs_ran],
+    }
+    if cfg.optimizer == "gauss_newton" and res.params1_by_date is not None:
+        from orp_tpu.train.gn import gram_cond
+
+        m = min(int(y_prices.shape[0]), 2048)
+        prices_all = _stack_prices(
+            jnp.asarray(y_prices[:m], model.dtype),
+            jnp.asarray(b_prices, model.dtype))
+        conds = []
+        for d in range(payload["n_dates"]):
+            p_d = jax.tree.map(lambda x: x[d], res.params1_by_date)
+            # the Gram the date's fit solved: features at t, prices at t+1
+            # (the regression's design — see _date_body's fit call)
+            c = gram_cond(model, p_d, jnp.asarray(features[:m, d]),
+                          prices_all[:, d + 1])
+            conds.append(round(float(c), 3))
+            obs_set_gauge("train/gram_cond", float(c), date=str(d))
+        payload["gram_cond"] = conds
+    obs_emit_record("train/convergence", payload)
 
 
 def _walk_impl(

@@ -40,10 +40,12 @@ No reference analogue — the reference trains everything with Keras Adam
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from orp_tpu.utils.precision import highest_matmul_precision
@@ -315,3 +317,44 @@ def fit_gn_pinball(
         value_fn=value_fn, loss_fn=loss_fn, cfg=cfg, weight_fn=weight_fn,
         metric_fns=metric_fns, solve_fn=None,
     )
+
+
+# -- convergence diagnostics ---------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _gram_eigs(model, params, feats, prices):
+    """Eigenvalues of the per-sample value-gradient Gram ``JᵀJ/n`` at
+    ``params`` — the matrix whose (damped) normal equations every GN
+    iteration solves. One vmap'd gradient + one PxP ``eigvalsh``; full-f32
+    matmul like the fit itself (normal equations square the condition
+    number — the SCALING.md §6b lesson)."""
+    theta, unravel = ravel_pytree(params)
+
+    def one(f, p):
+        return jax.grad(
+            lambda t: model.value(unravel(t), f[None], p[None])[0]
+        )(theta)
+
+    with jax.default_matmul_precision("highest"):
+        J = jax.vmap(one)(feats, prices)
+        G = J.T @ J / feats.shape[0]
+    return jnp.linalg.eigvalsh(G)
+
+
+def gram_cond(model, params, feats, prices, *, max_rows: int = 2048) -> float:
+    """Condition number of the GN Gram at ``params`` over (at most
+    ``max_rows`` of) the date's fit inputs — the convergence-telemetry
+    number ``train/convergence`` records per date: a Gram running into
+    f32's ~1e7 usable conditioning explains a stalled or erratic LM
+    trajectory before anyone reruns the walk under a debugger."""
+    eigs = np.asarray(_gram_eigs(model, params, feats[:max_rows],
+                                 prices[:max_rows]), np.float64)
+    top = float(eigs[-1])
+    if top <= 0.0:
+        return float("inf")
+    # floor the bottom eigenvalue at top*1e-12: a Gram whose spectrum spans
+    # more than 12 decades is numerically singular in f32 either way, and a
+    # capped 1e12 reads as exactly that instead of a meaningless 1e30
+    bottom = max(float(eigs[0]), top * 1e-12)
+    return top / bottom

@@ -415,6 +415,15 @@ def test_cli_sigterm_drain_removes_ready_file(tmp_path, trained):
         stop = threading.Event()
         with ResilientGatewayClient(addr, port) as rc:
             fut = rc.submit_block_async("d", 0, _blocks(1, rows=8)[0])
+            # under container load the drain can outrun the frame's
+            # ADMISSION — wait until the block reached the host (the HELLO
+            # handshake also counts a "frame", so gate on submitted_frames),
+            # so the pin tests "an in-flight reply flushes through the
+            # drain" and not "a late frame races a closed listener"
+            deadline = time.perf_counter() + 10
+            while (gw.totals()["submitted_frames"] < 1
+                   and time.perf_counter() < deadline):
+                time.sleep(0.005)
             _gateway_shutdown(gw, str(ready), stop)
             # the in-flight frame's reply flushed through the drain
             assert fut.result(timeout=10).n_served == 8

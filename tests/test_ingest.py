@@ -560,6 +560,17 @@ def test_cli_serve_bench_ingest_quick_smoke(tmp_path, capsys, trained):
     # all three lanes measured at every block size
     assert [c["block"] for c in ing["columnar"]] == ing["block_sizes"]
     assert [g["block"] for g in ing["gateway"]] == ing["block_sizes"]
+    # model-health riders: the drift-monitoring bill is measured AND under
+    # its gate (the command would have failed otherwise), and the bundle's
+    # baked validation set produced an orp-quality-v1 record with an honest
+    # (nonzero) RQMC confidence interval
+    drift = ing["drift_overhead"]
+    assert drift["overhead_pct"] == rec["drift_overhead_pct"]
+    assert 0 < drift["overhead_pct"] <= drift["gate_pct"]
+    q = rec["quality"]
+    assert q["schema"] == "orp-quality-v1"
+    assert q["hedge_error"]["mean"] > 0 and q["hedge_error"]["ci95"] > 0
+    assert len(q["per_date"]) == q["n_dates"]
 
 
 def test_cli_serve_gateway_ready_file_and_drain(tmp_path, trained):

@@ -26,7 +26,7 @@ def codes(src, **kw):
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
-                             "ORP014", "ORP015"})
+                             "ORP014", "ORP015", "ORP016"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1066,6 +1066,93 @@ def test_orp015_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/aot/compile.py") == []
+
+
+# -- ORP016: unrecorded numeric acceptance gates ------------------------------
+
+ORP016_POS = """
+    class GateRejected(RuntimeError):
+        pass
+
+    def quality_gate(candidate_err, incumbent_err, band):
+        regression = (candidate_err - incumbent_err) / incumbent_err
+        if regression > band:
+            # verdict on a measured float, nothing recorded: flagged
+            raise GateRejected(f"regression {regression}")
+
+    def admission_gate(queue_age, budget):
+        if queue_age >= budget:
+            return Rejection(reason="deadline")
+        return None
+
+    def inverted_gate(err, band):
+        # the verdict hides in the ELSE branch of the measured compare
+        if err <= band:
+            return None
+        else:
+            raise GateRejected("regressed")
+"""
+
+ORP016_NEG = """
+    from orp_tpu.obs import count as obs_count
+    from orp_tpu.obs import flight
+
+    class GateRejected(RuntimeError):
+        pass
+
+    def quality_gate(candidate_err, incumbent_err, band):
+        regression = (candidate_err - incumbent_err) / incumbent_err
+        if regression > band:
+            # the measurement reaches obs BEFORE the verdict: clean
+            obs_count("quality/gate_trip", gate="band")
+            raise GateRejected(f"regression {regression}")
+
+    def admission_gate(queue_age, budget):
+        flight.record("shed", age=queue_age)
+        if queue_age >= budget:
+            return Rejection(reason="deadline")
+        return None
+
+    def validate(max_pending):
+        # compare-then-raise of a VALIDATION type is input checking
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+
+    def decode(n_rows, cap):
+        # WireError is the wire plane's ValueError: malformed-frame bounds
+        if n_rows > cap:
+            raise WireError("too many rows")
+"""
+
+
+def test_orp016_flags_unrecorded_gates():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP016_POS),
+                                       path="orp_tpu/serve/host.py")]
+    # the raise, the Rejection return, and the else-branch raise
+    assert got.count("ORP016") == 3
+
+
+def test_orp016_clean_negative():
+    assert lint_source(textwrap.dedent(ORP016_NEG),
+                       path="orp_tpu/serve/host.py") == []
+
+
+def test_orp016_scoped_to_serve_and_guard():
+    assert lint_source(textwrap.dedent(ORP016_POS),
+                       path="orp_tpu/risk/surface.py") == []
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP016_POS),
+                                       path="orp_tpu/guard/serve.py")]
+    assert got.count("ORP016") == 3
+
+
+def test_orp016_noqa_suppresses():
+    src = """
+        def stall_gate(waited, wall):
+            if waited > wall:
+                raise FrameStall("stalled")  # orp: noqa[ORP016] -- the catcher emits the eviction counter
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/gateway.py") == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -1,0 +1,469 @@
+"""Model-health plane oracles (orp_tpu/obs/quality.py + the serve wiring):
+the hedge-quality estimator is bit-for-bit reproducible under a fixed
+scramble seed with honest nonzero RQMC CIs; ``orp export`` bakes the
+per-feature baseline sketch + pinned validation set and ``load_bundle``
+round-trips them; drifted block-lane traffic trips the flight recorder
+while undrifted traffic stays silent; a param-perturbed candidate that
+PASSES the finiteness-only gate is REJECTED by the quality band with the
+incumbent's bits untouched; every verdict lands on the hash-linked
+promotions chain; the ``orp doctor --quality`` probe and ``orp report``
+close the loop."""
+
+import dataclasses
+import json
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from orp_tpu import obs
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.obs import flight
+from orp_tpu.obs.manifest import chain_append, chain_verify, read_chain
+from orp_tpu.obs.quality import (DriftMonitor, FeatureSketch, ValidationSpec,
+                                 evaluate_quality, validate_quality_record)
+from orp_tpu.serve import ServeHost, export_bundle, load_bundle
+from orp_tpu.serve.host import CanaryRejected
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+# small but honest: 4 replicates x 256 paths keeps the estimator tier-1
+# cheap while the CI stays a real across-replicate spread
+SPEC = ValidationSpec(kind="gbm", n_steps=8, rebalance_every=2,
+                      n_paths=256, replicates=4)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(scope="module")
+def bundle_dir(trained, tmp_path_factory):
+    d = tmp_path_factory.mktemp("quality") / "bundle"
+    export_bundle(trained, d)
+    return d
+
+
+def _degraded(bundle):
+    """A finite-but-wrong candidate: sign-flipped per-date params — every
+    hedge ratio inverted, so the policy ADDS risk instead of removing it
+    (measured ~+60% hedge error on the validation set) while every output
+    stays finite: exactly the candidate the finiteness-only gate cannot
+    catch."""
+    bw = bundle.backward
+    flipped = jax.tree.map(lambda x: -x, bw.params1_by_date)
+    return dataclasses.replace(
+        bundle, backward=dataclasses.replace(bw, params1_by_date=flipped))
+
+
+# -- the estimator ------------------------------------------------------------
+
+
+def test_quality_estimator_reproducible_bit_for_bit(trained):
+    """Fixed spec + fixed scramble seed -> the whole record (means, CIs,
+    per-date rows) reproduces EXACTLY: the estimator is deterministic
+    Owen-scrambled RQMC over the serving forward, not a noisy sample."""
+    a = evaluate_quality(trained, SPEC)
+    b = evaluate_quality(trained, SPEC)
+    assert a == b
+    assert validate_quality_record(a) == []
+    assert a["hedge_error"]["mean"] > 0
+    assert a["hedge_error"]["ci95"] > 0          # honest replicate spread
+    assert a["validation_fingerprint"] == SPEC.fingerprint()
+    # hedging must REDUCE risk date over date: the per-date column is the
+    # residual after trading through date d, so it ends below the unhedged
+    # payoff risk
+    assert a["per_date"][-1]["mean"] < a["unhedged"]["mean"]
+
+
+def test_quality_record_schema_survives_the_sink(trained, tmp_path):
+    """The bundle copy of the record keeps its orp-quality-v1 tag: the sink
+    stamps ITS schema (orp-obs-v1) on the event envelope, so the record
+    nests under "record" instead of being re-stamped."""
+    with obs.telemetry(tmp_path):
+        evaluate_quality(trained, SPEC)
+    events = obs.read_events(tmp_path / "events.jsonl")
+    recs = [e for e in events if e.get("type") == "record"
+            and e.get("name") == "quality/hedge_error"]
+    assert recs
+    assert recs[-1]["schema"] == "orp-obs-v1"          # the envelope
+    assert validate_quality_record(recs[-1]["record"]) == []  # the payload
+
+
+def test_quality_estimator_refuses_mismatched_specs(trained):
+    with pytest.raises(ValueError, match="rebalance dates"):
+        evaluate_quality(trained, dataclasses.replace(SPEC, n_steps=16))
+    with pytest.raises(ValueError, match="feature"):
+        evaluate_quality(trained,
+                         dataclasses.replace(SPEC, kind="heston-qe"))
+    with pytest.raises(ValueError, match="pinned validation set"):
+        evaluate_quality(dataclasses.replace(trained, validation=None))
+
+
+def test_export_bakes_baseline_and_validation(trained, bundle_dir):
+    """The bundle carries the model-health baseline: per-feature sketch of
+    the TRAINING features, the pinned validation set (fingerprint-stable
+    across export/load), and the training-time hedge-error level."""
+    b = load_bundle(bundle_dir)
+    assert b.feature_sketch is not None
+    assert b.feature_sketch.n_features == 1
+    assert b.feature_sketch.count == SIM.n_paths * 5  # paths x knots
+    # the sketch describes moneyness-normalised features: mean near 1
+    assert 0.8 < b.feature_sketch.mean[0] < 1.3
+    assert b.validation.fingerprint() == trained.validation.fingerprint()
+    assert b.validation.n_dates == 4
+    assert b.hedge_error_baseline is not None and b.hedge_error_baseline > 0
+    # baked baseline (in-sample cv_std, normalised) and the validation-set
+    # estimate measure the same objective — they must agree to leading order
+    rec = evaluate_quality(b, SPEC)
+    assert abs(rec["hedge_error"]["mean"] - b.hedge_error_baseline) \
+        < 0.5 * b.hedge_error_baseline
+
+
+# -- serve-time drift ---------------------------------------------------------
+
+
+def _traffic(sketch, n, shift_sigmas=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    mean = np.asarray(sketch.mean) + shift_sigmas * np.asarray(sketch.std)
+    return (mean + np.asarray(sketch.std)
+            * rng.standard_normal((n, sketch.n_features))).astype(np.float32)
+
+
+def test_undrifted_traffic_stays_silent(bundle_dir):
+    """Chaos clean-path pin: traffic drawn from the TRAINING distribution
+    trips nothing — no drift_trip counter, no flight TRIP, score well
+    under the band."""
+    flight.RECORDER.reset()
+    with ServeHost() as host:
+        host.add_tenant("clean", bundle_dir)
+        b = load_bundle(bundle_dir)
+        for i in range(6):
+            out = host.submit_block(
+                "clean", 0,
+                _traffic(b.feature_sketch, 512, seed=i)).result()
+            assert out.n_served == 512
+        drift = host.stats()["clean"]["drift"]
+    assert drift["rows"] == 6 * 512
+    assert drift["score"] < 0.5 * drift["band"]
+    assert drift["tripped"] is False and drift["trips"] == 0
+    assert all(e["kind"] != "drift_trip" for e in flight.RECORDER.snapshot())
+
+
+def test_drifted_traffic_trips_flight_recorder(bundle_dir, tmp_path):
+    """Chaos pin: a 5-baseline-sigma mean shift on the block lane breaches
+    the band -> ONE quality/drift_trip, a flight-recorder TRIP event, and
+    (armed) an auto-dumped black box whose last events are the evidence;
+    the drift gauges surface through the host registry the scrape plane
+    serves."""
+    flight.RECORDER.reset()
+    flight.RECORDER.arm(tmp_path)
+    try:
+        with obs.active() as st, ServeHost(registry=st.registry) as host:
+            host.add_tenant("drifty", bundle_dir)
+            b = load_bundle(bundle_dir)
+            for i in range(4):
+                host.submit_block(
+                    "drifty", 0,
+                    _traffic(b.feature_sketch, 256, shift_sigmas=5.0,
+                             seed=10 + i)).result()
+            drift = host.stats()["drifty"]["drift"]
+            assert drift["score"] > drift["band"]
+            assert drift["tripped"] is True and drift["trips"] == 1
+            trip_counter = st.registry.counter("quality/drift_trip",
+                                               {"tenant": "drifty"})
+            assert trip_counter.value == 1
+            # the gauges ride the SAME registry the METRICS scrape serves
+            gmax = st.registry.gauge("quality/drift_max",
+                                     {"tenant": "drifty"})
+            assert gmax.value > drift["band"]
+    finally:
+        flight.RECORDER.disarm()
+    trips = [e for e in flight.RECORDER.snapshot()
+             if e["kind"] == "drift_trip"]
+    assert len(trips) == 1 and trips[0]["tenant"] == "drifty"
+    # TRIP-class: the armed ring auto-dumped the black box
+    dumped = flight.read_flight(tmp_path / "flight.jsonl")
+    assert any(e.get("kind") == "drift_trip" for e in dumped)
+
+
+def test_drift_scores_reach_orp_top(bundle_dir):
+    """quality/drift_max{tenant} rides the exposition into the `orp top`
+    per-tenant table (the drift column)."""
+    from orp_tpu.obs.sink import prometheus_text
+    from orp_tpu.serve.scrape import render_top, top_snapshot
+
+    with obs.active() as st, ServeHost(registry=st.registry) as host:
+        host.add_tenant("desk", bundle_dir)
+        b = load_bundle(bundle_dir)
+        host.submit_block("desk", 0,
+                          _traffic(b.feature_sketch, 512, shift_sigmas=3.0,
+                                   seed=3)).result()
+        snap = top_snapshot(prometheus_text(st.registry))
+    assert snap["tenants"]["desk"]["drift"] > 1.0
+    screen = render_top(snap, target="test:0")
+    assert "drift" in screen and "desk" in screen
+
+
+# -- the quantitative canary gate ---------------------------------------------
+
+
+def test_quality_band_rejects_what_finiteness_accepts(bundle_dir, tmp_path):
+    """THE acceptance pin: a param-perturbed candidate whose outputs are all
+    finite (the old require_same_bits=False gate accepts it) regresses
+    hedge error far outside the band and is REJECTED — incumbent bits,
+    version and serving state untouched; then the SAME candidate sails
+    through the finiteness-only gate, proving the band is what caught it.
+    Both verdicts land on the promotions chain, hash links intact."""
+    chain = tmp_path / "promotions.jsonl"
+    bad = _degraded(load_bundle(bundle_dir))
+    probe = (1.0 + 0.05 * np.random.default_rng(11)
+             .standard_normal((8, 1))).astype(np.float32)
+    with ServeHost(promotion_chain=chain) as host:
+        host.add_tenant("t", bundle_dir)
+        pre = host.evaluate("t", 0, probe)
+        with pytest.raises(CanaryRejected, match="hedge-error regression"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                host.reload_tenant("t", bad, require_same_bits=False,
+                                   quality_band=0.25, validation=SPEC)
+        post = host.evaluate("t", 0, probe)
+        for a, b in zip(pre, post):
+            if a is not None:
+                np.testing.assert_array_equal(a, b)
+        assert host.stats()["t"]["version"] == 1  # the reject IS the rollback
+        # the SAME candidate passes finiteness-only — the silent hole the
+        # band closes (and the unguarded path is itself observable now)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = host.reload_tenant("t", bad, require_same_bits=False)
+        assert out["swapped"] is True
+        assert host.stats()["t"]["version"] == 2
+    verdicts = read_chain(chain)
+    assert [(v["action"], v.get("stage")) for v in verdicts] == [
+        ("reject", "quality"), ("promote", None)]
+    assert verdicts[0]["quality"]["regression"] > 0.25
+    assert verdicts[0]["quality"]["incumbent"]["ci95"] > 0
+    assert chain_verify(chain)["ok"] is True
+
+
+def test_quality_band_passes_identical_candidate(bundle_dir, tmp_path):
+    """Zero-regression candidate (the same bundle) passes any band — and the
+    paired design makes the measured regression EXACTLY zero, not noise."""
+    with ServeHost(promotion_chain=tmp_path / "c.jsonl") as host:
+        host.add_tenant("t", bundle_dir)
+        host.evaluate("t", 0, np.ones((4, 1), np.float32))
+        out = host.reload_tenant("t", str(bundle_dir), quality_band=0.0,
+                                 validation=SPEC)
+    assert out["swapped"] is True
+    assert out["quality"]["regression"] == 0.0
+
+
+def test_unguarded_reload_warns_once_and_counts(bundle_dir):
+    """Satellite pin: require_same_bits=False WITHOUT a quality_band warns
+    once per tenant and emits guard/canary_unguarded every time — the
+    finiteness-only path is observable instead of silent."""
+    import orp_tpu.serve.host as host_mod
+
+    host_mod._UNGUARDED_WARNED.discard("u")
+    with obs.active() as st, ServeHost(registry=st.registry) as host:
+        host.add_tenant("u", bundle_dir)
+        host.evaluate("u", 0, np.ones((4, 1), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            host.reload_tenant("u", str(bundle_dir),
+                               require_same_bits=False)
+            host.reload_tenant("u", str(bundle_dir),
+                               require_same_bits=False)
+        unguarded = [x for x in w if "FINITENESS ONLY" in str(x.message)]
+        assert len(unguarded) == 1  # once per tenant
+        assert st.registry.counter(
+            "guard/canary_unguarded", {"tenant": "u"}).value == 2
+    assert any(e["kind"] == "canary_unguarded"
+               for e in flight.RECORDER.snapshot())
+
+
+def test_quality_band_without_validation_refuses_in_flagspeak(trained,
+                                                              bundle_dir):
+    no_spec = dataclasses.replace(load_bundle(bundle_dir), validation=None)
+    with ServeHost() as host:
+        host.add_tenant("t", bundle_dir)
+        host.evaluate("t", 0, np.ones((4, 1), np.float32))
+        with pytest.raises(ValueError, match="pinned validation set"):
+            host.reload_tenant("t", no_spec, require_same_bits=False,
+                               quality_band=0.1)
+
+
+# -- the promotions chain -----------------------------------------------------
+
+
+def test_chain_append_verify_and_tamper(tmp_path):
+    p = tmp_path / "chain.jsonl"
+    assert chain_verify(p) == {"ok": True, "length": 0, "problems": []}
+    chain_append(p, {"tenant": "a", "action": "promote", "version": 2})
+    chain_append(p, {"tenant": "a", "action": "reject", "stage": "bits"})
+    chain_append(p, {"tenant": "b", "action": "promote", "version": 2})
+    v = chain_verify(p)
+    assert v["ok"] is True and v["length"] == 3
+    recs = read_chain(p)
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+    assert recs[0]["prev"] == "genesis"
+    # EDIT a middle record in place -> the successor's hash link breaks
+    lines = p.read_text().splitlines()
+    lines[1] = lines[1].replace('"reject"', '"promote"')
+    p.write_text("\n".join(lines) + "\n")
+    v = chain_verify(p)
+    assert v["ok"] is False
+    assert any("link broken" in prob for prob in v["problems"])
+    # DROP a record -> seq + link both break
+    p.write_text("\n".join([lines[0], lines[2]]) + "\n")
+    assert chain_verify(p)["ok"] is False
+
+
+def test_chain_append_survives_torn_tail(tmp_path):
+    """A crash mid-append leaves a partial last line (possibly without its
+    newline). Later verdict appends must NOT raise — a reload's outcome is
+    never hostage to the audit log — and must not concatenate onto the torn
+    bytes; verify still reports the damage at the torn line."""
+    p = tmp_path / "chain.jsonl"
+    chain_append(p, {"tenant": "a", "action": "promote", "version": 2})
+    with open(p, "a") as f:
+        f.write('{"schema": "orp-chain-v1", "seq": 1, "tor')  # no newline
+    rec = chain_append(p, {"tenant": "a", "action": "reject",
+                           "stage": "quality"})
+    assert rec["seq"] == 2
+    lines = [ln for ln in p.read_text().splitlines() if ln]
+    assert json.loads(lines[-1])["action"] == "reject"  # not concatenated
+    v = chain_verify(p)     # the torn line is still reported
+    assert v["ok"] is False and v["length"] == 3
+
+
+# -- doctor + report ----------------------------------------------------------
+
+
+def test_doctor_quality_probe(bundle_dir, tmp_path):
+    """`orp doctor --quality BUNDLE`: passes on a baked bundle (parseable
+    record, nonzero CI, fingerprint shown), fails in flag-speak on a
+    pre-quality bundle missing the baseline."""
+    from orp_tpu.serve.health import doctor_report
+
+    rep = doctor_report(quality=str(bundle_dir))
+    row = next(c for c in rep["checks"] if c["check"] == "quality")
+    assert row["ok"] is True
+    assert "hedge_error" in row["detail"] and "RQMC" in row["detail"]
+    # a pre-quality bundle: same policy, baseline key stripped
+    import shutil
+
+    old = tmp_path / "old_bundle"
+    shutil.copytree(bundle_dir, old)
+    meta = json.loads((old / "bundle.json").read_text())
+    meta.pop("baseline")
+    (old / "bundle.json").write_text(json.dumps(meta, indent=1,
+                                                sort_keys=True))
+    rep = doctor_report(quality=str(old))
+    row = next(c for c in rep["checks"] if c["check"] == "quality")
+    assert row["ok"] is False
+    assert "re-export" in row["fix"]
+    assert rep["ok"] is False
+
+
+def test_convergence_telemetry_and_report_cli(tmp_path, capsys):
+    """Training-side convergence telemetry: a telemetered GN walk leaves ONE
+    train/convergence record (per-date loss trajectory, iterations, Gram
+    conditioning), and `orp report` renders it — rung column overlaid from
+    any guard/degrade events."""
+    from orp_tpu import cli
+
+    tdir = tmp_path / "bundle"
+    gn_train = TrainConfig(dual_mode="mse_only", optimizer="gauss_newton",
+                           gn_iters_first=6, gn_iters_warm=3)
+    small = dataclasses.replace(SIM, n_paths=256)
+    with obs.telemetry(tdir):
+        european_hedge(EURO, small, gn_train)
+    events = obs.read_events(tdir / "events.jsonl")
+    recs = [e for e in events if e.get("type") == "record"
+            and e.get("name") == "train/convergence"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["optimizer"] == "gauss_newton"
+    assert len(rec["train_loss"]) == rec["n_dates"] == 4
+    assert len(rec["gram_cond"]) == 4
+    assert all(c >= 1.0 for c in rec["gram_cond"])
+    # the CLI renders the merged table
+    cli.main(["report", "--events", str(tdir), "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["rungs"] == ["gauss_newton"] * 4
+    cli.main(["report", "--events", str(tdir)])
+    screen = capsys.readouterr().out
+    assert "gram_cond" in screen and "gauss_newton" in screen
+
+
+def test_report_scopes_guard_events_to_the_last_walk(tmp_path, capsys):
+    """A multi-walk session: guard/degrade events from an EARLIER walk must
+    not be pinned on the last walk's report (the overlay is scoped to the
+    event window between the two convergence records)."""
+    from orp_tpu.obs.report import load_convergence
+
+    gn_train = TrainConfig(dual_mode="mse_only", optimizer="gauss_newton",
+                           gn_iters_first=4, gn_iters_warm=2)
+    tiny = dataclasses.replace(SIM, n_paths=128)
+    with obs.telemetry(tmp_path):
+        # a demotion belonging to walk 1's era…
+        obs.count("guard/degrade", date="0", to="adam")
+        european_hedge(EURO, tiny, gn_train)                       # walk 1
+        european_hedge(EURO, dataclasses.replace(tiny, seed_fund=5),
+                       gn_train)                                   # walk 2
+    rec = load_convergence(tmp_path)
+    # …is NOT attributed to walk 2's (clean) report
+    assert rec["rungs"] == ["gauss_newton"] * rec["n_dates"]
+    assert rec["nan_events"] == {}
+
+
+def test_report_cli_without_record(tmp_path, capsys):
+    from orp_tpu import cli
+
+    with obs.telemetry(tmp_path):
+        pass  # a session that trained nothing
+    cli.main(["report", "--events", str(tmp_path)])
+    assert "no train/convergence record" in capsys.readouterr().out
+
+
+# -- drift monitor unit pins --------------------------------------------------
+
+
+def test_drift_monitor_fail_open_on_garbage():
+    """Monitoring is advisory: NaN rows are counted out (one NaN must not
+    poison the decayed sums forever — detection keeps working after), and a
+    wrong-width block is skipped, never an exception up the submit path."""
+    sk = FeatureSketch.from_features(
+        np.random.default_rng(0).normal(0.0, 1.0, (4096, 2)))
+    m = DriftMonitor(sk, band=1.0, min_rows=64)
+    poisoned = np.zeros((128, 2), np.float32)
+    poisoned[3, 1] = np.nan
+    m.update(poisoned)
+    assert np.isfinite(m.scores()["score"])          # sums not poisoned
+    m.update(np.ones((64, 3), np.float32))           # wrong width: skipped
+    assert m.scores()["rows"] == 127                 # only finite rows folded
+    # and the monitor still DETECTS after the garbage
+    assert m.update(np.full((256, 2), 5.0, np.float32)) > 1.0
+    assert m.trips == 1
+
+
+def test_drift_monitor_latch_and_rearm():
+    sk = FeatureSketch.from_features(
+        np.random.default_rng(0).normal(0.0, 1.0, (4096, 2)))
+    m = DriftMonitor(sk, band=1.0, min_rows=64)
+    # drifted: one trip, latched (no spam on continued drift)
+    assert m.update(np.full((256, 2), 5.0, np.float32)) > 1.0
+    m.update(np.full((256, 2), 5.0, np.float32))
+    assert m.trips == 1
+    # flood with on-distribution rows until the score clears -> re-arms
+    for i in range(40):
+        m.update(np.random.default_rng(i).normal(0.0, 1.0, (4096, 2))
+                 .astype(np.float32))
+    assert m.scores()["score"] < 0.8
+    assert m.scores()["tripped"] is False
