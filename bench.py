@@ -182,16 +182,24 @@ def main():
         kernel = "xla_scan"
         run = run_scan
         run()
-    t0 = time.perf_counter()
+    # repeats with per-iter walls (run() blocks internally): the headline
+    # throughput is a MEDIAN with its IQR alongside — the perf-ledger
+    # discipline, never one draw
     n_iters = 3
+    walls = []
     for _ in range(n_iters):
+        t0 = time.perf_counter()
         out = run()
-    dt = (time.perf_counter() - t0) / n_iters
+        walls.append(time.perf_counter() - t0)
 
     # sanity: drift oracle E[S_T] = e^{mu T} (Multi#7(out) checks the same)
     drift_err = abs(float(out[:, -1].mean()) - float(jnp.exp(0.08 * 10.0)))
     assert drift_err < 0.02, f"drift oracle failed: {drift_err}"
 
+    from orp_tpu.obs.perf import summarize_repeats
+
+    sim_summary = summarize_repeats(walls)
+    dt = sim_summary["median"]
     value = n_paths * n_steps / dt
     record = {
         "metric": "sobol_gbm_path_steps_per_sec_per_chip",
@@ -199,6 +207,9 @@ def main():
         "unit": "path-steps/s",
         "vs_baseline": round(value / BASELINE_PATH_STEPS_PER_SEC, 2),
         "kernel": kernel,
+        "sim_repeats": sim_summary["repeats"],
+        "sim_wall_median_s": round(sim_summary["median"], 4),
+        "sim_wall_iqr_s": round(sim_summary["iqr"], 4),
     }
     if cpu_fallback:
         record["cpu_fallback"] = True  # NOT a TPU number; tunnel was dead
@@ -295,6 +306,24 @@ def main():
     record["platform"] = jax.default_backend()
     compile_mon.__exit__(None, None, None)
     record.update(compile_mon.split(time.perf_counter() - t_run))
+
+    # perf ledger: the sim walls land as one orp-perf-v1 record (repeats +
+    # median + IQR + the device/config fingerprint), so every bench run
+    # extends the committed time series `orp perf-gate` judges
+    try:
+        from orp_tpu.obs import perf as _perf
+
+        ledger = os.environ.get(
+            "ORP_PERF_LEDGER",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "PERF_LEDGER.jsonl"))
+        _perf.ledger_append(ledger, _perf.make_record(
+            "bench", "sim_wall_s", walls,
+            fingerprint_extra={"n_paths": n_paths, "n_steps": n_steps,
+                               "kernel": kernel,
+                               "cpu_fallback": cpu_fallback}))
+    except (OSError, ValueError) as e:
+        print(f"perf-ledger append failed: {e}", file=sys.stderr)
 
     # telemetry bundle (ORP_BENCH_TELEMETRY_DIR): the round record goes
     # through the obs sink — a schema-versioned ``record`` event alongside

@@ -176,6 +176,44 @@ def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
             "serialized_bytes": len(blob),
             **{k: v for k, v in meta.items() if k != "fn"},
         }
+        if codec == "pjrt":
+            # roofline stamp (obs/perf): one warmup + median-of-3 timed
+            # executes of the JUST-compiled program against the engine's
+            # real params and zero rows, joining the cost_analysis
+            # FLOPs/bytes captured above with a MEASURED execute wall —
+            # the manifest then carries achieved FLOP/s / fraction-of-peak
+            # per bucket. Mesh topologies skip it: this process's engine
+            # holds unsharded params, and timing a fabricated placement
+            # would roofline the wrong program.
+            import time as _time
+
+            try:
+                feats = jnp.zeros((b, engine.model.n_features), dt)
+                pr = jnp.zeros((b, engine.n_instruments), dt)
+                idx = jnp.asarray(0, jnp.int32)
+
+                def call():
+                    return jax.block_until_ready(compiled(
+                        engine._p1, engine._p2, idx, feats, pr,
+                        engine._coc))
+
+                call()  # warmup off the record
+                exec_walls = []
+                for _ in range(3):
+                    t0 = _time.perf_counter()
+                    call()
+                    exec_walls.append(_time.perf_counter() - t0)
+                exec_s = sorted(exec_walls)[1]
+                entries[str(b)]["execute_wall_s"] = round(exec_s, 6)
+                if meta.get("flops"):
+                    from orp_tpu.obs import perf as _perf
+
+                    entries[str(b)]["roofline"] = _perf.roofline(
+                        meta.get("flops"), meta.get("bytes_accessed"),
+                        exec_s)
+            except Exception as e:  # orp: noqa[ORP009] -- degradation recorded: the error lands in the manifest's roofline_error field
+                entries[str(b)]["roofline_error"] = (
+                    f"{type(e).__name__}: {e}"[:200])
     manifest = {
         "format": AOT_FORMAT,
         "fingerprint": device_fingerprint(),

@@ -64,6 +64,27 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 (per-date loss trajectories, epochs/GN iterations, the
                 trainer-ladder rung each date finished on, GN Gram
                 conditioning) from a ``--telemetry DIR`` bundle
+- ``profile``   run a workload (north-star walk or a bundle's serve
+                schedule) under the performance observatory: flag-gated
+                device-time attribution splits every dispatch into queue
+                vs device seconds and every span wall into host vs
+                device, per-stage ``CompileTimeMonitor`` seconds replace
+                the old cold/warm-pair inference, and the FLOP ledger +
+                roofline fractions (achieved FLOP/s over the
+                ``device_kind`` peak table, measured-matmul fallback)
+                ride each stage; ``--trace-dir`` additionally captures a
+                perfetto trace whose regions carry the obs span names
+                (subsumes ``tools/profile_north_star.py``)
+- ``perf-gate`` noise-aware perf-regression verdict against the
+                ``orp-perf-v1`` ledger (``PERF_LEDGER.jsonl``): the
+                current run's median vs the matching-fingerprint
+                history's, regression = outside k*IQR AND past a relative
+                floor (container noise stays green), minimum-repeats
+                refusal in flag-speak; with ``--bundle`` the gate
+                measures a serve phase itself — the measurement reaches
+                obs before the verdict, and joins the ledger history
+                only on a green verdict (a regressed run must never
+                shift the baseline it failed against)
 - ``warm``      pre-populate the persistent XLA compile cache for training:
                 AOT-compile the fused backward-walk program for the given
                 pipeline/shape WITHOUT simulating or training, so the next
@@ -77,7 +98,7 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 validation-set fingerprint present, quality record
                 parseable with a nonzero RQMC CI
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP016 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP017 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
@@ -86,7 +107,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 work under a lock, per-row Python work in ingest-path
                 code, unbounded socket I/O, dynamic obs instrument names /
                 hot-path instrument construction, numeric acceptance gates
-                that never record their measurement); exits non-zero
+                that never record their measurement, stop-clocks read
+                before the block on jit-dispatched work); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -815,6 +837,7 @@ def cmd_serve_bench(args):
         drill_blocks=drill_blocks,
         drill_block_rows=drill_rows,
         drill_kill_at=drill_kill_at,
+        repeats=args.repeats,
         previous=previous,
     )
     if args.ingest:
@@ -829,6 +852,42 @@ def cmd_serve_bench(args):
                 "ingest amortization regressed")
     if args.out:
         write_bench_record(record, args.out)
+    # default ledger is PERF_LEDGER.jsonl next to --out for REAL runs only:
+    # a --quick smoke appends nowhere unless --ledger names a path (the
+    # `orp profile` discipline), so a CI/probe run from the repo root never
+    # seeds quick-shaped fingerprints into the committed ledger. An
+    # EXPLICIT --ledger is always honoured — with --out '' a relative path
+    # resolves against cwd; only the implicit default is dropped there (a
+    # record-less smoke must not scatter default-named ledgers around)
+    explicit = args.ledger is not None
+    ledger_arg = args.ledger
+    if ledger_arg is None:
+        ledger_arg = "" if args.quick else "PERF_LEDGER.jsonl"
+    if ledger_arg and (args.out or explicit
+                       or pathlib.Path(ledger_arg).is_absolute()):
+        # every record-writing serve-bench run appends its headline phases
+        # to the perf ledger — the time series `orp perf-gate` judges
+        # regressions on. A relative ledger resolves NEXT TO --out (the
+        # ledger lives beside the bench record it seeds: repo root for the
+        # committed artifact, a scratch dir for a scratch bench); with
+        # --out '' only an ABSOLUTE --ledger is honoured, so a record-less
+        # smoke never drops ledger rows into whatever cwd it ran from
+        from orp_tpu.obs import perf as _perf
+        from orp_tpu.serve.bench import ledger_records
+
+        ledger = pathlib.Path(ledger_arg)
+        if not ledger.is_absolute():
+            anchor = (pathlib.Path(args.out).resolve().parent if args.out
+                      else pathlib.Path.cwd())
+            ledger = anchor / ledger
+        try:
+            for rec in ledger_records(record):
+                _perf.ledger_append(ledger, rec)
+        except (OSError, ValueError) as e:
+            # the bench completed and its record is written — a read-only
+            # ledger must not turn that into a nonzero exit with no record
+            # on stdout (bench.py applies the same discipline)
+            print(f"perf-ledger append failed: {e}", file=sys.stderr)
     print(json.dumps(record))
 
 
@@ -880,6 +939,15 @@ def cmd_serve_gateway(args):
             # without --telemetry (which, when passed, already opened a
             # session before this command ran — see main())
             stack.enter_context(obs.active())
+        if args.device_profile:
+            # flag-gated device-time attribution (obs/devprof): per-bucket
+            # queue/device seconds + the live utilization gauge land in
+            # this process's registry — `orp top` renders dev-util, the
+            # /metrics scrape exports serve_device_* (bill gated ≤5% by
+            # the bench's profile_overhead phase; off = zero cost)
+            from orp_tpu.obs import devprof
+
+            stack.enter_context(devprof.profiling())
         host = stack.enter_context(
             ServeHost(max_live_engines=args.max_live_engines))
         host.add_tenant(args.tenant, args.bundle, policy=policy,
@@ -985,7 +1053,7 @@ def cmd_doctor(args):
     rep = doctor_report(args.bundle, mesh=args.mesh, cache_dir=args.cache_dir,
                         telemetry_dir=args.telemetry_dir,
                         gateway=args.gateway, metrics=args.metrics,
-                        quality=args.quality,
+                        quality=args.quality, perf=args.perf,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -1107,6 +1175,152 @@ def cmd_report(args):
         print(json.dumps(rec))
     else:
         print(format_report(rec))
+
+
+def cmd_profile(args):
+    """Run a workload under the performance observatory: device-time
+    attribution on (queue vs device seconds per dispatch, host vs device
+    per span), every XLA compile second metered per stage, the FLOP
+    ledger + roofline fractions joined — ONE run, no cold/warm pair
+    (subsumes ``tools/profile_north_star.py``). ``--trace-dir`` wraps the
+    run in ``jax.profiler.trace``: the obs spans' TraceAnnotations name
+    the regions in the emitted perfetto trace."""
+    import pathlib
+
+    from orp_tpu.obs import devprof
+
+    try:
+        out = devprof.profile_run(
+            workload=args.workload, bundle=args.bundle,
+            n_log2=args.paths_log2, quick=args.quick,
+            trace_dir=args.trace_dir)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    # default ledger is cwd-relative PERF_LEDGER.jsonl for REAL runs only:
+    # a --quick smoke appends nowhere unless --ledger names a path, so a
+    # CI/probe run from the repo root never dirties the committed ledger
+    ledger_arg = args.ledger
+    if ledger_arg is None:
+        ledger_arg = "" if args.quick else "PERF_LEDGER.jsonl"
+    ledger = None
+    if ledger_arg:
+        from orp_tpu.obs import perf as _perf
+
+        # the default is cwd-relative: resolve it up front and NAME it in
+        # the output below, so a run from the wrong directory shows where
+        # its rows landed instead of silently fragmenting the time series
+        ledger = pathlib.Path(ledger_arg).resolve()
+        try:
+            for rec in _profile_ledger_records(out):
+                _perf.ledger_append(ledger, rec)
+        except (OSError, ValueError) as e:
+            print(f"perf-ledger append failed: {e}", file=sys.stderr)
+            ledger = None
+    if args.json:
+        print(json.dumps(out))
+        return
+    print(f"orp profile — {out['workload']} "
+          f"({out.get('n_paths', out.get('n_requests'))} "
+          f"{'paths' if out['workload'] == 'north_star' else 'requests'}, "
+          f"platform {out['platform']})")
+    if out["workload"] == "north_star":
+        print(f"{'stage':<12}{'wall s':>10}{'compile s':>11}"
+              f"{'execute s':>11}{'host s':>9}{'device s':>10}"
+              f"{'frac peak':>11}")
+        for name, st in out["stages"].items():
+            rf = st.get("roofline") or {}
+            frac = rf.get("frac_peak_flops")
+            print(f"{name:<12}{st['wall_s']:>10.3f}"
+                  f"{(st['compile_s'] if st['compile_s'] is not None else float('nan')):>11.3f}"
+                  f"{(st['execute_wall_s'] if st['execute_wall_s'] is not None else float('nan')):>11.3f}"
+                  f"{st['host_s']:>9.3f}{st['device_wait_s']:>10.3f}"
+                  + (f"{frac:>11.2e}" if frac is not None else f"{'-':>11}"))
+    else:
+        print(f"device utilization {out['device_utilization']:.1%}")
+        print(f"{'bucket':>8}{'count':>7}{'device ms':>11}{'queue ms':>10}")
+        for b, st in sorted(out["buckets"].items(), key=lambda kv: int(kv[0])):
+            print(f"{b:>8}{st['count']:>7}"
+                  f"{st['device_s_median'] * 1e3:>11.4f}"
+                  f"{st['queue_s_median'] * 1e3:>10.4f}")
+        rf = out.get("roofline")
+        if rf and "error" not in rf:
+            print(f"roofline: bucket {rf['bucket']} achieved "
+                  f"{rf['achieved_flops_per_s']:.3g} FLOP/s = "
+                  f"{rf['frac_peak_flops']:.2e} of peak "
+                  f"({rf['peak_source']})")
+    if ledger is not None:
+        print(f"perf ledger -> {ledger}")
+    if "trace_dir" in out:
+        print(f"perfetto trace -> {out['trace_dir']}")
+
+
+def _profile_ledger_records(out: dict) -> list:
+    """The orp-perf-v1 rows an ``orp profile`` run seeds: one per
+    north-star stage (the stage wall as a single-sample record carries
+    repeats=1 and is therefore never GATED — the gate's min-repeats
+    refusal is the contract — but it still lands the time series), or the
+    serve workload's per-bucket device medians with their honest counts."""
+    from orp_tpu.obs import perf as _perf
+
+    recs = []
+    if out["workload"] == "north_star":
+        fp = {"n_paths": out["n_paths"], "n_dates": out["n_dates"],
+              "quick": out["quick"]}
+        for name, st in out["stages"].items():
+            recs.append(_perf.make_record_from_summary(
+                "profile_north_star", name, repeats=1,
+                median=st["wall_s"], iqr=0.0, fingerprint_extra=fp,
+                extra={"compile_s": st["compile_s"],
+                       "device_wait_s": st["device_wait_s"]}))
+    else:
+        fp = {"n_requests": out["n_requests"], "quick": out["quick"],
+              "policy": out.get("policy")}
+        for b, st in out["buckets"].items():
+            recs.append(_perf.make_record_from_summary(
+                "profile_serve", f"bucket_{b}_device_s",
+                repeats=st["count"], median=st["device_s_median"],
+                # the per-dispatch window's real spread — an iqr of 0.0
+                # would hand a later perf-gate a zero-width noise band
+                # that trips on ordinary container wobble
+                iqr=st.get("device_s_iqr", 0.0), fingerprint_extra=fp))
+    return recs
+
+
+def cmd_perf_gate(args):
+    """Noise-aware perf-regression verdict against the ledger's matching-
+    fingerprint history: green within k*IQR of the history medians (or on
+    a fresh baseline), exit 1 in flag-speak on a real regression, refusal
+    (exit 2) when either side has fewer than --min-repeats repeats. With
+    ``--bundle`` the gate takes its own measurement first (repeats of a
+    fixed serve schedule) and appends it to the ledger ONLY on a green
+    verdict (a regressed run must never shift the baseline it failed
+    against); without, it judges the ledger's newest matching record.
+    The measurement reaches obs before the verdict either way."""
+    from orp_tpu.obs import perf as _perf
+
+    try:
+        out = _perf.gate_cli(
+            ledger=args.ledger, bundle=args.bundle,
+            workload=args.workload, phase=args.phase,
+            repeats=args.repeats, evals=args.evals, rows=args.rows,
+            k=args.k, min_repeats=args.min_repeats)
+    except (ValueError, OSError) as e:
+        raise SystemExit(f"error: {e}") from None
+    if args.json:
+        print(json.dumps(out))
+    else:
+        mark = {"ok": "green", "no_history": "green (baseline seeded)",
+                "refused": "REFUSED", "regression": "REGRESSION"}
+        print(f"perf-gate {mark[out['verdict']]}: {out['reason']}")
+    if out["verdict"] == "refused":
+        raise SystemExit(2)
+    if not out["ok"]:
+        raise SystemExit(
+            f"error: perf regression on {out['record']['workload']}/"
+            f"{out['record']['phase']}: {out['reason']} — if this change "
+            "is intentional, reseed the history (move the ledger aside or "
+            "append accepted runs with `orp serve-bench --ledger`/"
+            "`orp perf-gate --bundle`)")
 
 
 def cmd_lint(args):
@@ -1415,6 +1629,82 @@ def build_parser():
     _add_train_flags(pw)
     pw.set_defaults(fn=cmd_warm)
 
+    ppr = sub.add_parser(
+        "profile",
+        help="run a workload under the performance observatory: device-"
+             "time attribution (queue vs device per dispatch, host vs "
+             "device per span), per-stage compile seconds, FLOP ledger + "
+             "roofline fractions — one run, no cold/warm pair; "
+             "--trace-dir additionally emits a perfetto trace with "
+             "obs-span-named regions (subsumes "
+             "tools/profile_north_star.py)",
+    )
+    ppr.add_argument("--workload", choices=["north-star", "serve"],
+                     default="north-star",
+                     help="north-star: the 1M-path 52-date hedge walk by "
+                          "stages; serve: a request schedule through a "
+                          "bundle's engine with the per-bucket "
+                          "queue/device table")
+    ppr.add_argument("--paths-log2", type=int, default=20,
+                     help="north-star path count as a power of two")
+    ppr.add_argument("--bundle", default=None,
+                     help="policy bundle directory (required for "
+                          "--workload serve)")
+    ppr.add_argument("--trace-dir", default=None, metavar="DIR",
+                     help="run under jax.profiler.trace and leave the "
+                          "perfetto trace in DIR (inspect with XProf/"
+                          "TensorBoard; obs spans name the regions)")
+    ppr.add_argument("--quick", action="store_true",
+                     help="CI smoke shape: 2^10 paths / a handful of "
+                          "requests, same stages, same record fields")
+    ppr.add_argument("--ledger", default=None,
+                     help="append the run's stage walls to this "
+                          "orp-perf-v1 ledger ('' skips; default "
+                          "./PERF_LEDGER.jsonl, except --quick smokes "
+                          "which append nowhere unless a path is named)")
+    ppr.add_argument("--json", action="store_true",
+                     help="emit the breakdown record as one JSON line")
+    _add_telemetry_flag(ppr)
+    ppr.set_defaults(fn=cmd_profile)
+
+    ppg = sub.add_parser(
+        "perf-gate",
+        help="noise-aware perf-regression gate against PERF_LEDGER.jsonl: "
+             "median outside k*IQR of the matching-fingerprint history "
+             "(and past a relative floor) exits 1 in flag-speak; "
+             "container noise stays green; under-min-repeats refuses "
+             "(exit 2)",
+    )
+    ppg.add_argument("--ledger", default="PERF_LEDGER.jsonl",
+                     help="the orp-perf-v1 ledger to judge against")
+    ppg.add_argument("--bundle", default=None,
+                     help="measure a serve phase NOW over this bundle, "
+                          "append it, and gate it (otherwise the ledger's "
+                          "newest matching record is judged)")
+    ppg.add_argument("--workload", default=None,
+                     help="without --bundle: select the ledger workload "
+                          "to judge (default: the newest record)")
+    ppg.add_argument("--phase", default=None,
+                     help="without --bundle: select the ledger phase")
+    ppg.add_argument("--repeats", type=int, default=5,
+                     help="with --bundle: timed measurement repeats")
+    ppg.add_argument("--evals", type=int, default=32,
+                     help="with --bundle: engine evaluations per repeat")
+    ppg.add_argument("--rows", type=int, default=64,
+                     help="with --bundle: rows per evaluation")
+    ppg.add_argument("--k", type=float, default=4.0,
+                     help="noise-band multiplier: regression = median "
+                          "outside k*IQR of history AND past the "
+                          "relative floor")
+    ppg.add_argument("--min-repeats", type=int, default=3,
+                     help="refuse (exit 2) when either side carries fewer "
+                          "repeats than this — a one-draw median has no "
+                          "noise band to judge against")
+    ppg.add_argument("--json", action="store_true",
+                     help="emit the verdict as one JSON line")
+    _add_telemetry_flag(ppg)
+    ppg.set_defaults(fn=cmd_perf_gate)
+
     psb = sub.add_parser(
         "serve-bench",
         help="benchmark the serving path of an exported bundle "
@@ -1495,6 +1785,19 @@ def build_parser():
                      help="CI smoke shape: shrink the ingest sweep and the "
                           "gateway drill to tiny row/block counts (same "
                           "lanes, same bitwise and speedup gates)")
+    psb.add_argument("--repeats", type=int, default=3,
+                     help="measurement repeats for the headline phases "
+                          "(sweep, ingest, drill): every committed "
+                          "headline is a median with an IQR, never one "
+                          "draw")
+    psb.add_argument("--ledger", default=None,
+                     help="append the run's headline phases to this "
+                          "orp-perf-v1 ledger ('' skips; a relative path "
+                          "resolves next to --out, so the ledger lives "
+                          "beside the bench record it seeds; default "
+                          "PERF_LEDGER.jsonl, except --quick smokes "
+                          "append nowhere) — the history `orp perf-gate` "
+                          "compares against")
     psb.add_argument("--prewarm", action="store_true",
                      help="assert the warmup contract: fail loudly if any "
                           "measured request paid a first-touch bucket "
@@ -1544,6 +1847,13 @@ def build_parser():
                           "sequenced frames are refused with a BUSY frame "
                           "(backpressure — the producer resends; no rows "
                           "shed)")
+    pgw.add_argument("--device-profile", action="store_true",
+                     help="enable device-time attribution for this serving "
+                          "process (orp_tpu/obs/devprof): per-bucket "
+                          "queue/device seconds + the live device-"
+                          "utilization gauge on the scrape path — the "
+                          "`orp top` dev-util column; measured overhead "
+                          "≤5% of the columnar lane, zero when off")
     pgw.add_argument("--metrics-port", type=int, default=None, metavar="P",
                      help="also serve plain-HTTP Prometheus scrape on this "
                           "port (GET /metrics = the live exposition, GET "
@@ -1636,6 +1946,16 @@ def build_parser():
                            "parseable orp-quality-v1 record with a nonzero "
                            "RQMC confidence interval (the preflight for "
                            "drift monitoring and reload quality_band gates)")
+    pdoc.add_argument("--perf", nargs="?", const="PERF_LEDGER.jsonl",
+                      default=None, metavar="LEDGER",
+                      help="probe the performance-observatory plumbing: "
+                           "jax.profiler importable + trace dir writable, "
+                           "the orp-perf-v1 ledger (default "
+                           "PERF_LEDGER.jsonl) parseable and appendable, "
+                           "and the roofline peak table covering this "
+                           "device_kind (flag-speak fix line when "
+                           "fraction-of-peak falls back to the measured-"
+                           "matmul peak)")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "
@@ -1664,8 +1984,9 @@ def build_parser():
              "drift, key reuse, silent excepts, blocking dispatch loops, "
              "single-device assumptions, per-row ingest work, unbounded "
              "socket I/O, dynamic obs instrument names, unrecorded "
-             "numeric acceptance gates — rules "
-             "ORP001-ORP016); non-zero "
+             "numeric acceptance gates, stop-clocks read before the "
+             "block on jitted work — rules "
+             "ORP001-ORP017); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

@@ -97,6 +97,18 @@ ORP016  numeric acceptance gates that never record their measurement: a
         (ValueError & co) are input checking, not verdicts, and are out of
         scope; a gate records through obs_count/obs_observe/obs_set_gauge/
         flight.record (or the promotion chain) BEFORE raising.
+ORP017  stop-clock read before the block on jit-dispatched work: ORP007
+        catches a timing scope with NO ``block_until_ready`` at all; this
+        rule catches the subtler ORDERING bug — the scope DOES sync, but
+        only AFTER the second ``perf_counter``/``monotonic`` read, so the
+        recorded delta still times dispatch, not device compute, while
+        reading as "blocked" to a reviewer (exactly the bug class the
+        device-time attribution plane exists to make impossible). The
+        block must land between the last dispatch inside the timer pair
+        and the stop clock. Allowlisted: ``obs/`` (devprof takes the raw
+        instants by design), ``aot/`` (the compile meters time lowering,
+        not dispatch) and ``*bench.py`` (the bench lanes measure the
+        dispatch path deliberately and block in bulk).
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -588,6 +600,110 @@ def check_unblocked_timing(ctx: FileContext) -> Iterator[Finding]:
                 f"perf_counter delta around async dispatch ({dispatches[0]} "
                 "…) without block_until_ready — this times dispatch, not "
                 "device compute",
+            )
+
+
+# -- ORP017 ------------------------------------------------------------------
+
+# files whose JOB is timing instrumentation: the obs spine (devprof takes
+# the raw pre-block instants by design), the aot compile meters, and the
+# bench lanes (root bench.py, serve/bench.py, tools/*_bench.py — they
+# measure the dispatch path deliberately and block in bulk)
+_ORP017_ALLOWED_DIRS = ("obs/", "aot/")
+
+
+def _orp017_bench_file(path: str) -> bool:
+    # exactly the documented set: a file NAMED bench.py (root, serve/) or a
+    # tools-style *_bench.py — not any name that merely ends in "bench.py"
+    # (a future workbench.py is serving code, not a bench lane)
+    base = path.rsplit("/", 1)[-1]
+    return base == "bench.py" or base.endswith("_bench.py")
+
+
+@rule("ORP017", "stop-clock read before block_until_ready around jitted work")
+def check_stop_clock_before_block(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if any("/" + d in path or path.startswith(d)
+           for d in _ORP017_ALLOWED_DIRS):
+        return
+    if _orp017_bench_file(path):
+        return
+    jitted_names = ctx.jit.jitted_callable_names()
+    for scope in _scopes(ctx.tree):
+        sync_fns = _local_sync_fns(scope)
+        # STOP-clocks are timer reads consumed by a subtraction
+        # (`perf_counter() - t0` / `t0 - monotonic()`): anchoring only on
+        # them keeps the (stop-of-region-1, start-of-region-2) adjacency —
+        # an untimed dispatch BETWEEN two correctly-blocked regions — from
+        # reading as a mis-ordered pair
+        stop_ids: set[int] = set()
+        sub_minuend_names: set[str] = set()
+        for node in walk_scope(scope):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                for side in (node.left, node.right):
+                    if (isinstance(side, ast.Call)
+                            and dotted(side.func) in _TIMER_CALLS):
+                        stop_ids.add(id(side))
+                if isinstance(node.left, ast.Name):
+                    sub_minuend_names.add(node.left.id)
+        # the NAMED stop-clock idiom (`t1 = perf_counter(); dt = t1 - t0`)
+        # is the dominant one in real code: a timer assigned to a name that
+        # later appears as the MINUEND of a subtraction is a stop clock
+        # (elapsed = stop - start, so start names sit on the right — which
+        # keeps a region-2 START clock like `t2` in `perf_counter() - t2`
+        # from reading as a stop)
+        for node in walk_scope(scope):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id in sub_minuend_names
+                    and isinstance(node.value, ast.Call)
+                    and dotted(node.value.func) in _TIMER_CALLS):
+                stop_ids.add(id(node.value))
+        events: list[tuple[int, str, ast.Call]] = []
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in _TIMER_CALLS:
+                events.append((node.lineno, "timer", node))
+            elif _is_sync_call(node) or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in sync_fns):
+                events.append((node.lineno, "sync", node))
+            elif d is None:
+                continue
+            elif d.startswith(("jnp.", "jax.")) and not d.startswith(
+                    _DISPATCH_EXEMPT_PREFIXES):
+                events.append((node.lineno, "dispatch", node))
+            elif d.split(".")[-1] in jitted_names:
+                events.append((node.lineno, "dispatch", node))
+        if not any(kind == "sync" for _, kind, _ in events):
+            # no sync anywhere: that is ORP007's finding, not a
+            # mis-ORDERED one — never double-report the same site
+            continue
+        events.sort(key=lambda e: e[0])
+        timers = [e for e in events if e[1] == "timer"]
+        for (t0_line, _, _), (t1_line, _, t1_node) in zip(timers,
+                                                          timers[1:]):
+            if id(t1_node) not in stop_ids:
+                continue  # pair ends on a START clock: not a timed region
+            dispatches = [ln for ln, kind, _ in events
+                          if kind == "dispatch" and t0_line < ln < t1_line]
+            if not dispatches:
+                continue
+            last_disp = dispatches[-1]
+            if any(kind == "sync" and last_disp <= ln <= t1_line
+                   for ln, kind, _ in events):
+                continue
+            yield ctx.finding(
+                t1_node, "ORP017",
+                "stop-clock read with no block_until_ready since the "
+                f"dispatch at line {last_disp} — the scope DOES sync, but "
+                "only after this clock stops, so the recorded delta times "
+                "dispatch, not device compute; move the block before the "
+                "stop clock (or use obs spans, which block via "
+                "set_result)",
             )
 
 

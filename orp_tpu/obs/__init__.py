@@ -35,7 +35,22 @@ instrumentation with one export spine; see PAPERS.md):
                   black box (``orp-flight-v1``) on guard trips, SIGTERM, or
                   a doctor request — always on, even with no session;
 - ``tracetree`` — the read side of tracing: rebuild one frame's span tree
-                  from a bundle's ``events.jsonl`` (CLI ``orp trace``).
+                  from a bundle's ``events.jsonl`` (CLI ``orp trace``);
+- ``devprof``   — the PERFORMANCE plane's write side: flag-gated
+                  device-time attribution (serial-device completion
+                  chaining splits every dispatch into queue vs device
+                  seconds, every span wall into host vs device), the
+                  ``serve/device_utilization`` gauge, and the
+                  ``orp profile`` workloads (north-star walk / serve
+                  schedule under ``jax.profiler.trace``);
+- ``perf``      — the PERFORMANCE plane's ledger side: the committed
+                  ``orp-perf-v1`` time series (``PERF_LEDGER.jsonl``,
+                  repeats + median + IQR + device/config fingerprints,
+                  validated like the sink's envelopes), roofline
+                  accounting (cost_analysis FLOPs/bytes joined with
+                  measured walls against a ``device_kind``-keyed peak
+                  table, measured-matmul fallback), and the noise-aware
+                  ``orp perf-gate`` regression verdict.
 
 The one-call entry point is the session::
 
@@ -61,9 +76,13 @@ import contextlib
 import pathlib
 import threading
 
-from orp_tpu.obs import flight
+from orp_tpu.obs import devprof, flight, perf
 from orp_tpu.obs.flight import (FLIGHT_FILE, FLIGHT_SCHEMA, FlightRecorder,
                                 read_flight, validate_flight_event)
+from orp_tpu.obs.perf import (PERF_LEDGER_FILE, PERF_SCHEMA, ledger_append,
+                              make_record, perf_fingerprint, read_ledger,
+                              roofline, summarize_repeats,
+                              validate_perf_record)
 from orp_tpu.obs.manifest import (CHAIN_FILE, CHAIN_SCHEMA, MANIFEST_SCHEMA,
                                   build_manifest, chain_append, chain_verify,
                                   config_fingerprint, read_chain,

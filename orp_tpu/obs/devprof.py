@@ -1,0 +1,490 @@
+"""Device-time attribution: split host-blocked walls into queue vs device.
+
+Every obs span so far measured ONE number — the host wall blocked on the
+result tree. That wall conflates three different bills: the Python/dispatch
+work before the block, the time the dispatch sat QUEUED behind earlier
+dispatches on the (serial) device stream, and the device actually EXECUTING
+this program. This module is the flag-gated profiling mode that separates
+them, Dapper-style (bounded overhead, always available):
+
+- :func:`enable` / :func:`profiling` switch attribution on process-wide.
+  **Disabled is the default and costs one module-global load + ``is None``
+  test per site** — the ``obs.spans`` discipline, pinned by
+  ``tests/test_perf.py`` exactly like the span no-op.
+- :class:`DevProf` is the attribution state: a serial-device completion
+  chain. Each dispatch stamps its submit instant; at block time the device
+  window is ``[max(t_dispatch, previous_completion), t_done]`` — on a
+  serial device a dispatch cannot start executing before its predecessor
+  completes, so ``device_s = t_done - start`` and ``queue_s = start -
+  t_dispatch`` partition the dispatch-to-done wall EXACTLY (pinned:
+  ``queue_s + device_s == t_done - t_dispatch``). Per-bucket device
+  seconds land in ``serve/device_seconds{bucket}`` (and the queue waits in
+  ``serve/queue_wait_seconds{bucket}``) on the active session registry —
+  the scrape plane (``orp top``, ``--metrics-port``) exports them live —
+  and in the DevProf's own bounded per-bucket windows, so a bench can read
+  the split back without a telemetry session.
+- a rolling device-utilization gauge (``serve/device_utilization``):
+  busy device seconds over the trailing horizon — the ``orp top`` column
+  that says whether the fleet needs more replicas or bigger batches.
+- the obs :class:`~orp_tpu.obs.spans.Span` consults :func:`active` at its
+  block point: with attribution on, every span event additionally carries
+  ``host_s`` (span open -> block start: Python + dispatch) and
+  ``device_s`` (the blocked tail), summing to ``dur_s`` exactly — which is
+  what gives the training walk its per-date device time for free (the
+  host-loop walk's ``train/fit``/``train/outputs`` spans split per date;
+  the fused walk is ONE XLA program, so its ``train/walk`` span splits as
+  a whole and anything finer needs the profiler trace below).
+- :func:`profile_north_star` / :func:`profile_serve` — the ``orp profile``
+  workloads (subsuming ``tools/profile_north_star.py``): each stage runs
+  ONCE under a per-stage ``CompileTimeMonitor`` + device attribution, so
+  compile-vs-execute and host-vs-device splits come from one run instead
+  of a cold/warm pair, with the FLOP ledger (``utils/flops.py``) and the
+  roofline join (``obs/perf.py``) stamped per stage. ``trace_dir`` wraps
+  the run in ``jax.profiler.trace`` — obs spans already open
+  ``TraceAnnotation`` regions, so the perfetto trace carries the same
+  span names the events carry.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+
+#: samples retained per bucket window — enough for a bench phase's medians,
+#: bounded so an always-on server never grows
+_WINDOW = 4096
+
+
+class DevProf:
+    """Serial-device completion-chaining attribution (see module docstring).
+
+    Thread-safe: the batcher's resolve stage and direct ``evaluate`` callers
+    may complete dispatches concurrently; the chain advances under one lock.
+    """
+
+    def __init__(self, *, horizon_s: float = 30.0):
+        self.horizon_s = float(horizon_s)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._last_complete = self._t0
+        # rolling (completion_instant, device_s) window for the util gauge,
+        # with the busy sum maintained INCREMENTALLY — the per-completion
+        # bill must stay O(1), not O(window), or the profiling mode's own
+        # overhead gate (serve/bench.py profile_overhead) would pay it
+        self._busy: collections.deque = collections.deque(maxlen=_WINDOW)
+        self._busy_sum = 0.0
+        # completion instant of the last sample the CAP (not the horizon)
+        # evicted: the retained window then only represents time after it,
+        # and utilization must shrink its denominator to match — dividing
+        # a 4096-sample window by the full horizon under sustained load
+        # would underreport a busy device by the drop ratio
+        self._cap_evicted_t: float | None = None
+        # per-bucket bounded device/queue second windows, session-independent
+        self._device: dict[str, collections.deque] = {}
+        self._queue: dict[str, collections.deque] = {}
+        self.completions = 0
+        # cached session-registry instrument handles, keyed by bucket and
+        # invalidated when the obs session changes: registry interning
+        # (sorted label tuples under the registry lock) per completion
+        # would dominate the per-dispatch bill the overhead gate bounds
+        self._instr_state = None
+        self._instr: dict[str, tuple] = {}
+
+    def complete(self, t_dispatch: float, t_block_start: float,
+                 *, bucket=None) -> tuple[float, float]:
+        """One dispatch finished NOW: attribute its wall. Returns
+        ``(queue_s, device_s)`` with ``queue_s + device_s == now -
+        t_dispatch`` exactly (the serial-device partition). ``t_block_start``
+        is recorded for honesty (the host-blocked portion is ``now -
+        t_block_start``) but the attribution keys on the dispatch instant —
+        the device was working whether or not the host was watching."""
+        t_done = time.perf_counter()
+        key = str(bucket)
+        with self._lock:
+            start = min(max(t_dispatch, self._last_complete), t_done)
+            device_s = t_done - start
+            queue_s = start - t_dispatch
+            self._last_complete = t_done
+            self.completions += 1
+            if len(self._busy) == self._busy.maxlen:
+                # about to roll off the CAP: remember its instant so the
+                # utilization denominator covers only the retained span
+                self._cap_evicted_t = self._busy[0][0]
+                self._busy_sum -= self._busy[0][1]
+            self._busy.append((t_done, device_s))
+            self._busy_sum += device_s
+            cutoff = t_done - self.horizon_s
+            while self._busy and self._busy[0][0] < cutoff:
+                self._busy_sum -= self._busy.popleft()[1]
+            dq = self._device.get(key)
+            if dq is None:
+                dq = self._device[key] = collections.deque(maxlen=_WINDOW)
+                self._queue[key] = collections.deque(maxlen=_WINDOW)
+            dq.append(device_s)
+            self._queue[key].append(queue_s)
+        # session mirror: registry-only histograms (the scrape plane reads
+        # them; no sink event per dispatch) + the live utilization gauge,
+        # through handles cached per (session, bucket)
+        from orp_tpu.obs.spans import state
+
+        st = state()
+        if st is not None:
+            if st is not self._instr_state:
+                self._instr_state = st
+                self._instr = {}
+            handles = self._instr.get(key)
+            if handles is None:
+                labels = {"bucket": key}
+                handles = self._instr[key] = (
+                    st.registry.histogram("serve/device_seconds", labels),
+                    st.registry.histogram("serve/queue_wait_seconds",
+                                          labels),
+                    st.registry.gauge("serve/device_utilization"),
+                )
+            handles[0].observe(device_s)
+            handles[1].observe(queue_s)
+            # decimated: the gauge is a dashboard series, not a ledger —
+            # every 16th completion (and the first) keeps it fresh without
+            # putting the utilization fold on every dispatch
+            if self.completions % 16 == 1:
+                handles[2].set(round(self.utilization(), 6))
+        return queue_s, device_s
+
+    def utilization(self) -> float:
+        """Busy device seconds over the trailing horizon (0..~1; >1 is
+        impossible by construction — the chain serializes windows)."""
+        now = time.perf_counter()
+        with self._lock:
+            cutoff = now - self.horizon_s
+            while self._busy and self._busy[0][0] < cutoff:
+                self._busy_sum -= self._busy.popleft()[1]
+            busy = max(self._busy_sum, 0.0)
+            elapsed = min(self.horizon_s, now - self._t0)
+            if (self._cap_evicted_t is not None
+                    and self._cap_evicted_t >= cutoff):
+                # the sample cap truncated the window inside the horizon:
+                # the retained completions only describe [evicted, now]
+                elapsed = min(elapsed, now - self._cap_evicted_t)
+        return busy / elapsed if elapsed > 0 else 0.0
+
+    def bucket_stats(self) -> dict:
+        """Per-bucket attribution summary from the bounded windows:
+        ``{bucket: {count, device_s_median, device_s_total, queue_s_median}}``
+        — readable with NO telemetry session (the bench path)."""
+        import numpy as np
+
+        out = {}
+        with self._lock:
+            items = [(k, list(v), list(self._queue[k]))
+                     for k, v in self._device.items()]
+        for key, dev, que in items:
+            if not dev:
+                continue
+            q25, q75 = np.percentile(dev, [25.0, 75.0])
+            out[key] = {
+                "count": len(dev),
+                "device_s_median": float(np.median(dev)),
+                # the window's real spread: the ledger rows these medians
+                # seed need a nonzero noise band for the gate to judge in
+                "device_s_iqr": float(q75 - q25),
+                "device_s_total": float(np.sum(dev)),
+                "queue_s_median": float(np.median(que)),
+            }
+        return out
+
+
+_STATE: DevProf | None = None
+
+
+def enable(*, horizon_s: float = 30.0) -> DevProf:
+    """Switch device-time attribution on process-wide."""
+    global _STATE
+    _STATE = DevProf(horizon_s=horizon_s)
+    return _STATE
+
+
+def disable() -> None:
+    global _STATE
+    _STATE = None
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def active() -> DevProf | None:
+    """The live attribution state, or None — the disabled path is one
+    module-global load + ``is None`` test (the spans discipline)."""
+    return _STATE
+
+
+@contextlib.contextmanager
+def profiling(*, horizon_s: float = 30.0):
+    """``enable``/``disable`` as a scope; yields the :class:`DevProf`.
+    Restores any previously-installed state on exit (benches nest)."""
+    global _STATE
+    prev = _STATE
+    prof = DevProf(horizon_s=horizon_s)
+    _STATE = prof
+    try:
+        yield prof
+    finally:
+        _STATE = prev
+
+
+# -- the `orp profile` workloads ----------------------------------------------
+#
+# One run per stage: a per-stage CompileTimeMonitor meters every XLA compile
+# second inside it (execute wall = stage wall - compile seconds) and the
+# host/device split comes from an explicit pre-block instant — so the
+# cold/warm-pair logic of the old tools/profile_north_star.py collapses into
+# one pass, and the same stage record carries FLOPs + roofline fractions.
+
+
+def _stage(stages: dict, name: str, fn, *, flops: float | None = None,
+           extra: dict | None = None):
+    """Run ``fn`` once as stage ``name``: wall, compile seconds (jax
+    monitoring), execute wall, host/device split, optional FLOP join +
+    roofline fractions. Returns ``fn``'s result."""
+    import jax
+
+    from orp_tpu.aot import CompileTimeMonitor
+    from orp_tpu.obs import perf as _perf
+    from orp_tpu.obs.spans import span
+
+    with CompileTimeMonitor() as mon:
+        with span(f"profile/{name}") as sp:
+            t0 = time.perf_counter()
+            out = sp.set_result(fn())
+            t_pre = time.perf_counter()
+        # a REAL span blocked on the result in __exit__ (so its emitted
+        # host_s/device_s split agrees with this table's — blocking inside
+        # the span body left the event a degenerate host_s≈dur_s split);
+        # the no-op span of a session-less caller blocked on nothing, so
+        # block again — free on an already-ready tree
+        jax.block_until_ready(out)
+        t_done = time.perf_counter()
+    wall = t_done - t0
+    exec_raw = max(wall - mon.seconds, 0.0) if mon.supported else None
+    device_raw = t_done - t_pre
+    entry = {
+        "wall_s": round(wall, 3),
+        "compile_s": round(mon.seconds, 3) if mon.supported else None,
+        "execute_wall_s": None if exec_raw is None else round(exec_raw, 3),
+        "host_s": round(t_pre - t0, 3),
+        "device_wait_s": round(device_raw, 3),
+    }
+    if flops:
+        # roofline basis, most-honest-first: the compile-free execute wall;
+        # else (monitor unsupported, or its overlapping compile phases sum
+        # past the wall) the blocked device tail; else the LABELED total
+        # wall — an upper bound that makes the fraction an explicit lower
+        # bound instead of a silently compile-diluted number. A basis that
+        # yields frac > 1 is physically refuted (achieved can't beat peak):
+        # stages that block INTERNALLY (the fused walks) leave a µs no-op
+        # device tail that would otherwise divide the whole stage's FLOPs —
+        # demote to the next basis down the ladder instead of reporting it.
+        candidates = []
+        if exec_raw is not None and exec_raw > 1e-6:
+            candidates.append(("execute_wall", exec_raw))
+        if device_raw > 1e-6:
+            candidates.append(("device_wait", device_raw))
+        candidates.append(("total_wall_including_compile", wall))
+        for basis, basis_s in candidates:
+            rl = _perf.roofline(flops, None, basis_s)
+            frac = rl.get("frac_peak_flops")
+            if frac is None or frac <= 1.0:
+                break
+        entry["flops"] = int(flops)
+        entry["roofline"] = {"basis": basis, **rl}
+    if extra:
+        entry.update(extra)
+    stages[name] = entry
+    return out
+
+
+def profile_north_star(n_log2: int = 20, *, quick: bool = False) -> dict:
+    """Stage-level breakdown of the north-star hedge: sim -> prep -> fused
+    Adam walk -> fused GN walk, each stage ONE run with compile seconds
+    metered, host/device split recorded and the analytic FLOP ledger +
+    roofline joined. ``quick`` shrinks to a CI-smoke shape (2^10 paths,
+    4 dates, tiny epoch budgets) — same stages, same record fields."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from orp_tpu.aot import enable_persistent_cache
+    from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
+    from orp_tpu.api.pipelines import _backward_cfg
+    from orp_tpu.models.mlp import HedgeMLP
+    from orp_tpu.sde import TimeGrid, bond_curve, payoffs, simulate_gbm_log
+    from orp_tpu.train.backward import backward_induction
+    from orp_tpu.utils import flops as F
+
+    enable_persistent_cache()
+    if quick:
+        n_log2 = min(n_log2, 10)
+    n_paths = 1 << n_log2
+    euro = EuropeanConfig(constrain_self_financing=False)
+    if quick:
+        sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 52, rebalance_every=13)
+        train = TrainConfig(dual_mode="mse_only", epochs_first=8,
+                            epochs_warm=4, batch_size=max(n_paths // 4, 64))
+        gn_first, gn_warm = 4, 2
+    else:
+        sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 364, rebalance_every=7)
+        train = TrainConfig(dual_mode="mse_only", epochs_first=120,
+                            epochs_warm=30,
+                            batch_size=max(n_paths // 64, 512))
+        gn_first, gn_warm = 60, 30
+    stages: dict = {}
+    grid = TimeGrid(sim.T, sim.n_steps)
+
+    s = _stage(stages, "sim", lambda: simulate_gbm_log(
+        jnp.arange(sim.n_paths, dtype=jnp.uint32), grid, euro.s0, euro.r,
+        euro.sigma, sim.seed_fund, store_every=sim.rebalance_every,
+    ), flops=F.sim_flops(n_paths, sim.n_steps))
+
+    def prep():
+        coarse = grid.reduced(sim.rebalance_every)
+        b = bond_curve(coarse, euro.r, jnp.float32)
+        payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
+        sn = s / euro.s0
+        bn = jnp.asarray(b / euro.s0, jnp.float32)
+        terminal = payoff / euro.s0
+        return sn[:, :, None], sn, bn, terminal, float(jnp.mean(payoff)) / euro.s0
+
+    features, sn, bn, terminal, e_payoff_n = _stage(stages, "prep", prep)
+    n_dates = sn.shape[1] - 1
+    model = HedgeMLP(n_features=1, constrain_self_financing=False)
+    args = (model, features, sn, bn, terminal)
+    adam_cfg = dataclasses.replace(_backward_cfg(train), fused=True,
+                                   shuffle="blocks")
+    _stage(stages, "adam_walk",
+           lambda: backward_induction(*args, adam_cfg,
+                                      bias_init=(e_payoff_n, 0.0)).values,
+           flops=F.adam_walk_flops(n_paths, n_dates, train.epochs_first,
+                                   train.epochs_warm))
+    gn_cfg = dataclasses.replace(adam_cfg, optimizer="gauss_newton",
+                                 gn_iters_first=gn_first,
+                                 gn_iters_warm=gn_warm)
+    _stage(stages, "gn_walk",
+           lambda: backward_induction(*args, gn_cfg,
+                                      bias_init=(e_payoff_n, 0.0)).values,
+           flops=F.gn_walk_flops(n_paths, n_dates, gn_first, gn_warm))
+    return {
+        "workload": "north_star",
+        "n_paths": n_paths,
+        "n_dates": int(n_dates),
+        "quick": bool(quick),
+        "platform": jax.default_backend(),
+        "stages": stages,
+    }
+
+
+def profile_serve(bundle, *, quick: bool = False, n_requests: int = 200,
+                  batch_sizes=(1, 7, 64, 1000)) -> dict:
+    """Device-time breakdown of a serve schedule over ``bundle`` (a bundle
+    directory or a loaded policy): the engine-phase request mix under
+    attribution, the per-bucket queue/device table, the live utilization,
+    and the roofline join of the headline bucket's ``cost_analysis``
+    FLOPs/bytes against its measured device seconds."""
+    import numpy as np
+
+    from orp_tpu.obs import perf as _perf
+    from orp_tpu.serve.engine import HedgeEngine
+
+    policy = bundle
+    if isinstance(bundle, str):
+        from orp_tpu.serve.bundle import load_bundle
+
+        policy = load_bundle(bundle)
+    if quick:
+        n_requests = min(n_requests, 24)
+        batch_sizes = tuple(b for b in batch_sizes if b <= 64) or (1, 8)
+    engine = HedgeEngine(policy)
+    rng = np.random.default_rng(0)
+    nf = engine.model.n_features
+    engine.prewarm(batch_sizes)
+    with profiling() as prof:
+        for i in range(n_requests):
+            n = batch_sizes[i % len(batch_sizes)]
+            feats = (1.0 + 0.1 * rng.standard_normal((n, nf))
+                     ).astype(np.float32)
+            engine.evaluate(i % engine.n_dates, feats)
+        stats = prof.bucket_stats()
+        util = prof.utilization()
+    headline = engine.bucket_for(max(batch_sizes))
+    roofline = None
+    try:
+        cost = engine.program_cost(max(batch_sizes))
+        med = stats.get(str(headline), {}).get("device_s_median")
+        if med and cost.get("flops"):
+            roofline = {"bucket": headline, **cost,
+                        "device_s_median": round(med, 6),
+                        **_perf.roofline(cost["flops"],
+                                         cost.get("bytes_accessed"), med)}
+    except Exception as e:  # orp: noqa[ORP009] -- degradation recorded in the returned record's roofline_error field
+        roofline = {"error": f"{type(e).__name__}: {e}"}
+    import jax
+
+    return {
+        "workload": "serve",
+        "n_requests": int(n_requests),
+        "batch_sizes": list(batch_sizes),
+        "quick": bool(quick),
+        # the policy identity the per-bucket numbers belong to: without it
+        # two different bundles' profile runs would pool into ONE
+        # perf-gate history (a bigger model reading as a "regression")
+        "policy": _perf.policy_digest(policy),
+        "platform": jax.default_backend(),
+        "device_utilization": round(util, 4),
+        "buckets": {k: {f: round(v, 6) if isinstance(v, float) else v
+                        for f, v in st.items()}
+                    for k, st in stats.items()},
+        "roofline": roofline,
+    }
+
+
+def profile_run(*, workload: str = "north-star", bundle=None,
+                n_log2: int = 20, quick: bool = False,
+                trace_dir=None) -> dict:
+    """The ``orp profile`` driver: run the selected workload under device
+    attribution (and ``jax.profiler.trace`` when ``trace_dir`` is given —
+    the obs spans' TraceAnnotations name the regions in the perfetto
+    trace), emit the record through obs, and return it."""
+    from orp_tpu.obs import spans as _spans
+    from orp_tpu.obs.spans import emit_record
+
+    ctx = contextlib.nullcontext()
+    if trace_dir is not None:
+        import pathlib
+
+        import jax
+
+        pathlib.Path(trace_dir).mkdir(parents=True, exist_ok=True)
+        ctx = jax.profiler.trace(str(trace_dir))
+    with contextlib.ExitStack() as stack:
+        if not _spans.enabled():
+            # without a live session span() is the no-op singleton: no
+            # TraceAnnotation would name the perfetto regions and the
+            # stage spans would never block — run under a registry-backed
+            # session (the serve-gateway discipline) so the advertised
+            # span-named trace holds with or without --telemetry
+            stack.enter_context(_spans.active())
+        stack.enter_context(profiling())
+        stack.enter_context(ctx)
+        if workload == "serve":
+            if bundle is None:
+                raise ValueError(
+                    "profile workload 'serve' needs --bundle DIR")
+            out = profile_serve(bundle, quick=quick)
+        else:
+            out = profile_north_star(n_log2, quick=quick)
+    if trace_dir is not None:
+        out["trace_dir"] = str(trace_dir)
+    emit_record("profile", out)
+    return out

@@ -1,0 +1,557 @@
+"""The perf ledger, roofline accounting and the noise-aware regression gate.
+
+Three pieces close the performance-observability loop the bench headlines
+never had:
+
+- **the ``orp-perf-v1`` ledger** (``PERF_LEDGER.jsonl``) — a committed,
+  schema-versioned time series of performance measurements. Every
+  ``bench.py`` / ``serve-bench`` / ``orp profile`` run appends one record
+  per measured phase: REPEATS with median + IQR (the repo's own
+  statistical discipline — Owen 1997 replicate CIs — applied to
+  wall-clock: never one number), plus the device/topology/config
+  fingerprint the measurement is only comparable under. Records are
+  append-only JSON lines validated like the sink's envelopes
+  (:func:`validate_perf_record`); a torn tail (a killed bench) is
+  tolerated on read and healed on the next append.
+- **roofline accounting** — join the ``cost_analysis`` FLOPs/bytes the
+  AOT path already captures (``aot/compile.py::cost_summary``) with
+  measured execute walls: achieved FLOP/s, bytes/s and fraction-of-peak
+  per executable/bucket. Peaks come from :data:`PEAK_TABLE` keyed by
+  ``device_kind`` (published per-chip numbers); an unknown device falls
+  back to a MEASURED matmul peak (``peak_source="measured_matmul"``) so
+  the fraction is always against a real ceiling, never a guess.
+- **``orp perf-gate``** — compare the current run's median against the
+  ledger's matching-fingerprint history with a noise-aware verdict: a
+  regression is a median outside ``k * IQR`` of the history AND past a
+  relative floor (container noise moves medians a few percent; k*IQR of
+  an honest history absorbs it), with a minimum-repeats refusal in
+  flag-speak. The gate records its measurement through obs BEFORE the
+  verdict — a tripped gate nobody can see in telemetry is a silent
+  rollback (the ORP016 discipline, applied here by construction).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+PERF_SCHEMA = "orp-perf-v1"
+PERF_LEDGER_FILE = "PERF_LEDGER.jsonl"
+
+#: gate defaults: the band multiplier and the honest-minimum repeat count
+GATE_K = 4.0
+GATE_MIN_REPEATS = 3
+#: relative floor under which a median move is container noise by fiat —
+#: k*IQR of a tight history can be microseconds, and a 2% scheduler wobble
+#: must not read as a regression
+GATE_REL_FLOOR = 0.05
+
+_REQUIRED = {"schema": str, "workload": str, "phase": str, "unit": str,
+             "repeats": int, "median": float, "iqr": float,
+             "fingerprint": dict}
+
+
+def summarize_repeats(samples) -> dict:
+    """Median + IQR (and the quartiles/extremes) over repeated measurements
+    — the shape every ledger record and every bench headline phase carries.
+    Raises on an empty sample set: a summary of nothing is a lie."""
+    xs = sorted(float(s) for s in samples)
+    if not xs:
+        raise ValueError("summarize_repeats: no samples")
+    p25, p50, p75 = (float(v) for v in np.percentile(xs, [25, 50, 75]))
+    return {
+        "repeats": len(xs),
+        "median": p50,
+        "iqr": p75 - p25,
+        "p25": p25,
+        "p75": p75,
+        "min": xs[0],
+        "max": xs[-1],
+    }
+
+
+def policy_digest(policy) -> str | None:
+    """The 12-hex policy identity perf records fingerprint on — a DIGEST
+    of the full compatibility string, never a repr prefix (the string's
+    first chars are the schema tag, identical across every bundle).
+    None when ``policy`` carries no fingerprint (e.g. a raw
+    ``PipelineResult``)."""
+    fp = getattr(policy, "fingerprint", None)
+    if fp is None:
+        return None
+    return hashlib.sha256(str(fp).encode()).hexdigest()[:12]
+
+
+def perf_fingerprint(extra: dict | None = None) -> dict:
+    """The identity a measurement is only comparable under: platform,
+    device kind/count and jax version, plus any workload-config fields the
+    caller adds (rows, paths, bundle fingerprint...)."""
+    fp: dict = {}
+    try:
+        import jax
+
+        dev = jax.devices()[0]  # orp: noqa[ORP011] -- topology introspection: device 0 names the platform/kind shared by the fleet
+        fp.update(platform=dev.platform, device_kind=dev.device_kind,
+                  n_devices=jax.local_device_count(), jax=jax.__version__)
+    except Exception as e:  # orp: noqa[ORP009] -- the degradation IS recorded: it lands in the fingerprint's jax_error field
+        fp["jax_error"] = f"{type(e).__name__}: {e}"
+    if extra:
+        fp.update(extra)
+    return fp
+
+
+def make_record(workload: str, phase: str, samples, *, unit: str = "s",
+                direction: str = "lower", fingerprint_extra: dict | None = None,
+                extra: dict | None = None) -> dict:
+    """One stamped ``orp-perf-v1`` record from raw repeat samples."""
+    rec = {
+        "schema": PERF_SCHEMA,
+        "ts_unix": time.time(),
+        "workload": str(workload),
+        "phase": str(phase),
+        "unit": str(unit),
+        "direction": str(direction),
+        **summarize_repeats(samples),
+        "fingerprint": perf_fingerprint(fingerprint_extra),
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def make_record_from_summary(workload: str, phase: str, *, repeats: int,
+                             median: float, iqr: float, unit: str = "s",
+                             direction: str = "lower",
+                             fingerprint_extra: dict | None = None,
+                             extra: dict | None = None) -> dict:
+    """A stamped record from an ALREADY-summarized phase (the bench phases
+    carry median/IQR, not raw samples) — same schema, same validation."""
+    rec = {
+        "schema": PERF_SCHEMA,
+        "ts_unix": time.time(),
+        "workload": str(workload),
+        "phase": str(phase),
+        "unit": str(unit),
+        "direction": str(direction),
+        "repeats": int(repeats),
+        "median": float(median),
+        "iqr": float(iqr),
+        "fingerprint": perf_fingerprint(fingerprint_extra),
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def validate_perf_record(rec: dict) -> list[str]:
+    """Schema check for one parsed ledger line; returns problems (empty =
+    valid) — the same contract shape as ``obs.sink.validate_event``."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, expected dict"]
+    for key, typ in _REQUIRED.items():
+        if key not in rec:
+            problems.append(f"missing key {key!r}")
+        elif typ in (int, float) and isinstance(rec[key], bool):
+            # bool subclasses int, so isinstance alone would bless
+            # {"repeats": true} — which gate() would then compute with
+            problems.append(f"{key}={rec[key]!r} is bool, expected "
+                            f"{typ.__name__}")
+        elif typ is float and isinstance(rec[key], int):
+            continue  # JSON integers are honest floats
+        elif not isinstance(rec[key], typ):
+            problems.append(f"{key}={rec[key]!r} is "
+                            f"{type(rec[key]).__name__}, expected "
+                            f"{typ.__name__}")
+    if rec.get("schema") not in (None, PERF_SCHEMA):
+        problems.append(f"schema {rec['schema']!r} != {PERF_SCHEMA!r}")
+    if isinstance(rec.get("repeats"), int) and rec["repeats"] < 1:
+        problems.append(f"repeats={rec['repeats']} < 1")
+    if rec.get("direction") not in (None, "lower", "higher"):
+        problems.append(f"direction {rec.get('direction')!r} is neither "
+                        "'lower' nor 'higher'")
+    return problems
+
+
+def read_ledger(path) -> tuple[list[dict], list[str]]:
+    """Parse a ledger into ``(records, problems)``. A torn LAST line (a
+    bench killed mid-append) is tolerated — noted in problems, skipped —
+    because the next append heals it; a torn line anywhere ELSE is
+    corruption and raises (an edited history must not quietly shrink)."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return [], []
+    text = p.read_text()
+    # only an UNTERMINATED last line is a crash artifact; a complete line
+    # that does not parse is corruption wherever it sits
+    ends_nl = text.endswith("\n")
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    records: list[dict] = []
+    problems: list[str] = []
+    for i, line in enumerate(lines):
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if i == len(lines) - 1 and not ends_nl:
+                problems.append(f"torn tail line skipped ({e})")
+                continue
+            raise ValueError(
+                f"{p}: line {i + 1} does not parse ({e}) — not the torn "
+                "tail; the ledger was edited or corrupted") from None
+    return records, problems
+
+
+def ledger_append(path, record: dict) -> dict:
+    """Append one validated record as a canonical JSON line, HEALING a torn
+    tail first: a last line with no trailing newline that does not parse (a
+    bench killed mid-append) is truncated away — the half-record holds no
+    usable measurement, and leaving it would turn the tolerated torn TAIL
+    into an intolerable torn MIDDLE line on the very next append. A
+    parseable-but-unterminated last line keeps its bytes and gains its
+    newline. Refuses an invalid record loudly."""
+    problems = validate_perf_record(record)
+    if problems:
+        raise ValueError(f"refusing to append an invalid perf record: "
+                         f"{problems}")
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    needs_nl = False
+    if p.exists() and p.stat().st_size > 0:
+        # the torn tail only ever occupies the LAST line, so read only the
+        # file tail (O(1) in ledger size — an append-only time series must
+        # not cost a full-history read per record); records are a few
+        # hundred bytes, so one 64KiB window covers any honest tail
+        with open(p, "rb") as f:
+            size = f.seek(0, 2)
+            back = min(size, 65536)
+            f.seek(size - back)
+            chunk = f.read(back)
+        if not chunk.endswith(b"\n"):
+            nl = chunk.rfind(b"\n")
+            if nl < 0 and back < size:
+                chunk = p.read_bytes()  # pathological >64KiB last line
+                nl = chunk.rfind(b"\n")
+            tail = chunk[nl + 1:]
+            try:
+                json.loads(tail.decode("utf-8"))
+                needs_nl = True  # complete record, just unterminated
+            except (ValueError, UnicodeDecodeError):
+                with open(p, "ab") as f:
+                    f.truncate(size - len(tail))
+    with open(p, "a") as f:
+        if needs_nl:
+            f.write("\n")
+        f.write(json.dumps(record, sort_keys=False,
+                           separators=(",", ":")) + "\n")
+    return record
+
+
+def matching_history(records, current: dict) -> list[dict]:
+    """The ledger records ``current`` is comparable against: same workload
+    + phase + fingerprint (dict equality — a different device kind,
+    topology or config is a different experiment, not a history), the
+    current record itself excluded by timestamp identity."""
+    cur_fp = current.get("fingerprint")
+    return [r for r in records
+            if r.get("workload") == current.get("workload")
+            and r.get("phase") == current.get("phase")
+            and r.get("fingerprint") == cur_fp
+            and r.get("ts_unix") != current.get("ts_unix")]
+
+
+def gate(current: dict, history, *, k: float = GATE_K,
+         min_repeats: int = GATE_MIN_REPEATS,
+         rel_floor: float = GATE_REL_FLOOR) -> dict:
+    """The noise-aware verdict: is ``current`` a real regression against
+    ``history``?
+
+    - ``refused`` when either side carries fewer than ``min_repeats``
+      repeats — a median of two draws has no IQR worth gating on; the
+      reason says which flag to raise.
+    - ``no_history`` (green) when no matching-fingerprint history exists —
+      the current record BECOMES the baseline.
+    - ``regression`` when the current median is outside ``k * scale`` of
+      the history median in the bad direction AND past ``rel_floor``
+      relative — ``scale`` is the larger of the history's median IQR and
+      the IQR of its medians, so both within-run and between-run noise
+      widen the band.
+    - ``ok`` otherwise (container noise stays green).
+
+    The caller records the measurement through obs BEFORE acting on the
+    verdict (``gate_cli`` does; the ORP016 discipline)."""
+    verdict: dict = {
+        "k": float(k), "min_repeats": int(min_repeats),
+        "rel_floor": float(rel_floor),
+        "current_median": current.get("median"),
+        "current_repeats": current.get("repeats"),
+    }
+    if int(current.get("repeats") or 0) < min_repeats:
+        verdict.update(ok=False, verdict="refused", reason=(
+            f"current run has {current.get('repeats')} repeat(s), the gate "
+            f"needs >= {min_repeats} — raise --repeats (a one-draw median "
+            "has no noise band to judge against)"))
+        return verdict
+    thin = [h for h in history
+            if int(h.get("repeats") or 0) < min_repeats]
+    history = [h for h in history
+               if int(h.get("repeats") or 0) >= min_repeats]
+    if not history:
+        if thin:
+            # matching history EXISTS but none of it is judgeable — the
+            # "either side" half of the min-repeats contract: refusing
+            # beats silently re-seeding a green baseline over it
+            verdict.update(ok=False, verdict="refused", reason=(
+                f"all {len(thin)} matching-fingerprint history record(s) "
+                f"carry fewer than {min_repeats} repeats — re-measure the "
+                "baseline with --repeats raised (a one-draw history has "
+                "no noise band to judge against)"))
+            return verdict
+        verdict.update(ok=True, verdict="no_history", reason=(
+            "no matching-fingerprint history — this record seeds the "
+            "baseline"))
+        return verdict
+    meds = [float(h["median"]) for h in history]
+    iqrs = [float(h.get("iqr") or 0.0) for h in history]
+    hist_median = float(np.median(meds))
+    scale = max(float(np.median(iqrs)),
+                float(np.subtract(*np.percentile(meds, [75, 25]))))
+    cur = float(current["median"])
+    direction = current.get("direction", "lower")
+    delta = cur - hist_median if direction == "lower" else hist_median - cur
+    rel = delta / abs(hist_median) if hist_median else 0.0
+    regressed = delta > k * scale and rel > rel_floor
+    verdict.update(
+        ok=not regressed,
+        verdict="regression" if regressed else "ok",
+        history_runs=len(history),
+        history_median=hist_median,
+        band=k * scale,
+        delta=delta,
+        rel_delta=round(rel, 4),
+        reason=(
+            f"median {cur:.6g}{current.get('unit', '')} vs history "
+            f"{hist_median:.6g} ({'+' if rel >= 0 else ''}{rel * 100:.1f}%), "
+            f"band k*scale={k * scale:.3g}"
+            + (" — REAL regression (outside the noise band and past the "
+               "relative floor)" if regressed else " — within noise")),
+    )
+    return verdict
+
+
+# -- roofline -----------------------------------------------------------------
+
+#: published per-chip peaks keyed by jax ``device_kind``. FLOP/s is the
+#: F32-EQUIVALENT matmul ceiling for this repo's workload (matmuls pinned to
+#: f32 via utils/precision.py; on TPU that lowers to a ~6-pass bf16
+#: decomposition, so the f32 ceiling is the published bf16 peak / 6 —
+#: utils/flops.py documents the same convention). bytes/s is published HBM
+#: bandwidth. Unknown kinds fall back to a measured matmul peak.
+PEAK_TABLE: dict[str, dict] = {
+    "TPU v3": {"flops_per_s": 123e12 / 6, "bytes_per_s": 900e9,
+               "note": "123T bf16-era peak / 6-pass f32"},
+    "TPU v4": {"flops_per_s": 275e12 / 6, "bytes_per_s": 1228e9,
+               "note": "275T bf16 / 6-pass f32"},
+    "TPU v5 lite": {"flops_per_s": 197e12 / 6, "bytes_per_s": 819e9,
+                    "note": "197T bf16 / 6-pass f32 (v5e)"},
+    "TPU v5e": {"flops_per_s": 197e12 / 6, "bytes_per_s": 819e9,
+                "note": "197T bf16 / 6-pass f32"},
+    "TPU v5p": {"flops_per_s": 459e12 / 6, "bytes_per_s": 2765e9,
+                "note": "459T bf16 / 6-pass f32"},
+    "TPU v6 lite": {"flops_per_s": 918e12 / 6, "bytes_per_s": 1640e9,
+                    "note": "918T bf16 / 6-pass f32 (v6e)"},
+}
+
+_MEASURED_PEAK: dict[str, float] = {}
+
+
+def measured_matmul_peak(n: int = 512, repeats: int = 5) -> float:
+    """FLOP/s of the best of ``repeats`` dense f32 ``n x n`` matmuls — the
+    fallback ceiling for a ``device_kind`` the table does not cover. Cached
+    per process (the probe costs milliseconds, doctor and every roofline
+    join may ask repeatedly)."""
+    key = f"{n}"
+    hit = _MEASURED_PEAK.get(key)
+    if hit is not None:
+        return hit
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda x: x @ x)  # orp: noqa[ORP003] -- one-shot probe, result cached per process in _MEASURED_PEAK
+    jax.block_until_ready(f(a))  # compile outside the timed reps
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a))
+        best = min(best, time.perf_counter() - t0)
+    peak = 2.0 * n ** 3 / best
+    _MEASURED_PEAK[key] = peak
+    return peak
+
+
+def peak_for(device_kind: str | None = None) -> tuple[dict, str]:
+    """``(peak_entry, source)`` for a device kind: the published table row
+    (``source="table"``) or the measured-matmul fallback
+    (``source="measured_matmul"``, bytes/s None — honest absence beats a
+    fabricated bandwidth). ``device_kind=None`` reads this process's."""
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind  # orp: noqa[ORP011] -- topology introspection: the kind is fleet-wide
+    entry = PEAK_TABLE.get(str(device_kind))
+    if entry is not None:
+        return dict(entry), "table"
+    return {"flops_per_s": measured_matmul_peak(), "bytes_per_s": None,
+            "note": f"measured f32 matmul peak ({device_kind!r} not in "
+                    "PEAK_TABLE)"}, "measured_matmul"
+
+
+def roofline(flops: float | None, bytes_accessed: float | None,
+             wall_s: float, *, device_kind: str | None = None) -> dict:
+    """Join a program's cost_analysis FLOPs/bytes with a measured execute
+    wall: achieved FLOP/s, bytes/s and fraction-of-peak. Fields are None
+    when the corresponding cost or peak is unavailable — a roofline that
+    fabricates a denominator is worse than none."""
+    if wall_s <= 0:
+        raise ValueError(f"roofline: wall_s={wall_s} must be > 0")
+    peak, source = peak_for(device_kind)
+    out: dict = {"wall_s": round(float(wall_s), 9), "peak_source": source,
+                 "peak_flops_per_s": peak["flops_per_s"],
+                 "peak_bytes_per_s": peak["bytes_per_s"]}
+    if flops:
+        achieved = float(flops) / wall_s
+        out["achieved_flops_per_s"] = round(achieved, 1)
+        # 12 decimals: a tiny bucket program on a big chip sits at ~1e-7
+        # of peak, and a 6-decimal round would flatten real fractions to 0
+        out["frac_peak_flops"] = round(achieved / peak["flops_per_s"], 12)
+    else:
+        out["achieved_flops_per_s"] = out["frac_peak_flops"] = None
+    if bytes_accessed and peak["bytes_per_s"]:
+        bps = float(bytes_accessed) / wall_s
+        out["achieved_bytes_per_s"] = round(bps, 1)
+        out["frac_peak_bytes"] = round(bps / peak["bytes_per_s"], 12)
+    else:
+        out["achieved_bytes_per_s"] = out["frac_peak_bytes"] = None
+    return out
+
+
+# -- the perf-gate measurement + CLI driver -----------------------------------
+
+
+def measure_serve_phase(policy, *, repeats: int = 5, evals: int = 32,
+                        rows: int = 64, seed: int = 0) -> dict:
+    """The gate's own measurement: ``repeats`` timed passes of ``evals``
+    blocking engine evaluations at a fixed ``rows`` shape (prewarmed — the
+    window is compile-free), summarized into one ledger record. The
+    existing guard fault sites (``serve/dispatch``/``serve/execute``) sit
+    inside the measured path, so an injected delay shows up here exactly
+    like a real slowdown — which is how the trip test proves the gate."""
+    import numpy as np
+
+    from orp_tpu.serve.engine import HedgeEngine
+
+    engine = HedgeEngine(policy)
+    nf = engine.model.n_features
+    feats = (1.0 + 0.1 * np.random.default_rng(seed)
+             .standard_normal((rows, nf))).astype(np.float32)
+    engine.prewarm([rows])
+    samples = []
+    for _ in range(int(repeats)):
+        t0 = time.perf_counter()
+        for i in range(int(evals)):
+            # evaluate() blocks on the device result internally (the span
+            # is device-complete), so the repeat wall is honest
+            engine.evaluate(i % engine.n_dates, feats)
+        samples.append(time.perf_counter() - t0)
+    fp_extra = {"rows": int(rows), "evals": int(evals)}
+    digest = policy_digest(policy)
+    if digest is not None:
+        fp_extra["policy"] = digest
+    return make_record("serve_engine", "evaluate", samples,
+                       fingerprint_extra=fp_extra,
+                       extra={"rows": int(rows), "evals": int(evals)})
+
+
+def gate_cli(*, ledger, bundle=None, workload: str | None = None,
+             phase: str | None = None, repeats: int = 5, evals: int = 32,
+             rows: int = 64, k: float = GATE_K,
+             min_repeats: int = GATE_MIN_REPEATS) -> dict:
+    """The ``orp perf-gate`` driver. With ``bundle``: measure the serve
+    phase NOW, gate it against the prior matching-fingerprint history, and
+    append it to the ledger ONLY on a green verdict — a regressed
+    measurement must never enter the history, or re-running the gate on a
+    regressed build would shift the baseline until the regression reads
+    green (the self-healing-gate hole). Without: gate the ledger's newest
+    record (optionally selected by workload/phase) against its own
+    history. The measurement reaches obs BEFORE the verdict is returned
+    either way."""
+    from orp_tpu.obs.spans import count as obs_count
+    from orp_tpu.obs.spans import observe as obs_observe
+
+    records, problems = read_ledger(ledger)
+    # a parseable-but-invalid record (hand-edited, foreign tool) must never
+    # be judged or serve as history — exclude it with a problem note so the
+    # verdict path only ever touches schema-true orp-perf-v1 records
+    valid: list[dict] = []
+    for i, r in enumerate(records):
+        why = validate_perf_record(r)
+        if why:
+            problems.append(
+                f"record {i + 1} excluded (not a valid orp-perf-v1 "
+                f"record: {'; '.join(why)})")
+        else:
+            valid.append(r)
+    records = valid
+    appended = False
+    if bundle is not None:
+        policy = bundle
+        if isinstance(bundle, (str, pathlib.Path)):
+            from orp_tpu.serve.bundle import load_bundle
+
+            policy = load_bundle(bundle)
+        current = measure_serve_phase(policy, repeats=repeats, evals=evals,
+                                      rows=rows)
+        history = matching_history(records, current)
+    else:
+        pool = [r for r in records
+                if (workload is None or r.get("workload") == workload)
+                and (phase is None or r.get("phase") == phase)]
+        if not pool:
+            excluded = "; ".join(p for p in problems if "excluded" in p)
+            raise ValueError(
+                f"no ledger records match workload={workload!r} "
+                f"phase={phase!r} in {ledger} — run `orp profile`/"
+                "`orp serve-bench` (or `orp perf-gate --bundle DIR`) to "
+                "seed one"
+                + (f" ({excluded} — move the corrupt ledger aside)"
+                   if excluded else ""))
+        current = pool[-1]
+        history = matching_history(pool, current)
+    # the measurement reaches obs BEFORE the verdict (ORP016 discipline):
+    # a tripped gate must be visible in telemetry, not only in an exit code.
+    # Medians arrive in the record's own unit (s, req/s, ns, ms) — phase and
+    # unit ride as labels so the series never pools incompatible units.
+    obs_observe("perf/gate_median", float(current["median"]),
+                workload=str(current["workload"]),
+                phase=str(current.get("phase", "")),
+                unit=str(current.get("unit", "")))
+    verdict = gate(current, history, k=k, min_repeats=min_repeats)
+    if not verdict["ok"]:
+        obs_count("perf/gate_trip", verdict=verdict["verdict"])
+    elif bundle is not None:
+        try:
+            ledger_append(ledger, current)
+            appended = True
+        except (OSError, ValueError) as e:
+            # a GREEN verdict on a read-only ledger is still a green
+            # verdict — the gate's job is the judgement, not the append
+            # (the bench.py / serve-bench / profile append discipline)
+            print(f"perf-ledger append failed: {e}", file=sys.stderr)
+            problems.append(f"append failed: {e}")
+    return {"ledger": str(ledger), "ledger_problems": problems,
+            "record": current, "appended": appended, **verdict}

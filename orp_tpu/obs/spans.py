@@ -30,6 +30,7 @@ import secrets
 import threading
 import time
 
+from orp_tpu.obs import devprof as _devprof
 from orp_tpu.obs.registry import Registry
 
 _tls = threading.local()
@@ -159,10 +160,18 @@ class Span:
 
     def __exit__(self, exc_type, exc, tb):
         ok = exc_type is None
+        # device-time attribution (obs/devprof): with the flag-gated
+        # profiling mode on, stamp the instant the block STARTS so the
+        # span's wall splits into host_s (Python + dispatch) and device_s
+        # (the blocked tail) — summing to dur_s exactly. One module-global
+        # load + is-None test when attribution is off.
+        t_pre = None
         try:
             if self._result is not None and ok:
                 import jax
 
+                if _devprof._STATE is not None:
+                    t_pre = time.perf_counter()
                 jax.block_until_ready(self._result)
         except BaseException:
             ok = False
@@ -173,7 +182,8 @@ class Span:
             # thread-local stack would corrupt parent attribution for every
             # later span on this thread, and an unexited TraceAnnotation
             # would leak its profiler region open
-            dur = time.perf_counter() - self._t0
+            t_done = time.perf_counter()
+            dur = t_done - self._t0
             self._annotation.__exit__(exc_type, exc, tb)
             stack = _span_stack()
             if stack and stack[-1] is self:
@@ -182,11 +192,18 @@ class Span:
             st.registry.histogram(
                 "span_seconds", {"name": self.name}).observe(dur)
             st.registry.counter("spans_total", {"name": self.name}).inc()
+            if t_pre is not None:
+                st.registry.histogram(
+                    "span_device_seconds",
+                    {"name": self.name}).observe(t_done - t_pre)
             if st.sink is not None:
                 event = {
                     "type": "span", "name": self.name, "dur_s": round(dur, 9),
                     "parent": self.parent, "ok": ok,
                 }
+                if t_pre is not None:
+                    event["host_s"] = round(t_pre - self._t0, 9)
+                    event["device_s"] = round(t_done - t_pre, 9)
                 if self.attrs:
                     event["attrs"] = self.attrs
                 st.sink.emit(event)

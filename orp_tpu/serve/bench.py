@@ -37,6 +37,8 @@ import time
 import numpy as np
 
 from orp_tpu import obs
+from orp_tpu.obs import devprof as _devprof
+from orp_tpu.obs import perf as _perf
 from orp_tpu.serve.batcher import MicroBatcher
 from orp_tpu.serve.engine import HedgeEngine
 from orp_tpu.serve.metrics import ServingMetrics
@@ -45,6 +47,10 @@ DEFAULT_BATCH_SIZES = (1, 7, 64, 1000)
 # low levels on purpose: submitters are pure-Python threads, and past ~4 of
 # them GIL churn starves the dispatch loop instead of feeding it
 DEFAULT_SWEEP_CONCURRENCY = (1, 2, 4)
+# headline phases repeat this many times by default — no committed headline
+# is ever a single draw (the perf ledger's Owen-style replicate discipline
+# applied to wall clock; median + IQR ride every phase record)
+DEFAULT_REPEATS = 3
 
 
 def _phase_metrics(phase: str) -> ServingMetrics:
@@ -79,7 +85,38 @@ def _request_stream(rng, n_requests, batch_sizes, n_dates, n_features):
 
 def _sweep_level(engine, *, concurrency: int, n_requests: int,
                  max_batch: int, max_wait_us: float, seed: int,
-                 window: int | None = None) -> dict:
+                 window: int | None = None,
+                 repeats: int = DEFAULT_REPEATS) -> dict:
+    """One sweep point, measured ``repeats`` times: EVERY point field of
+    the committed row comes from the median-throughput run (the element
+    median — no interpolation), so the row is one internally-consistent
+    draw (``rows_per_s == requests_per_s``, ``requests/wall_s``
+    reproduces the headline, p50 <= p99 pointwise) sitting at the median
+    of its repeats; the cross-run IQRs ride alongside
+    (``repeats``/``requests_per_s_iqr``/``p99_ms_iqr``) — a sweep
+    headline is never one draw."""
+    runs = [
+        _sweep_level_once(engine, concurrency=concurrency,
+                          n_requests=n_requests, max_batch=max_batch,
+                          max_wait_us=max_wait_us, seed=seed + 7919 * r,
+                          window=window)
+        for r in range(max(1, int(repeats)))
+    ]
+    rps = _perf.summarize_repeats([r_["requests_per_s"] for r_ in runs])
+    p99 = _perf.summarize_repeats([r_["p99_ms"] for r_ in runs])
+    out = dict(sorted(runs, key=lambda r_: r_["requests_per_s"])
+               [len(runs) // 2])
+    out.update(
+        repeats=rps["repeats"],
+        requests_per_s_iqr=round(rps["iqr"], 2),
+        p99_ms_iqr=round(p99["iqr"], 4),
+    )
+    return out
+
+
+def _sweep_level_once(engine, *, concurrency: int, n_requests: int,
+                      max_batch: int, max_wait_us: float, seed: int,
+                      window: int | None = None) -> dict:
     """One sweep point: ``concurrency`` threads each stream their share of
     ``n_requests`` single-row requests through ONE continuous batcher,
     timed submit-to-all-resolved. Open-loop by default (every request
@@ -192,29 +229,39 @@ def _mesh_sweep_phase(policy, mesh_sizes, *, rows: int, repeats: int,
 
 
 def _columnar_level(engine, feats, bsz: int, top: int, max_wait_us: float,
-                    pin) -> dict:
-    """One columnar-lane point: the full row set through ``submit_block``
-    at block size ``bsz``; ``submit_ns_per_row`` times the submit calls
-    only (the admission cost being amortized), ``ingest_rows_per_s`` the
-    end-to-end serve."""
+                    pin, repeats: int = DEFAULT_REPEATS) -> dict:
+    """One columnar-lane point, measured ``repeats`` times: the full row
+    set through ``submit_block`` at block size ``bsz``;
+    ``submit_ns_per_row`` times the submit calls only (the admission cost
+    being amortized), ``ingest_rows_per_s`` the end-to-end serve — both
+    reported as medians across repeats with IQRs alongside."""
     rows = feats.shape[0]
-    with MicroBatcher(engine, max_batch=max(top, bsz),
-                      max_wait_us=max_wait_us) as mb:
-        t0 = time.perf_counter()
-        futures = [mb.submit_block(0, feats[o:o + bsz])
-                   for o in range(0, rows, bsz)]
-        t1 = time.perf_counter()
-        results = [f.result(timeout=120) for f in futures]
-        t_done = time.perf_counter()
-    pin(np.concatenate([r.phi for r in results]),
-        np.concatenate([r.psi for r in results]), f"columnar@{bsz}")
-    if any(r.status.any() for r in results):
-        raise RuntimeError("columnar lane shed rows with no guard policy "
-                           "installed")
+    submit_ns, rows_per_s = [], []
+    for _ in range(max(1, int(repeats))):
+        with MicroBatcher(engine, max_batch=max(top, bsz),
+                          max_wait_us=max_wait_us) as mb:
+            t0 = time.perf_counter()
+            futures = [mb.submit_block(0, feats[o:o + bsz])
+                       for o in range(0, rows, bsz)]
+            t1 = time.perf_counter()
+            results = [f.result(timeout=120) for f in futures]
+            t_done = time.perf_counter()
+        pin(np.concatenate([r.phi for r in results]),
+            np.concatenate([r.psi for r in results]), f"columnar@{bsz}")
+        if any(r.status.any() for r in results):
+            raise RuntimeError("columnar lane shed rows with no guard "
+                               "policy installed")
+        submit_ns.append((t1 - t0) / rows * 1e9)
+        rows_per_s.append(rows / (t_done - t0))
+    sub = _perf.summarize_repeats(submit_ns)
+    rps = _perf.summarize_repeats(rows_per_s)
     return {
         "block": bsz,
-        "submit_ns_per_row": round((t1 - t0) / rows * 1e9, 1),
-        "ingest_rows_per_s": round(rows / (t_done - t0), 1),
+        "repeats": sub["repeats"],
+        "submit_ns_per_row": round(sub["median"], 1),
+        "submit_ns_per_row_iqr": round(sub["iqr"], 1),
+        "ingest_rows_per_s": round(rps["median"], 1),
+        "ingest_rows_per_s_iqr": round(rps["iqr"], 1),
     }
 
 
@@ -359,6 +406,45 @@ def _trace_bill_s(feats, iters: int = 2000) -> float:
     return walls[1]
 
 
+PROFILE_OVERHEAD_GATE_PCT = 5.0
+
+
+def _profile_overhead(disabled_ns_per_row: float, block: int = 1024) -> dict:
+    """Device-attribution cost on the columnar lane — what the flag-gated
+    profiling mode (``obs/devprof``) ADDS to one dispatch, measured in a
+    tight loop: the dispatch-instant stamp plus ``DevProf.complete`` (the
+    completion chain, the rolling-utilization window, the two per-bucket
+    histogram observes and the gauge write), amortized over the headline
+    block and divided by the measured disabled-lane ns/row — the same
+    tight-numerator / robust-denominator estimator the trace and drift
+    overhead phases use. The DISABLED mode is the shared no-op discipline
+    (one module-global load + ``is None`` test, pinned like spans in
+    tests/test_perf.py) and is therefore not re-measured here."""
+    from orp_tpu.obs.sink import ListSink
+
+    iters = 2000
+    with obs.suspended(), obs.active(sink=ListSink()):
+        with _devprof.profiling() as prof:
+
+            def batch() -> float:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    t_d = time.perf_counter()  # the dispatch stamp
+                    prof.complete(t_d, t_d, bucket=block)
+                return (time.perf_counter() - t0) / iters
+
+            walls = sorted(batch() for _ in range(3))
+    bill_s = walls[1]
+    overhead_pct = (bill_s / block * 1e9) / disabled_ns_per_row * 100.0
+    return {
+        "block": int(block),
+        "profile_bill_us_per_dispatch": round(bill_s * 1e6, 3),
+        "disabled_ns_per_row": round(disabled_ns_per_row, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "gate_pct": PROFILE_OVERHEAD_GATE_PCT,
+    }
+
+
 DRIFT_OVERHEAD_GATE_PCT = 5.0
 
 
@@ -421,7 +507,8 @@ def _gateway_level(client, feats, bsz: int, pin) -> dict:
 
 
 def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
-                  max_wait_us: float = 200.0) -> dict:
+                  max_wait_us: float = 200.0,
+                  repeats: int = DEFAULT_REPEATS) -> dict:
     """The columnar-ingest sweep (CLI ``serve-bench --ingest``): the SAME
     feature rows through three lanes, timed where each lane pays its
     Python —
@@ -473,27 +560,37 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
                 "engine.evaluate of the same rows — a broken lane, not a "
                 "fast one")
 
-    # lane 1: per-request — the measured ceiling this plane exists to break
-    with MicroBatcher(engine, max_batch=top, max_wait_us=max_wait_us) as mb:
-        futures = []
-        t0 = time.perf_counter()
-        for i in range(rows):
-            futures.append(mb.submit(0, feats[i:i + 1]))  # orp: noqa[ORP013] -- this loop IS the per-request lane being measured (the ceiling the columnar lane is compared against)
-        t1 = time.perf_counter()
-        got = [f.result(timeout=120) for f in futures]
-        t_done = time.perf_counter()
-    _pin(np.concatenate([g[0] for g in got]),
-         np.concatenate([g[1] for g in got]), "per_request")
+    # lane 1: per-request — the measured ceiling this plane exists to break.
+    # Repeated like every headline phase: the ns/row ceiling is a median.
+    pr_submit, pr_rate = [], []
+    for _ in range(max(1, int(repeats))):
+        with MicroBatcher(engine, max_batch=top,
+                          max_wait_us=max_wait_us) as mb:
+            futures = []
+            t0 = time.perf_counter()
+            for i in range(rows):
+                futures.append(mb.submit(0, feats[i:i + 1]))  # orp: noqa[ORP013] -- this loop IS the per-request lane being measured (the ceiling the columnar lane is compared against)
+            t1 = time.perf_counter()
+            got = [f.result(timeout=120) for f in futures]
+            t_done = time.perf_counter()
+        _pin(np.concatenate([g[0] for g in got]),
+             np.concatenate([g[1] for g in got]), "per_request")
+        pr_submit.append((t1 - t0) / rows * 1e9)  # orp: noqa[ORP013] -- one append per REPEAT (3 entries), not per row
+        pr_rate.append(rows / (t_done - t0))  # orp: noqa[ORP013] -- one append per REPEAT (3 entries), not per row
+    pr_sub = _perf.summarize_repeats(pr_submit)
     per_request = {
         "rows": rows,
-        "submit_ns_per_row": round((t1 - t0) / rows * 1e9, 1),
-        "rows_per_s": round(rows / (t_done - t0), 1),
+        "repeats": pr_sub["repeats"],
+        "submit_ns_per_row": round(pr_sub["median"], 1),
+        "submit_ns_per_row_iqr": round(pr_sub["iqr"], 1),
+        "rows_per_s": round(_perf.summarize_repeats(pr_rate)["median"], 1),
     }
 
     # lanes 2+3 iterate BLOCKS, not rows (the whole point) — list
     # comprehensions over the level helpers below, so the per-level work
     # stays out of ORP013's per-row-loop scope by construction
-    columnar = [_columnar_level(engine, feats, bsz, top, max_wait_us, _pin)
+    columnar = [_columnar_level(engine, feats, bsz, top, max_wait_us, _pin,
+                                repeats=repeats)
                 for bsz in block_sizes]
     with ServeHost(max_live_engines=1) as host:
         host.add_tenant("bench", policy)
@@ -511,6 +608,12 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
     # commitment, gated like tracing's)
     drift_overhead = _drift_overhead(
         feats, trace_overhead["disabled_ns_per_row"])
+    # device-attribution bill per dispatch (obs/devprof), same estimator,
+    # same denominator, same ≤5% commitment — the performance plane's cost
+    # is measured, never asserted
+    profile_overhead = _profile_overhead(
+        trace_overhead["disabled_ns_per_row"],
+        block=min(rows, 1024))
 
     # the LARGEST block is the amortization headline — by value, not list
     # position, so an unsorted --ingest-blocks cannot flip the CLI gate
@@ -523,6 +626,7 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
         "gateway": gateway,
         "trace_overhead": trace_overhead,
         "drift_overhead": drift_overhead,
+        "profile_overhead": profile_overhead,
         "submit_ns_per_row": best["submit_ns_per_row"],
         "ingest_rows_per_s": max(c["ingest_rows_per_s"] for c in columnar),
         "submit_speedup_vs_per_request": round(
@@ -536,7 +640,7 @@ def _ingest_phase(policy, *, rows: int, block_sizes, seed: int,
 
 def _gateway_drill(policy, *, blocks: int, block_rows: int,
                    kill_at_frame: int, seed: int,
-                   window: int = 8) -> dict:
+                   window: int = 8, repeats: int = DEFAULT_REPEATS) -> dict:
     """The gateway-kill chaos drill (CLI ``serve-bench --gateway-drill``):
     a :class:`~orp_tpu.serve.client.ResilientGatewayClient` streams
     ``blocks`` sequenced frames; right after the gateway ADMITS frame
@@ -643,25 +747,49 @@ def _gateway_drill(policy, *, blocks: int, block_rows: int,
         return concat_results(results), stats, totals, mttr_ms
 
     base, _, _, _ = run(kill=False)
-    served, stats, totals, mttr_ms = run(kill=True)
     total_rows = blocks * block_rows
-    bits_equal = bool(np.array_equal(served.phi, base.phi)
-                      and np.array_equal(served.psi, base.psi)
-                      and np.array_equal(served.status, base.status))
+    # the kill run repeats (the baseline's answers never change): the
+    # headline MTTR is a median with an IQR, and the delivery contracts
+    # (zero lost, zero duplicated, bits equal) must hold on EVERY run
+    mttrs: list[float] = []
+    rep = None  # ((rows_lost, duplicate_serves), served, stats, totals)
+    bits_equal_all = True
+    for _ in range(max(1, int(repeats))):
+        served, stats, totals, mttr_ms = run(kill=True)
+        bits_equal_all = bits_equal_all and bool(
+            np.array_equal(served.phi, base.phi)
+            and np.array_equal(served.psi, base.psi)
+            and np.array_equal(served.status, base.status))
+        badness = (total_rows - served.n_served,
+                   stats["duplicate_replies"])
+        # the representative run is the WORST one: rows_served/reconnects/
+        # replay counters and the contract fields must describe the SAME
+        # run, or a violating record reads rows_sent - rows_served !=
+        # rows_lost and points diagnosis at a run that lost nothing
+        # (healthy runs all tie at (0, 0) and the first is kept)
+        if rep is None or badness > rep[0]:
+            rep = (badness, served, stats, totals)
+        if mttr_ms is not None:
+            mttrs.append(mttr_ms)
+    (rows_lost, duplicate_serves), served, stats, totals = rep
+    mttr = _perf.summarize_repeats(mttrs) if mttrs else None
     return {
         "blocks": int(blocks),
         "block_rows": int(block_rows),
         "kill_at_frame": int(kill_at_frame),
+        "repeats": max(1, int(repeats)),
         "rows_sent": total_rows,
         "rows_served": served.n_served,
-        "rows_lost": total_rows - served.n_served,
-        "duplicate_serves": stats["duplicate_replies"],
+        "rows_lost": rows_lost,
+        "duplicate_serves": duplicate_serves,
         "reconnects": stats["reconnects"],
         "replayed_frames": stats["replayed_frames"],
         "frames_submitted_total": totals["submitted_frames"],
         "replayed_from_cache": totals.get("replayed_from_cache", 0),
-        "mttr_ms": mttr_ms,
-        "replayed_bits_equal": bits_equal,
+        "mttr_ms": None if mttr is None else round(mttr["median"], 1),
+        "mttr_ms_iqr": None if mttr is None else round(mttr["iqr"], 1),
+        "mttr_runs": len(mttrs),
+        "replayed_bits_equal": bits_equal_all,
     }
 
 
@@ -763,6 +891,7 @@ def serve_bench(
     drill_blocks: int = 64,
     drill_block_rows: int = 256,
     drill_kill_at: int = 20,
+    repeats: int = DEFAULT_REPEATS,
     previous: dict | None = None,
 ) -> dict:
     """Run the three phases against ``policy`` (a ``PolicyBundle`` or a
@@ -824,6 +953,10 @@ def serve_bench(
     engine.prewarm(sizes)
     warm_misses = engine.misses
 
+    # the timed engine phase runs CLEAN — the headline req/s and latency
+    # percentiles must be measured under the same conditions as every
+    # pre-attribution record they are compared against (the attribution
+    # bill is real, ~µs/dispatch: profile_overhead measures it)
     metrics = _phase_metrics("engine")
     for date_idx, feats in _request_stream(
             rng, n_requests, batch_sizes, engine.n_dates, n_features):
@@ -831,8 +964,38 @@ def serve_bench(
         engine.evaluate(date_idx, feats)
         metrics.record(time.perf_counter() - t0, feats.shape[0])
     engine_summary = metrics.summary()
+    # snapshot the cache ledger NOW, before the attribution replay below
+    # re-dispatches the whole stream — the committed aot_hits/hit-rate
+    # must count the benched requests, not the instrumentation's
     cache = engine.cache_info()
     served = cache["hits"] + cache["misses"]
+
+    # device-time attribution (obs/devprof) rides a SEPARATE untimed
+    # replay of the same stream shape: every dispatch's wall splits into
+    # queue vs device seconds, read back from the DevProf's own windows
+    # (no telemetry session required), and the headline bucket's
+    # cost_analysis joins them into a roofline row
+    with _devprof.profiling() as dev_prof:
+        for date_idx, feats in _request_stream(
+                np.random.default_rng(seed + 1), n_requests, batch_sizes,
+                engine.n_dates, n_features):
+            engine.evaluate(date_idx, feats)
+        dev_stats = dev_prof.bucket_stats()
+        dev_util = dev_prof.utilization()
+    roofline_row = None
+    try:
+        cost = engine.program_cost(max(batch_sizes))
+        med = dev_stats.get(str(cost["bucket"]), {}).get("device_s_median")
+        if med and cost.get("flops"):
+            roofline_row = {
+                "bucket": cost["bucket"],
+                "flops": cost["flops"],
+                "bytes_accessed": cost.get("bytes_accessed"),
+                **_perf.roofline(cost["flops"], cost.get("bytes_accessed"),
+                                 med),
+            }
+    except Exception as e:  # orp: noqa[ORP009] -- degradation recorded: the error lands in the record's roofline field
+        roofline_row = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # batcher phase: a burst of single-row requests, coalesced by the
     # continuous dispatch loop (the legacy comparison shape: same burst the
@@ -853,7 +1016,7 @@ def serve_bench(
     sweep = [
         _sweep_level(engine, concurrency=c, n_requests=sweep_requests,
                      max_batch=sweep_max_batch, max_wait_us=max_wait_us,
-                     seed=seed + c)
+                     seed=seed + c, repeats=repeats)
         for c in sweep_concurrency
     ]
     best = max(sweep, key=lambda r: r["requests_per_s"]) if sweep else None
@@ -865,6 +1028,10 @@ def serve_bench(
         "n_requests": n_requests,
         "batch_sizes": list(batch_sizes),
         "n_dates": engine.n_dates,
+        # the policy identity the numbers belong to: the ledger
+        # fingerprint binds to it, so two bundles never pool into one
+        # perf-gate history
+        "policy": _perf.policy_digest(policy),
         "p50_ms": engine_summary["p50_ms"],
         "p95_ms": engine_summary["p95_ms"],
         "p99_ms": engine_summary["p99_ms"],
@@ -888,6 +1055,17 @@ def serve_bench(
         "batcher_p99_ms": batcher_summary["p99_ms"],
     }
     record["mesh_devices"] = cache["mesh_devices"]
+    # the performance-observatory columns: per-bucket queue/device split,
+    # the rolling device utilization, and the headline roofline join
+    record["device_utilization"] = round(dev_util, 4)
+    record["device_seconds"] = {
+        k: {"count": v["count"],
+            "device_s_median": round(v["device_s_median"], 7),
+            "queue_s_median": round(v["queue_s_median"], 7)}
+        for k, v in sorted(dev_stats.items(), key=lambda kv: int(kv[0]))
+    }
+    if roofline_row is not None:
+        record["roofline"] = roofline_row
     if mesh_sweep:
         record["mesh_sweep"] = _mesh_sweep_phase(
             policy, mesh_sweep, rows=mesh_sweep_rows,
@@ -903,7 +1081,8 @@ def serve_bench(
     if gateway_drill:
         drill = _gateway_drill(policy, blocks=drill_blocks,
                                block_rows=drill_block_rows,
-                               kill_at_frame=drill_kill_at, seed=seed)
+                               kill_at_frame=drill_kill_at, seed=seed,
+                               repeats=repeats)
         record["gateway_drill"] = drill
         if (drill["rows_lost"] or drill["duplicate_serves"]
                 or not drill["replayed_bits_equal"]):
@@ -916,13 +1095,26 @@ def serve_bench(
     if ingest:
         ing = _ingest_phase(policy, rows=ingest_rows,
                             block_sizes=ingest_block_sizes, seed=seed,
-                            max_wait_us=max_wait_us)
+                            max_wait_us=max_wait_us, repeats=repeats)
         record["ingest"] = ing
         # the amortized-submit headlines, first-class like p99/mttr
         record["submit_ns_per_row"] = ing["submit_ns_per_row"]
         record["ingest_rows_per_s"] = ing["ingest_rows_per_s"]
         record["trace_overhead_pct"] = ing["trace_overhead"]["overhead_pct"]
         record["drift_overhead_pct"] = ing["drift_overhead"]["overhead_pct"]
+        record["profile_overhead_pct"] = (
+            ing["profile_overhead"]["overhead_pct"])
+        if ing["profile_overhead"]["overhead_pct"] > PROFILE_OVERHEAD_GATE_PCT:
+            # measured value recorded through obs BEFORE the verdict
+            # (ORP016): the record dict path below never runs on a raise
+            obs.count("quality/gate_trip", gate="profile_overhead")
+            raise RuntimeError(
+                "device-attribution overhead gate violated: the per-"
+                "dispatch profiling bill costs "
+                f"{ing['profile_overhead']['overhead_pct']}% of the "
+                f"disabled columnar lane (gate {PROFILE_OVERHEAD_GATE_PCT}"
+                "%) — the performance plane crept into the hot path; do "
+                "not commit this record")
         if ing["trace_overhead"]["overhead_pct"] > TRACE_OVERHEAD_GATE_PCT:
             # the measured value is already recorded (the record dict +
             # obs.emit_record below never runs on this path, so count the
@@ -996,3 +1188,64 @@ def write_bench_record(record: dict, path: str | pathlib.Path = "BENCH_serve.jso
     trailing newline, BENCH_r* style)."""
     p = pathlib.Path(path)
     p.write_text(json.dumps(record, indent=1, sort_keys=False) + "\n")
+
+
+def ledger_records(record: dict) -> list[dict]:
+    """The ``orp-perf-v1`` ledger rows a serve-bench record seeds: one per
+    headline phase that carries a repeats/median/IQR triple (sweep
+    sustained req/s, ingest submit ns/row + rows/s, drill MTTR). The
+    fingerprint binds each row to the benched configuration, so
+    ``orp perf-gate`` only ever compares like with like."""
+    out: list[dict] = []
+    cfg = {"n_dates": record.get("n_dates"),
+           "mesh_devices": record.get("mesh_devices"),
+           "policy": record.get("policy")}
+    sweep = record.get("sweep") or []
+    if sweep:
+        best = max(sweep, key=lambda r: r["requests_per_s"])
+        if "repeats" in best:
+            # the fingerprint binds to the SWEPT EXPERIMENT (every level
+            # tried), never the winning level: a regression that flips
+            # which concurrency wins must land in the SAME history and
+            # trip the gate, not seed a fresh green baseline under a
+            # never-seen fingerprint. The winner rides as a plain field.
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", "sweep_requests_per_s",
+                repeats=best["repeats"], median=best["requests_per_s"],
+                iqr=best.get("requests_per_s_iqr", 0.0), unit="req/s",
+                direction="higher",
+                fingerprint_extra={
+                    **cfg,
+                    "concurrency_levels": sorted(
+                        r["concurrency"] for r in sweep),
+                    # winner-INDEPENDENT: per-level requests round down to
+                    # concurrency * (n // concurrency), so best["requests"]
+                    # would re-open the winner-flip fresh-baseline hole
+                    # this fingerprint exists to close
+                    "requests": max(r["requests"] for r in sweep)},
+                extra={"winning_concurrency": best["concurrency"]}))
+    ing = record.get("ingest")
+    if ing:
+        best = max(ing["columnar"], key=lambda c: c["block"])
+        fp = {**cfg, "rows": ing["rows"], "block": best["block"]}
+        if "repeats" in best:
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", "ingest_submit_ns_per_row",
+                repeats=best["repeats"], median=best["submit_ns_per_row"],
+                iqr=best.get("submit_ns_per_row_iqr", 0.0), unit="ns",
+                direction="lower", fingerprint_extra=fp))
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", "ingest_rows_per_s",
+                repeats=best["repeats"], median=best["ingest_rows_per_s"],
+                iqr=best.get("ingest_rows_per_s_iqr", 0.0), unit="rows/s",
+                direction="higher", fingerprint_extra=fp))
+    drill = record.get("gateway_drill")
+    if drill and drill.get("mttr_ms") is not None and drill.get("mttr_runs"):
+        out.append(_perf.make_record_from_summary(
+            "serve_bench", "gateway_drill_mttr_ms",
+            repeats=drill["mttr_runs"], median=drill["mttr_ms"],
+            iqr=drill.get("mttr_ms_iqr") or 0.0, unit="ms",
+            direction="lower",
+            fingerprint_extra={**cfg, "blocks": drill["blocks"],
+                               "block_rows": drill["block_rows"]}))
+    return out

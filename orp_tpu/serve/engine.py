@@ -26,6 +26,7 @@ recording layer is new and session-gated).
 from __future__ import annotations
 
 import functools
+import time
 import warnings
 
 import jax
@@ -36,6 +37,7 @@ from orp_tpu.guard import inject as _inject
 from orp_tpu.guard.serve import CircuitBreaker
 from orp_tpu.lint.trace_audit import compile_count
 from orp_tpu.obs import count as obs_count
+from orp_tpu.obs import devprof as _devprof
 from orp_tpu.obs import enabled as obs_enabled
 from orp_tpu.obs import span as obs_span
 from orp_tpu.train.backward import _date_outputs_core, _split_holdings
@@ -92,15 +94,22 @@ class PendingEval:
     same dispatch, split at the block point.
     """
 
-    __slots__ = ("_phi", "_psi", "_v", "_n", "_has_prices", "bucket")
+    __slots__ = ("_phi", "_psi", "_v", "_n", "_has_prices", "bucket",
+                 "_prof", "_t_dispatch")
 
-    def __init__(self, phi, psi, v, n: int, has_prices: bool, bucket: int):
+    def __init__(self, phi, psi, v, n: int, has_prices: bool, bucket: int,
+                 prof=None, t_dispatch: float = 0.0):
         self._phi = phi
         self._psi = psi
         self._v = v
         self._n = int(n)
         self._has_prices = has_prices
         self.bucket = int(bucket)
+        # device-time attribution (obs/devprof, flag-gated): the dispatch
+        # instant + the live DevProf, stamped by evaluate_async; None when
+        # attribution is off (the zero-cost default)
+        self._prof = prof
+        self._t_dispatch = t_dispatch
 
     def result(self):
         """Block until the device finishes, then slice the padding off:
@@ -114,7 +123,14 @@ class PendingEval:
             # prey), a block-surfaced transient is fail, a loss discovered
             # at completion is device_loss
             inj.fire("serve/execute", bucket=self.bucket)
+        prof = self._prof
+        t_block = time.perf_counter() if prof is not None else 0.0
         phi, psi, v = jax.block_until_ready((self._phi, self._psi, self._v))
+        if prof is not None:
+            # serial-device attribution: this dispatch's wall splits into
+            # queue vs device seconds (serve/device_seconds{bucket}) and
+            # feeds the rolling utilization gauge
+            prof.complete(self._t_dispatch, t_block, bucket=self.bucket)
         with span("serve/unpad"):
             phi = np.asarray(phi)[:n]
             psi = np.asarray(psi)[:n]
@@ -271,6 +287,27 @@ class HedgeEngine:
             ),
         }
 
+    def program_cost(self, n_rows: int) -> dict:
+        """FLOPs / bytes-accessed of the executable serving ``n_rows``-row
+        requests (``cost_analysis`` on a fresh lower+compile of the bucket
+        program from avals — no request data touched). The roofline join
+        (``obs/perf.py``) divides these by measured device seconds. A
+        profiling/bench helper, not a hot path: with the persistent compile
+        cache on, the compile is a disk read after the first call."""
+        from orp_tpu.aot.compile import cost_summary
+
+        b = self.bucket_for(n_rows)
+        dt = self.model.dtype
+        sds = jax.ShapeDtypeStruct
+        lowered = _eval_core.lower(
+            self.model, self._p1, self._p2, sds((), jnp.int32),
+            sds((b, self.model.n_features), dt),
+            sds((b, self.n_instruments), dt), self._coc,
+            dual_mode=self.dual_mode,
+            holdings_combine=self.holdings_combine,
+        )
+        return {"bucket": b, **cost_summary(lowered.compile())}
+
     # -- evaluation ----------------------------------------------------------
 
     def bucket_for(self, n_rows: int, mesh="engine") -> int:
@@ -410,7 +447,13 @@ class HedgeEngine:
             self._buckets.add(b)
             obs_count("serve/bucket_misses", bucket=str(b))
         obs_count("serve/rows", n, sink_event=False)
-        return PendingEval(phi, psi, v, n, has_prices, b)
+        prof = _devprof.active()
+        if prof is None:
+            return PendingEval(phi, psi, v, n, has_prices, b)
+        # attribution on: stamp the dispatch instant — the completion chain
+        # in PendingEval.result attributes queue vs device seconds from it
+        return PendingEval(phi, psi, v, n, has_prices, b, prof,
+                           time.perf_counter())
 
     def _jit_eval(self, idx: int, feats, pr):
         """The always-correct jit path: one bucket-shaped ``_eval_core``

@@ -170,7 +170,8 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                   telemetry_dir=None, gateway=None, metrics=None,
-                  quality=None, gateway_timeout_s: float = 5.0) -> dict:
+                  quality=None, perf=None,
+                  gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
     row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
@@ -200,6 +201,15 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     ``orp-quality-v1`` record with a nonzero RQMC confidence interval —
     the preflight for serve-time drift monitoring and the
     ``reload_tenant(quality_band=...)`` canary gate.
+    ``perf``        — optionally probe the PERFORMANCE-observatory
+    plumbing (``orp doctor --perf [LEDGER]``): ``jax.profiler`` importable
+    with a writable trace-dir target (the ``orp profile --trace-dir``
+    preflight), the ``orp-perf-v1`` ledger parseable AND appendable (a
+    torn tail is tolerated, anything else is corruption), and the roofline
+    peak table covering THIS process's ``device_kind`` — an uncovered kind
+    still rooflines against the measured-matmul fallback, but the check
+    says so in flag-speak because a fabricated-feeling fraction-of-peak is
+    exactly what an operator should not discover mid-incident.
     ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
@@ -398,4 +408,84 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                    fix="no live scrape at that address — probe the ingest "
                        "port of a running `orp serve-gateway` (the METRICS "
                        "wire kind shares it), or fix host:port")
+    # 9) performance observatory: profiler + trace dir, ledger, peak table
+    if perf is not None:
+        import tempfile
+
+        from orp_tpu.obs import perf as perf_mod
+
+        import pathlib as _pathlib
+
+        try:
+            import jax.profiler as _profiler
+
+            ok = hasattr(_profiler, "trace")
+            w_ok, w_detail = _dir_writable(
+                _pathlib.Path(tempfile.gettempdir()) / "orp_profile_probe")
+            _check(checks, "perf_profiler", ok and w_ok,
+                   ("jax.profiler.trace available; trace target "
+                    f"{w_detail}") if ok else
+                   "this jax build exposes no jax.profiler.trace",
+                   fix=("run `orp profile` without --trace-dir (the span "
+                        "breakdown still works), or upgrade jaxlib for "
+                        "perfetto captures" if not ok else
+                        "point --trace-dir at a writable directory"))
+        except Exception as e:  # orp: noqa[ORP009] -- the report IS the emission: the probe failure becomes a failing check row
+            _check(checks, "perf_profiler", False,
+                   f"{type(e).__name__}: {e}",
+                   fix="no jax backend came up — fix JAX_PLATFORMS before "
+                       "profiling anything")
+        ledger_path = (perf if isinstance(perf, str)
+                       else perf_mod.PERF_LEDGER_FILE)
+        try:
+            records, problems = perf_mod.read_ledger(ledger_path)
+            invalid = sum(bool(perf_mod.validate_perf_record(r))
+                          for r in records)
+            lp = _pathlib.Path(ledger_path)
+            if lp.exists():
+                # appendable probe WITHOUT a side effect: open-for-append
+                # on the existing file (never creates an empty ledger)
+                with open(lp, "a"):
+                    pass
+                app = "appendable"
+            else:
+                ok_dir, dir_detail = _dir_writable(lp.parent
+                                                   if str(lp.parent) else ".")
+                if not ok_dir:
+                    raise OSError(f"parent not writable ({dir_detail})")
+                app = "absent (first run seeds it); parent writable"
+            ok = invalid == 0
+            _check(checks, "perf_ledger", ok,
+                   f"{ledger_path}: {len(records)} record(s), {app}"
+                   + (f", {len(problems)} torn-tail line(s) tolerated"
+                      if problems else "")
+                   + (f"; {invalid} INVALID record(s)" if invalid else ""),
+                   fix="the ledger holds records that fail the orp-perf-v1 "
+                       "schema — move it aside and reseed with `orp "
+                       "serve-bench --ledger PATH` / `orp profile`")
+        except (OSError, ValueError) as e:
+            _check(checks, "perf_ledger", False, f"{ledger_path}: {e}",
+                   fix="move the corrupt ledger aside; the next `orp "
+                       "profile` / `orp serve-bench --ledger PATH` run "
+                       "reseeds it")
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind  # orp: noqa[ORP011] -- topology introspection: the kind is fleet-wide
+            peak, source = perf_mod.peak_for(kind)
+            _check(checks, "perf_peaks", source == "table",
+                   (f"PEAK_TABLE covers {kind!r} "
+                    f"({peak['flops_per_s'] / 1e12:.1f} TFLOP/s f32 ceiling)"
+                    if source == "table" else
+                    f"{kind!r} not in PEAK_TABLE — roofline fractions fall "
+                    f"back to the measured-matmul peak "
+                    f"({peak['flops_per_s'] / 1e9:.1f} GFLOP/s)"),
+                   fix=f"add a PEAK_TABLE entry for {kind!r} in "
+                       "orp_tpu/obs/perf.py (published per-chip FLOP/s + "
+                       "HBM bytes/s) — until then frac_peak_* is against "
+                       "the measured-matmul fallback and bytes/s fractions "
+                       "are absent")
+        except Exception as e:  # orp: noqa[ORP009] -- the report IS the emission: the probe failure becomes a failing check row
+            _check(checks, "perf_peaks", False, f"{type(e).__name__}: {e}",
+                   fix="no jax backend came up — fix JAX_PLATFORMS first")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}

@@ -184,6 +184,12 @@ def top_snapshot(text: str, *, previous: dict | None = None,
                              round(v * 1e3, 4))(
             _quantile(series, "serve_queue_age_seconds", "0.99",
                       outcome="served")),
+        # device-time attribution (obs/devprof, flag-gated): the rolling
+        # busy-fraction gauge — None when the serving process runs without
+        # the profiling mode, a 0..1 fraction when it does
+        "device_util": next(
+            (value for _, value in
+             series.get("serve_device_utilization", ())), None),
         "tenants": tenants,
     }
     if health is not None:
@@ -219,7 +225,9 @@ def render_top(snap: dict, *, target: str = "") -> str:
         f"errors {snap['errors']:,.0f}  "
         f"queue-age p99 "
         + ("-" if snap["queue_age_p99_ms"] is None
-           else f"{snap['queue_age_p99_ms']:.3f} ms"))
+           else f"{snap['queue_age_p99_ms']:.3f} ms")
+        + ("" if snap.get("device_util") is None
+           else f"  dev-util {snap['device_util'] * 100:.0f}%"))
     lines = head
     tenants = snap.get("tenants") or {}
     if tenants:

@@ -26,7 +26,7 @@ def codes(src, **kw):
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
-                             "ORP014", "ORP015", "ORP016"})
+                             "ORP014", "ORP015", "ORP016", "ORP017"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1153,6 +1153,152 @@ def test_orp016_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/gateway.py") == []
+
+
+# -- ORP017: stop-clock before the block on jitted work -----------------------
+
+ORP017_POS = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        dt = time.perf_counter() - t0      # stop-clock BEFORE the block
+        jax.block_until_ready(y)           # too late: dt timed dispatch
+        return dt, y
+
+    def bench_monotonic(x):
+        t0 = time.monotonic()
+        y = jnp.dot(x, x)
+        dt = time.monotonic() - t0
+        jax.block_until_ready(y)
+        return dt
+
+    def bench_named_stop(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        t1 = time.perf_counter()           # named stop clock...
+        dt = t1 - t0                       # ...consumed here
+        jax.block_until_ready(y)           # too late: dt timed dispatch
+        return dt, y
+"""
+
+ORP017_NEG = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jax.block_until_ready(jnp.dot(x, x))  # block INSIDE the pair
+        return time.perf_counter() - t0, y
+
+    def bench_host(xs):
+        t0 = time.perf_counter()
+        total = sum(xs)                    # no dispatch between the clocks
+        dt = time.perf_counter() - t0
+        jax.block_until_ready(total)
+        return dt
+
+    def setup_then_time(x):
+        y = jnp.dot(x, x)                  # dispatch BEFORE the timer pair
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        n = int(x.shape[0])
+        return time.perf_counter() - t0, n
+
+    def two_named_regions(x):
+        t0 = time.perf_counter()
+        y = jax.block_until_ready(jnp.dot(x, x))
+        d1 = time.perf_counter() - t0
+        t2 = time.perf_counter()           # region-2 START clock: its name
+        z = jax.block_until_ready(jnp.dot(y, y))
+        d2 = time.perf_counter() - t2      # sits on the Sub's RIGHT side
+        return d1, d2, z
+"""
+
+
+def test_orp017_flags_stop_clock_before_block():
+    got = codes(ORP017_POS)
+    # all three timer pairs (inline ×2, named stop clock) stop before
+    # their block; ORP007 stays quiet (the scopes DO sync — that rule
+    # owns the no-sync-at-all case)
+    assert got == ["ORP017", "ORP017", "ORP017"]
+
+
+def test_orp017_clean_negative():
+    assert codes(ORP017_NEG) == []
+
+
+def test_orp017_does_not_double_report_orp007_positives():
+    # a scope with NO sync at all is ORP007's finding alone
+    assert codes(ORP007_POS) == ["ORP007"]
+
+
+def test_orp017_allowlists_obs_aot_and_bench():
+    src = textwrap.dedent(ORP017_POS)
+    for path in ("orp_tpu/obs/devprof.py", "orp_tpu/aot/compile.py",
+                 "bench.py", "orp_tpu/serve/bench.py",
+                 "tools/dual_wall_bench.py"):
+        assert lint_source(src, path=path) == [], path
+
+
+def test_orp017_two_timed_regions_back_to_back_stay_clean():
+    # an untimed dispatch BETWEEN two correctly-blocked regions must not
+    # read as a mis-ordered pair: the (stop1, start2) adjacency ends on a
+    # START clock (not a subtraction operand), so it is not a timed region
+    src = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def two_regions(x):
+            t0 = time.perf_counter()
+            y = jax.block_until_ready(jnp.dot(x, x))
+            dt1 = time.perf_counter() - t0
+            buf = jnp.asarray(y)               # untimed prep between regions
+            t2 = time.perf_counter()
+            z = jax.block_until_ready(jnp.dot(y, y))
+            dt2 = time.perf_counter() - t2
+            return dt1, dt2, buf, z
+    """
+    assert codes(src) == []
+
+
+def test_orp017_sees_local_sync_helpers():
+    # a call to a nested def that blocks counts as the sync, at its line
+    src = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def bench(x):
+            def run():
+                return jax.block_until_ready(jnp.dot(x, x))
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            run()                              # blocks before the stop
+            return time.perf_counter() - t0, y
+    """
+    assert codes(src) == []
+
+
+def test_orp017_noqa_suppresses():
+    src = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            dt = time.perf_counter() - t0  # orp: noqa[ORP017] -- measures the dispatch path on purpose
+            jax.block_until_ready(y)
+            return dt
+    """
+    assert codes(src) == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -1,259 +1,38 @@
-"""Stage-level wall-clock breakdown of the north-star hedge (1M-path, 52-date
-European call): where do the seconds go?
+"""DEPRECATED shim — the profile moved into the package CLI: ``orp profile``.
 
-Profiles BOTH walk variants:
-  - the unfused host-loop baseline (per-date dispatch/sync — the r2 code path
-    whose 172.8s BENCH_r02 record this explains), staged with explicit
-    block_until_ready barriers: sim / prep / first fit cold+run / warm fits
-    (fit vs outputs vs host syncs);
-  - the fused single-XLA-program walk with "blocks" shuffle — the path
-    benchmarks/north_star.py actually runs now — cold (compile+run) and warm.
+The stage-level north-star breakdown this tool owned (and its cold/warm-pair
+compile-split inference) is subsumed by ``orp_tpu.obs.devprof``: every stage
+now runs ONCE under a per-stage ``CompileTimeMonitor`` (compile vs execute
+wall from jax's monitoring events) with device-time attribution (host vs
+device split per span), the FLOP ledger and the roofline join — see
+``python -m orp_tpu.cli profile --help``. This file forwards with a warning
+so existing invocations keep producing a record.
 
-Usage: python tools/profile_north_star.py [n_paths_log2=20] [telemetry_dir]
-
-With ``telemetry_dir`` (or ``ORP_PROFILE_TELEMETRY_DIR``) set, the profile
-runs under an ``orp_tpu.obs`` session: every stage wall lands in the shared
-registry (``profile_stage_seconds{stage=...}`` gauges -> ``metrics.prom``),
-the stamps record is emitted to ``events.jsonl`` through the schema-versioned
-sink, and ``manifest.json`` binds the numbers to jax/platform/git — the
-per-run bundle instead of a hand-rolled one-off JSON shape.
+Usage (unchanged): python tools/profile_north_star.py [n_paths_log2=20] [telemetry_dir]
 """
 
 import json
 import os
 import pathlib
 import sys
-import time
+import warnings
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-import jax
-import jax.numpy as jnp
 
-from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig
-from orp_tpu.api.pipelines import _backward_cfg
-from orp_tpu.models.mlp import HedgeMLP
-from orp_tpu.sde import TimeGrid, bond_curve, payoffs
-from orp_tpu.train.backward import _date_outputs
-from orp_tpu.train.fit import FitConfig, fit
-from orp_tpu.train import losses as L
+def main(n_log2: int = 20) -> dict:
+    from orp_tpu.obs import devprof
 
-
-def main(n_log2=20):
-    from orp_tpu.aot import CompileTimeMonitor, enable_persistent_cache
-
-    enable_persistent_cache()  # one entry point (ORP008), env-overridable
-    # every XLA compile second in this run is metered, so the record carries
-    # a first-class compile-vs-execute wall split instead of the split being
-    # inferable only from a cold/warm run pair
-    with CompileTimeMonitor() as _compile_mon:
-        _main_profiled(n_log2, _compile_mon)
-
-
-def _main_profiled(n_log2, compile_mon):
-    n_paths = 1 << n_log2
-    euro = EuropeanConfig(constrain_self_financing=False)
-    sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 364, rebalance_every=7)
-    # optimizer pinned to Adam: the host-loop/stage breakdown below explains
-    # the ADAM walk (the r2 record); the GN walk (the current north_star
-    # default) is timed separately at the end as gn_walk_cold/warm
-    train = TrainConfig(
-        dual_mode="mse_only", epochs_first=120, epochs_warm=30,
-        batch_size=max(n_paths // 64, 512), lr=1e-3, optimizer="adam",
+    warnings.warn(
+        "tools/profile_north_star.py is a forwarding shim — use "
+        "`python -m orp_tpu.cli profile` (adds --trace-dir perfetto "
+        "captures, --workload serve, and the perf-ledger append)",
+        DeprecationWarning,
+        stacklevel=2,
     )
-    stamps = {}
-    t_all = time.perf_counter()
-
-    t0 = time.perf_counter()
-    grid = TimeGrid(sim.T, sim.n_steps)
-    # scan engine, matching the pipeline default: the Pallas kernel at THIS
-    # storage shape (53 knots) reproducibly faults the tunneled v5e and a
-    # device fault poisons the whole process, killing the rest of the profile
-    # (SCALING.md §5) — a try/except cannot save it
-    from orp_tpu.sde import simulate_gbm_log
-
-    s = simulate_gbm_log(
-        jnp.arange(sim.n_paths, dtype=jnp.uint32), grid, euro.s0, euro.r,
-        euro.sigma, sim.seed_fund, store_every=sim.rebalance_every,
-    )
-    s.block_until_ready()
-    stamps["sim_engine"] = "scan"
-    stamps["sim"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    coarse = grid.reduced(sim.rebalance_every)
-    b = bond_curve(coarse, euro.r, jnp.float32)
-    payoff = payoffs.european(s[:, -1], euro.strike, euro.option_type)
-    s0v = euro.s0
-    sn = s / s0v
-    features = sn[:, :, None]
-    bn = jnp.asarray(b / s0v, jnp.float32)
-    prices_all = jnp.stack(
-        [sn, jnp.broadcast_to(bn[None, :], sn.shape)], axis=-1)
-    terminal = payoff / s0v
-    e_payoff_n = float(jnp.mean(payoff)) / s0v
-    prices_all.block_until_ready()
-    stamps["prep"] = time.perf_counter() - t0
-
-    cfg = _backward_cfg(train)
-    model = HedgeMLP(n_features=1, constrain_self_financing=False)
-    key = jax.random.key(cfg.seed)
-    k1, k2, kfit = jax.random.split(key, 3)
-    params1 = model.init(k1, bias_init=(e_payoff_n, 0.0))
-    mse = L.make_loss("mse")
-    metric_fns = (L.mae, L.mape)
-
-    n_knots = sn.shape[1]
-    n_dates = n_knots - 1
-
-    # --- first date fit: compile+run, then isolate the run with fresh params
-    fit_cfg_first = FitConfig(
-        n_epochs=cfg.epochs_first, batch_size=cfg.batch_size,
-        patience=cfg.patience_first, lr=cfg.lr,
-    )
-    t = n_dates - 1
-    kfit, ka, kb = jax.random.split(kfit, 3)
-    t0 = time.perf_counter()
-    p1_first, aux1 = fit(
-        params1, features[:, t], prices_all[:, t + 1], terminal, ka,
-        value_fn=model.value, loss_fn=mse, cfg=fit_cfg_first,
-        metric_fns=metric_fns,
-    )
-    jax.block_until_ready(p1_first)
-    stamps["fit_first_cold"] = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    p1_warmrun, _ = fit(  # orp: noqa[ORP004] -- same key on purpose: times the IDENTICAL program warm vs cold
-        params1, features[:, t], prices_all[:, t + 1], terminal, ka,
-        value_fn=model.value, loss_fn=mse, cfg=fit_cfg_first,
-        metric_fns=metric_fns,
-    )
-    jax.block_until_ready(p1_warmrun)
-    stamps["fit_first_run"] = time.perf_counter() - t0
-    params1 = p1_first
-
-    # first date outputs
-    t0 = time.perf_counter()
-    values_next = terminal
-    v_t, comb, var_resid = _date_outputs(
-        model, params1, params1, features[:, t], prices_all[:, t],
-        prices_all[:, t + 1], values_next, cfg.cost_of_capital,
-        jnp.zeros(()), dual_mode="mse_only", holdings_combine="single",
-    )
-    jax.block_until_ready((v_t, comb, var_resid))
-    stamps["outputs_first_cold"] = time.perf_counter() - t0
-    values_next = v_t
-
-    # --- warm dates
-    fit_cfg_warm = FitConfig(
-        n_epochs=cfg.epochs_warm, batch_size=cfg.batch_size,
-        patience=cfg.patience_warm, lr=cfg.lr,
-    )
-    fit_s = out_s = sync_s = 0.0
-    warm_cold = None
-    t_warm = time.perf_counter()
-    for step_i, t in enumerate(range(n_dates - 2, -1, -1)):
-        kfit, ka, kb = jax.random.split(kfit, 3)
-        t0 = time.perf_counter()
-        params1, aux1 = fit(
-            params1, features[:, t], prices_all[:, t + 1], values_next, ka,
-            value_fn=model.value, loss_fn=mse, cfg=fit_cfg_warm,
-            metric_fns=metric_fns,
-        )
-        jax.block_until_ready(params1)
-        dt_fit = time.perf_counter() - t0
-        if step_i == 0:
-            warm_cold = dt_fit
-        fit_s += dt_fit
-        t0 = time.perf_counter()
-        v_t, comb, var_resid = _date_outputs(
-            model, params1, params1, features[:, t], prices_all[:, t],
-            prices_all[:, t + 1], values_next, cfg.cost_of_capital,
-            jnp.zeros(()), dual_mode="mse_only", holdings_combine="single",
-        )
-        jax.block_until_ready((v_t, comb, var_resid))
-        out_s += time.perf_counter() - t0
-        values_next = v_t
-        t0 = time.perf_counter()
-        _ = (float(aux1["final_loss"]), float(aux1["mae"]), float(aux1["mape"]),
-             int(aux1["n_epochs_ran"]))
-        sync_s += time.perf_counter() - t0
-    stamps["fits_warm_total"] = time.perf_counter() - t_warm
-    stamps["warm_first_cold"] = warm_cold
-    stamps["warm_fit_sum"] = fit_s
-    stamps["warm_outputs_sum"] = out_s
-    stamps["warm_sync_sum"] = sync_s
-    stamps["warm_fit_each_warmed"] = (fit_s - warm_cold) / max(n_dates - 2, 1)
-
-    stamps["host_walk_total"] = time.perf_counter() - t_all
-
-    # --- the fused walk (what benchmarks/north_star.py runs): cold vs warm
-    from orp_tpu.train.backward import backward_induction
-    import dataclasses
-
-    fused_cfg = dataclasses.replace(
-        _backward_cfg(train), fused=True, shuffle="blocks"
-    )
-    model_f = HedgeMLP(n_features=1, constrain_self_financing=False)
-    args = (model_f, features, sn, bn, terminal)
-    t0 = time.perf_counter()
-    res = backward_induction(*args, fused_cfg, bias_init=(e_payoff_n, 0.0))
-    jax.block_until_ready(res.values)
-    stamps["fused_walk_cold"] = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    res = backward_induction(*args, fused_cfg, bias_init=(e_payoff_n, 0.0))
-    jax.block_until_ready(res.values)
-    stamps["fused_walk_warm"] = time.perf_counter() - t0
-
-    # the GN walk — what benchmarks/north_star.py runs by default now
-    gn_cfg = dataclasses.replace(
-        fused_cfg, optimizer="gauss_newton", gn_iters_first=60, gn_iters_warm=30
-    )
-    t0 = time.perf_counter()
-    res = backward_induction(*args, gn_cfg, bias_init=(e_payoff_n, 0.0))
-    jax.block_until_ready(res.values)
-    stamps["gn_walk_cold"] = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    res = backward_induction(*args, gn_cfg, bias_init=(e_payoff_n, 0.0))
-    jax.block_until_ready(res.values)
-    stamps["gn_walk_warm"] = time.perf_counter() - t0
-
-    # achieved-FLOP/s + MFU per phase (VERDICT r4 item 5): analytic useful-
-    # arithmetic counts (orp_tpu/utils/flops.py, XLA-census-validated) over
-    # the measured walls — shapes taken from the very objects timed above
-    # (n_dates from the trajectory, steps from sim, iters from gn_cfg), so
-    # a profile-config change can never desync the FLOP ledger
-    from orp_tpu.utils import flops as F
-
-    stamps["flops_sim"] = F.phase_report(
-        F.sim_flops(n_paths, sim.n_steps), stamps["sim"])
-    stamps["flops_gn_walk"] = F.phase_report(
-        F.gn_walk_flops(n_paths, n_dates, gn_cfg.gn_iters_first,
-                        gn_cfg.gn_iters_warm), stamps["gn_walk_warm"])
-    stamps["flops_adam_walk"] = F.phase_report(
-        F.adam_walk_flops(n_paths, n_dates, train.epochs_first,
-                          train.epochs_warm), stamps["fused_walk_warm"])
-
-    # first-class compile/execute split (ISSUE 5 satellite): total XLA
-    # compile seconds across the whole profile vs everything else
-    total_wall = time.perf_counter() - t_all
-    stamps.update(compile_mon.split(total_wall))
-
-    stamps = {
-        k: round(v, 3) if isinstance(v, float) else v for k, v in stamps.items()
-    }
-    stamps["n_paths"] = n_paths
-    stamps["platform"] = jax.default_backend()
-
-    # telemetry: per-stage gauges into the registry + the full record as one
-    # sink event (obs/sink.py stamps schema/seq/ts), so an enabled run drops
-    # the standard bundle instead of this tool owning a private format
-    from orp_tpu import obs
-
-    for k, v in stamps.items():
-        if isinstance(v, float):  # the stage walls; not counts/strings/dicts
-            obs.set_gauge("profile_stage_seconds", v, stage=k)
-    obs.emit_record("profile_north_star", stamps)
-    print(json.dumps(stamps))
+    out = devprof.profile_run(workload="north-star", n_log2=n_log2)
+    print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":
