@@ -800,6 +800,10 @@ def cmd_serve_bench(args):
     ingest_rows = args.ingest_rows
     ingest_blocks = tuple(int(x) for x in args.ingest_blocks.split(","))
     drill_blocks, drill_rows = args.drill_blocks, args.drill_rows
+    fleet_replicas = tuple(int(x) for x in args.fleet_replicas.split(","))
+    fleet_gateways, fleet_tenants = args.fleet_gateways, args.fleet_tenants
+    fleet_blocks, fleet_rows = args.fleet_blocks, args.fleet_rows
+    repeats = args.repeats
     if args.quick:
         # the CI smoke shape: tiny block counts, same lanes, same pins —
         # the speedup claim stays regression-gated without bench-scale spend
@@ -808,6 +812,15 @@ def cmd_serve_bench(args):
                               if b <= ingest_rows) or (1, 64)
         drill_blocks = min(drill_blocks, 16)
         drill_rows = min(drill_rows, 32)
+        fleet_replicas = tuple(n for n in fleet_replicas if n <= 2) or (1, 2)
+        fleet_gateways = min(fleet_gateways, 2)
+        fleet_tenants = min(fleet_tenants, 3)
+        fleet_blocks = min(fleet_blocks, 3)
+        fleet_rows = min(fleet_rows, 16)
+        if args.fleet:
+            repeats = 1
+    if any(n < 1 for n in fleet_replicas):
+        raise SystemExit("error: --fleet-replicas counts must be >= 1")
     drill_kill_at = (args.drill_kill_at if args.drill_kill_at is not None
                      else max(1, drill_blocks // 3))
     if args.gateway_drill and not 0 < drill_kill_at <= drill_blocks:
@@ -837,7 +850,13 @@ def cmd_serve_bench(args):
         drill_blocks=drill_blocks,
         drill_block_rows=drill_rows,
         drill_kill_at=drill_kill_at,
-        repeats=args.repeats,
+        fleet=args.fleet,
+        fleet_replicas=fleet_replicas,
+        fleet_gateways=fleet_gateways,
+        fleet_tenants=fleet_tenants,
+        fleet_blocks=fleet_blocks,
+        fleet_block_rows=fleet_rows,
+        repeats=repeats,
         previous=previous,
     )
     if args.ingest:
@@ -928,6 +947,17 @@ def cmd_serve_gateway(args):
     from orp_tpu.guard.serve import GuardPolicy
     from orp_tpu.serve import MetricsServer, ServeGateway, ServeHost
 
+    if args.bundle is None and args.fleet is None:
+        raise SystemExit("error: pass --bundle DIR (a serving gateway) or "
+                         "--fleet topology.json (a routing gateway)")
+    if args.fleet is not None and (args.deadline_ms is not None
+                                   or args.watermark is not None
+                                   or args.max_pending is not None):
+        raise SystemExit(
+            "error: --deadline-ms/--watermark/--max-pending configure a "
+            "SERVING gateway's guard policy; a --fleet router forwards "
+            "blocks and enforces none of them — set these flags on the "
+            "replica gateways instead")
     policy = None
     if args.deadline_ms is not None or args.watermark is not None:
         policy = GuardPolicy(deadline_ms=args.deadline_ms,
@@ -948,10 +978,20 @@ def cmd_serve_gateway(args):
             from orp_tpu.obs import devprof
 
             stack.enter_context(devprof.profiling())
-        host = stack.enter_context(
-            ServeHost(max_live_engines=args.max_live_engines))
-        host.add_tenant(args.tenant, args.bundle, policy=policy,
-                        max_pending=args.max_pending)
+        if args.fleet is not None:
+            from orp_tpu.serve.fleet import FleetError, FleetHost, \
+                load_topology
+
+            try:
+                topo = load_topology(args.fleet)
+            except FleetError as e:
+                raise SystemExit(f"error: {e}") from None
+            host = stack.enter_context(FleetHost(topo["replicas"]))
+        else:
+            host = stack.enter_context(
+                ServeHost(max_live_engines=args.max_live_engines))
+            host.add_tenant(args.tenant, args.bundle, policy=policy,
+                            max_pending=args.max_pending)
         stop = threading.Event()
         gw = stack.enter_context(ServeGateway(
             host, addr=args.addr, port=args.port,
@@ -976,15 +1016,21 @@ def cmd_serve_gateway(args):
         addr, port = gw.address
         line = {"addr": addr, "port": port, "tenant": args.tenant,
                 "bundle": args.bundle}
+        if args.fleet is not None:
+            line["fleet"] = args.fleet
+            line["replicas"] = {r.name: f"{r.addr}:{r.port}"
+                                for r in topo["replicas"]}
         if mserver is not None:
             line["metrics_port"] = mserver.address[1]
         scrape_note = ("" if mserver is None else
                        f"; metrics http://{mserver.address[0]}:"
                        f"{mserver.address[1]}/metrics")
+        what = (f"routing {len(topo['replicas'])} replica(s) from "
+                f"{args.fleet}" if args.fleet is not None else
+                f"serving {args.bundle} as tenant {args.tenant!r}")
         print(json.dumps(line) if args.json
-              else f"serving {args.bundle} as tenant {args.tenant!r} "
-                   f"on {addr}:{port} (orp-ingest v1/v2; SIGTERM or "
-                   f"ctrl-C to drain{scrape_note})",
+              else f"{what} on {addr}:{port} (orp-ingest v1/v2; SIGTERM "
+                   f"or ctrl-C to drain{scrape_note})",
               flush=True)
         if args.ready_file:
             pathlib.Path(args.ready_file).write_text(f"{addr} {port}\n")
@@ -1054,6 +1100,7 @@ def cmd_doctor(args):
                         telemetry_dir=args.telemetry_dir,
                         gateway=args.gateway, metrics=args.metrics,
                         quality=args.quality, perf=args.perf,
+                        fleet=args.fleet,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -1079,6 +1126,11 @@ def cmd_top(args):
     from orp_tpu.serve.gateway import GatewayClient
     from orp_tpu.serve.scrape import render_top, top_snapshot
 
+    if args.fleet is not None:
+        return _top_fleet(args)
+    if args.gateway is None:
+        raise SystemExit("error: pass --gateway HOST:PORT (one gateway) "
+                         "or --fleet topology.json (the whole fleet)")
     addr, _, port = str(args.gateway).rpartition(":")
     addr = addr or "127.0.0.1"
     target = f"{addr}:{port}"
@@ -1109,6 +1161,64 @@ def cmd_top(args):
                 print(json.dumps(snap))
             else:
                 print(render_top(snap, target=target), flush=True)
+            if not args.watch:
+                return
+    except KeyboardInterrupt:
+        return  # --watch exits clean on ctrl-C, like top(1)
+
+
+def _top_fleet(args):
+    """``orp top --fleet topology.json``: scrape EVERY gateway in the
+    topology twice, ``--interval`` apart, and aggregate (reusing
+    ``top_snapshot`` per gateway): fleet-wide rates, the per-gateway
+    table, and the routing-version agreement line."""
+    import time as _time
+
+    from orp_tpu.serve.fleet import (FleetError, fleet_snapshot,
+                                     load_topology, render_fleet_top)
+    from orp_tpu.serve.gateway import GatewayClient
+    from orp_tpu.serve.scrape import top_snapshot
+
+    try:
+        topo = load_topology(args.fleet)
+    except FleetError as e:
+        raise SystemExit(f"error: {e}") from None
+    if not topo["gateways"]:
+        raise SystemExit(f"error: {args.fleet} lists no gateways — add "
+                         'a "gateways": ["host:port", …] section')
+
+    def scrape_all(previous=None, interval=None):
+        per = {}
+        for addr, port in topo["gateways"]:
+            target = f"{addr}:{port}"
+            try:
+                with GatewayClient(addr, port,
+                                   timeout_s=args.timeout_s) as client:
+                    text = client.metrics()
+                    health = client.health()
+            except (OSError, ValueError, RuntimeError) as e:
+                raise SystemExit(
+                    f"error: could not scrape fleet gateway {target}: {e} "
+                    f"— probe the fleet with `orp doctor --fleet "
+                    f"{args.fleet}`") from None
+            prev_snap = (previous or {}).get(target, {}).get("snap")
+            per[target] = {
+                "snap": top_snapshot(text, previous=prev_snap,
+                                     interval_s=interval, health=health),
+                "routing": health.get("routing"),
+            }
+        return per
+
+    try:
+        per = scrape_all()
+        while True:
+            _time.sleep(args.interval)
+            per = scrape_all(previous=per, interval=args.interval)
+            snap = fleet_snapshot(per)
+            if args.json:
+                print(json.dumps(snap))
+            else:
+                print(render_fleet_top(snap), flush=True)
             if not args.watch:
                 return
     except KeyboardInterrupt:
@@ -1781,10 +1891,33 @@ def build_parser():
     psb.add_argument("--drill-kill-at", type=int, default=None, metavar="K",
                      help="admitted-frame count at which the gateway dies "
                           "(default: a third of --drill-blocks)")
+    psb.add_argument("--fleet", action="store_true",
+                     help="append the horizontal-fleet phase: N in-process "
+                          "fleet gateways (FleetHost routing tables) fan "
+                          "frames out to M serve replicas at each "
+                          "--fleet-replicas count — aggregate rows/s + p99 "
+                          "per count, a routing-agreement pin across "
+                          "gateways, the cross-connection coalescing "
+                          "bitwise pin, and (at the largest count) the "
+                          "kill-one-replica drill with fleet-level MTTR, "
+                          "rows_lost 0 and duplicate_serves 0; the phase "
+                          "FAILS when any contract is violated")
+    psb.add_argument("--fleet-replicas", default="1,2,4",
+                     help="comma-separated replica counts the fleet phase "
+                          "measures")
+    psb.add_argument("--fleet-gateways", type=int, default=2,
+                     help="fleet gateway processes fanning traffic out")
+    psb.add_argument("--fleet-tenants", type=int, default=6,
+                     help="tenant names spread over the replicas")
+    psb.add_argument("--fleet-blocks", type=int, default=10,
+                     help="blocks each tenant streams per measurement")
+    psb.add_argument("--fleet-rows", type=int, default=64,
+                     help="rows per fleet block")
     psb.add_argument("--quick", action="store_true",
-                     help="CI smoke shape: shrink the ingest sweep and the "
-                          "gateway drill to tiny row/block counts (same "
-                          "lanes, same bitwise and speedup gates)")
+                     help="CI smoke shape: shrink the ingest sweep, the "
+                          "gateway drill and the fleet phase to tiny "
+                          "row/block counts (same lanes, same bitwise and "
+                          "speedup gates)")
     psb.add_argument("--repeats", type=int, default=3,
                      help="measurement repeats for the headline phases "
                           "(sweep, ingest, drill): every committed "
@@ -1815,8 +1948,20 @@ def build_parser():
              "non-Python-per-row ingest plane (probe with "
              "`orp doctor --gateway host:port`)",
     )
-    pgw.add_argument("--bundle", required=True,
-                     help="policy bundle directory to serve")
+    pgw.add_argument("--bundle", default=None,
+                     help="policy bundle directory to serve (omit with "
+                          "--fleet: a router gateway serves no policy "
+                          "itself)")
+    pgw.add_argument("--fleet", default=None, metavar="TOPOLOGY",
+                     help="run as a FLEET gateway instead of a serving "
+                          "one: route every frame to its tenant's replica "
+                          "per the rendezvous table over the topology.json "
+                          "replica set (health-driven — replicas are "
+                          "probed via the HEALTH wire kind and unhealthy "
+                          "ones' tenants remap automatically); the "
+                          "forwarding lane is the reconnect-replay client, "
+                          "so replica blips and deaths keep "
+                          "exactly-once-serve")
     pgw.add_argument("--tenant", default="default",
                      help="tenant name frames route to when their tenant "
                           "field is empty (16 ASCII bytes max on the wire)")
@@ -1880,8 +2025,14 @@ def build_parser():
              "METRICS/HEALTH wire kinds and print req/s, p99, queue "
              "depth, shed/BUSY rates and the per-tenant table",
     )
-    pt.add_argument("--gateway", required=True, metavar="HOST:PORT",
+    pt.add_argument("--gateway", default=None, metavar="HOST:PORT",
                     help="the running `orp serve-gateway` ingest address")
+    pt.add_argument("--fleet", default=None, metavar="TOPOLOGY",
+                    help="aggregate ALL of topology.json's gateways into "
+                         "one fleet table instead of scraping one: fleet "
+                         "req/s (two-scrape rates summed), per-gateway "
+                         "p99/queue/shed columns, and the routing-table "
+                         "version agreement line")
     pt.add_argument("--interval", type=float, default=1.0,
                     help="seconds between the two scrapes that turn "
                          "lifetime counters into rates (and the refresh "
@@ -1956,6 +2107,13 @@ def build_parser():
                            "device_kind (flag-speak fix line when "
                            "fraction-of-peak falls back to the measured-"
                            "matmul peak)")
+    pdoc.add_argument("--fleet", default=None, metavar="TOPOLOGY",
+                      help="probe a whole serve fleet from topology.json: "
+                           "PING every replica and gateway, read each "
+                           "gateway's routing view and verify "
+                           "ROUTING-TABLE AGREEMENT (same tenant sample → "
+                           "same replica from every gateway, same table "
+                           "version) plus per-replica health ages")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "

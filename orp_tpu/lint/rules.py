@@ -109,6 +109,22 @@ ORP017  stop-clock read before the block on jit-dispatched work: ORP007
         instants by design), ``aot/`` (the compile meters time lowering,
         not dispatch) and ``*bench.py`` (the bench lanes measure the
         dispatch path deliberately and block in bulk).
+ORP018  per-process-salted hashing in routing/sharding/placement code:
+        the fleet's founding invariant is that EVERY gateway process
+        computes the IDENTICAL tenant→replica mapping with no
+        coordination — and builtin ``hash()`` is salted per process
+        (PYTHONHASHSEED), so one ``hash(tenant) % n`` in a ``*rout*``/
+        ``*shard*``/``*placement*`` function under ``serve/`` silently
+        splits the fleet's routing view: each gateway forwards the same
+        tenant somewhere else, dedup windows never line up, and the bug
+        only shows as cross-process disagreement (invisible to any
+        single-process test). Unseeded ``random.*`` (and an unseeded
+        ``np.random.default_rng()`` / legacy ``np.random.*`` global) in
+        the same functions is the same failure with more steps — a
+        placement decision that differs per process. Route on a keyed
+        digest (``hashlib.blake2b`` — ``serve/fleet.py::route_weight``)
+        or a seeded generator; a function that genuinely wants
+        process-local randomness says so with a noqa.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -1323,6 +1339,73 @@ def check_unrecorded_gate(ctx: FileContext) -> Iterator[Finding]:
                     "can see in telemetry is a silent rollback; emit the "
                     "value (obs_count/obs_observe/obs_set_gauge/"
                     "flight.record) before the verdict",
+                )
+
+
+# -- ORP018 ------------------------------------------------------------------
+
+# the functions that ARE placement decisions: routing, sharding, placement —
+# where per-process salt silently splits the fleet's view
+_ORP018_FN_RE = re.compile(r"rout|shard|placement", re.IGNORECASE)
+# seeded constructors: an explicit seed argument makes the stream identical
+# in every process, which is exactly the property routing needs
+_ORP018_SEEDED_CTORS = {"random.Random", "np.random.default_rng",
+                        "numpy.random.default_rng",
+                        "np.random.Generator", "numpy.random.Generator",
+                        "jax.random.PRNGKey", "jax.random.key"}
+
+
+def _orp018_is_seeded(node: ast.Call) -> bool:
+    return bool(node.args) or any(kw.arg == "seed" for kw in node.keywords)
+
+
+@rule("ORP018", "per-process-salted hash/random in routing-decision code")
+def check_salted_routing_hash(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _ORP018_FN_RE.search(fdef.name):
+            continue
+        for node in walk_scope(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "hash"):
+                yield ctx.finding(
+                    node, "ORP018",
+                    f"builtin hash() in routing-decision {fdef.name!r} — "
+                    "str/bytes hashes are salted per process "
+                    "(PYTHONHASHSEED), so every gateway computes a "
+                    "DIFFERENT mapping and the fleet's routing view "
+                    "silently splits; use a keyed digest "
+                    "(hashlib.blake2b — serve/fleet.py::route_weight)",
+                )
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            if d in _ORP018_SEEDED_CTORS:
+                if not _orp018_is_seeded(node):
+                    yield ctx.finding(
+                        node, "ORP018",
+                        f"{d}() without a seed in routing-decision "
+                        f"{fdef.name!r} — an unseeded generator makes a "
+                        "placement decision that differs per process; "
+                        "pass an explicit seed (or route on a keyed "
+                        "digest)",
+                    )
+            elif (d.startswith(("random.", "np.random.", "numpy.random."))
+                  and d.rsplit(".", 1)[-1] != "default_rng"):
+                yield ctx.finding(
+                    node, "ORP018",
+                    f"{d}() in routing-decision {fdef.name!r} — the "
+                    "module-global random stream is process-local state; "
+                    "two gateways disagree on every draw. Route on a "
+                    "keyed digest or a generator seeded from the "
+                    "routing key",
                 )
 
 

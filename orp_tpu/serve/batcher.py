@@ -209,10 +209,18 @@ class _Group:
     prices: object = None
     pending: object = None        # engine handle with .result()
     error: Exception | None = None
-    # columnar lane: a Block rides its OWN group (one block = one dispatch;
-    # its rows are already a device-shaped batch) and resolves through its
-    # single future with the per-row status column instead of request slices
+    # columnar lane: a LONE Block rides its OWN group (its rows are already
+    # one contiguous device-shaped batch — zero concatenates clean-path) and
+    # resolves through its single future with the per-row status column
     block: Block | None = None
+    # cross-connection coalescing: SEVERAL blocks sharing an executable key
+    # (same date, width, prices-presence) merge into ONE device dispatch —
+    # many small connections of one tenant fill one launch (each tenant
+    # owns its batcher, so the merge is per-tenant by construction) —
+    # with per-origin live-row counts so each connection's reply columns
+    # slice back out bitwise what its own dispatch would have served
+    blocks: list | None = None
+    block_lives: list | None = None
 
 
 def _shed_order(req: _Request) -> tuple:
@@ -246,7 +254,8 @@ class MicroBatcher:
                  metrics: ServingMetrics | None = None,
                  policy: GuardPolicy | None = None,
                  max_inflight: int = 2,
-                 min_fill: int | None = None):
+                 min_fill: int | None = None,
+                 coalesce_blocks: bool = True):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         if max_inflight < 1:
@@ -264,6 +273,16 @@ class MicroBatcher:
         # max_wait_us window is the only batching wait.
         self.min_fill = (max(1, self.max_batch // 8) if min_fill is None
                          else int(min_fill))
+        # cross-connection block coalescing: admitted blocks sharing one
+        # executable key (date, width, prices-presence) concatenate into ONE
+        # device dispatch — many small connections of one tenant fill one
+        # launch instead of paying one per connection (each tenant owns its
+        # batcher, so the merge is per-tenant by construction). Per-origin
+        # row-slice bookkeeping makes each block's reply bitwise what its
+        # own dispatch serves (the forward is per-row); `False` keeps the
+        # one-block-one-dispatch shape (the A/B the fleet bench pins bits
+        # against).
+        self.coalesce_blocks = bool(coalesce_blocks)
         self.metrics = metrics
         self.policy = policy
         # stuck-dispatch watchdog (serve/health.py), opt-in via the policy's
@@ -578,41 +597,33 @@ class MicroBatcher:
         feature count) fails on ITS OWN future with the engine's error
         instead of poisoning the concat of an entire well-formed batch.
 
-        A :class:`~orp_tpu.serve.ingest.Block` rides its OWN group: its
-        rows are already one contiguous device-shaped batch (the whole
+        A LONE :class:`~orp_tpu.serve.ingest.Block` rides its OWN group:
+        its rows are already one contiguous device-shaped batch (the whole
         point of the columnar lane — zero concatenates on the clean path),
         and its single future resolves with the status column instead of
-        per-request slices."""
+        per-request slices. SEVERAL admitted blocks sharing one key — the
+        fleet's many-small-connections-per-tenant shape — coalesce into
+        ONE dispatch (``coalesce_blocks``) with per-origin live-row
+        slices, so each connection still gets bitwise its own dispatch's
+        columns (per-row forward; pinned in tests/test_fleet.py)."""
         groups: dict[tuple, list[_Request]] = {}
+        block_groups: dict[tuple, list[Block]] = {}
         out: list[_Group] = []
         for req in batch:
             if isinstance(req, Block):
-                feats, prices = req.live_columns()
-                g = _Group(reqs=[], has_prices=prices is not None,
-                           rows=int(feats.shape[0]), date_idx=req.date_idx,
-                           block=req)
-                out.append(g)
-                try:
-                    g.feats, g.prices = feats, prices
-                    g.pending = self._dispatch_engine(g.date_idx, feats,
-                                                      prices)
-                except Exception as e:  # orp: noqa[ORP009] -- delivered to the block's future by _resolve
-                    g.error = e
-                    continue
-                if req.trace is not None:
-                    # the dispatch segment ends at device submission
-                    req.t_dispatch = time.perf_counter()
-                obs_count("serve/batcher_dispatches")
-                obs_count("serve/ingest_block_rows", g.rows, sink_event=False)
-                if self.metrics is not None:
-                    cap = (self.engine.bucket_for(g.rows)
-                           if hasattr(self.engine, "bucket_for") else
-                           self.max_batch)
-                    self.metrics.record_dispatch(1, g.rows, cap)
+                key = (req.date_idx, req.features.shape[1],
+                       None if req.prices is None else req.prices.shape[1])
+                block_groups.setdefault(key, []).append(req)
                 continue
             key = (req.date_idx, req.features.shape[1],
                    None if req.prices is None else req.prices.shape[1])
             groups.setdefault(key, []).append(req)
+        for (date_idx, _, pwidth), blks in block_groups.items():
+            if len(blks) == 1 or not self.coalesce_blocks:
+                for blk in blks:
+                    out.append(self._dispatch_block(blk))
+                continue
+            out.append(self._dispatch_coalesced(date_idx, pwidth, blks))
         for (date_idx, _, pwidth), reqs in groups.items():
             has_prices = pwidth is not None
             g = _Group(reqs=reqs, has_prices=has_prices,
@@ -638,6 +649,72 @@ class MicroBatcher:
                        self.max_batch)
                 self.metrics.record_dispatch(len(reqs), g.rows, cap)
         return out
+
+    def _dispatch_block(self, blk: Block) -> _Group:
+        """One block, one dispatch — the PR 10 lane unchanged: the block's
+        own contiguous columns go to the device with zero concatenates."""
+        feats, prices = blk.live_columns()
+        g = _Group(reqs=[], has_prices=prices is not None,
+                   rows=int(feats.shape[0]), date_idx=blk.date_idx,
+                   block=blk)
+        try:
+            g.feats, g.prices = feats, prices
+            g.pending = self._dispatch_engine(g.date_idx, feats, prices)
+        except Exception as e:  # orp: noqa[ORP009] -- delivered to the block's future by _resolve
+            g.error = e
+            return g
+        if blk.trace is not None:
+            # the dispatch segment ends at device submission
+            blk.t_dispatch = time.perf_counter()
+        obs_count("serve/batcher_dispatches")
+        obs_count("serve/ingest_block_rows", g.rows, sink_event=False)
+        if self.metrics is not None:
+            cap = (self.engine.bucket_for(g.rows)
+                   if hasattr(self.engine, "bucket_for") else
+                   self.max_batch)
+            self.metrics.record_dispatch(1, g.rows, cap)
+        return g
+
+    def _dispatch_coalesced(self, date_idx: int, pwidth, blks) -> _Group:
+        """Cross-connection coalescing: N admitted blocks with one
+        executable key ride ONE device dispatch. The concatenation order is
+        admission order, and each block's live-row count is kept so the
+        resolve stage slices every origin's columns back out — bitwise what
+        a per-block dispatch serves (the forward is per-row, and bucket
+        padding rides OUTSIDE the sliced rows)."""
+        has_prices = pwidth is not None
+        lives = []
+        feat_cols = []
+        price_cols = [] if has_prices else None
+        for blk in blks:
+            f, p = blk.live_columns()
+            lives.append(int(f.shape[0]))
+            feat_cols.append(f)
+            if has_prices:
+                price_cols.append(p)
+        g = _Group(reqs=[], has_prices=has_prices, rows=sum(lives),
+                   date_idx=date_idx, blocks=list(blks), block_lives=lives)
+        try:
+            g.feats = np.concatenate(feat_cols, axis=0)
+            g.prices = (np.concatenate(price_cols, axis=0)
+                        if has_prices else None)
+            g.pending = self._dispatch_engine(date_idx, g.feats, g.prices)
+        except Exception as e:  # orp: noqa[ORP009] -- delivered to every block future by _resolve
+            g.error = e
+            return g
+        now = time.perf_counter()
+        for blk in blks:
+            if blk.trace is not None:
+                blk.t_dispatch = now
+        obs_count("serve/batcher_dispatches")
+        obs_count("serve/batcher_coalesced_blocks", len(blks))
+        obs_count("serve/ingest_block_rows", g.rows, sink_event=False)
+        if self.metrics is not None:
+            cap = (self.engine.bucket_for(g.rows)
+                   if hasattr(self.engine, "bucket_for") else
+                   self.max_batch)
+            self.metrics.record_dispatch(len(blks), g.rows, cap)
+        return g
 
     def _dispatch_engine(self, date_idx: int, feats, pr):
         """One non-blocking engine dispatch, with the policy's bounded
@@ -701,6 +778,9 @@ class MicroBatcher:
             if g.block is not None:
                 self._resolve_block(g)
                 continue
+            if g.blocks is not None:
+                self._resolve_coalesced(g)
+                continue
             if g.error is not None:
                 for r in g.reqs:
                     if r.future.set_running_or_notify_cancel():
@@ -754,6 +834,41 @@ class MicroBatcher:
         blk.resolve_served(phi, psi, value, timing=timing)
         if self.metrics is not None:
             self.metrics.record(done - blk.submitted_at, g.rows)
+
+    def _resolve_coalesced(self, g: _Group) -> None:
+        """Resolve a coalesced multi-block dispatch: slice each origin's
+        live rows back out of the shared columns — contiguous slices in
+        admission order, so every connection's reply is bitwise its own
+        dispatch's — and resolve each block's future independently (one
+        dispatch failure reaches every coalesced future; there is one
+        device answer to miss)."""
+        if g.error is not None:
+            for blk in g.blocks:
+                if blk.future.set_running_or_notify_cancel():
+                    blk.future.set_exception(g.error)
+            return
+        try:
+            with span("serve/batch", attrs={"requests": len(g.blocks),
+                                            "rows": g.rows}) as sp:
+                phi, psi, value = self._blocked_result(g)
+        except Exception as e:  # noqa: BLE001 — delivered through every block future
+            for blk in g.blocks:
+                if blk.future.set_running_or_notify_cancel():
+                    blk.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        off = 0
+        served = []
+        for blk, n_live in zip(g.blocks, g.block_lives):
+            sl_phi = phi[off:off + n_live]
+            sl_psi = psi[off:off + n_live]
+            sl_val = value[off:off + n_live] if g.has_prices else None
+            off += n_live
+            timing = blk.trace_report(done) if blk.trace is not None else None
+            blk.resolve_served(sl_phi, sl_psi, sl_val, timing=timing)
+            served.append((done - blk.submitted_at, n_live))
+        if self.metrics is not None:
+            self.metrics.record_many(served)
 
 
 class _Resolved:

@@ -146,6 +146,14 @@ class ResilientGatewayClient:
 
     # -- producer side -------------------------------------------------------
 
+    @property
+    def dead(self) -> bool:
+        """True once the client is unusable: closed, or its reconnect
+        budget exhausted (every submit raises). A fleet router polls this
+        to decide whether a fresh client is needed for the replica."""
+        with self._lock:
+            return self._closed or self._dead is not None
+
     def submit_block_async(self, tenant: str, date_idx: int, states,
                            prices=None, deadlines=None, *,
                            deadline_ms: float | None = None,

@@ -420,7 +420,8 @@ class ServeGateway:
                 obs_count("serve/gateway_errors", stage="decode")
                 return self._send_on(st, wire.encode_error(str(e)))
             return self._send_on(st, wire.encode_health(self.health_report(
-                dump_flight=bool(ask.get("dump_flight")))))
+                dump_flight=bool(ask.get("dump_flight")),
+                route=ask.get("route"))))
         if kind == wire.KIND_HELLO:
             return self._handle_hello(frame, st)
         if kind != wire.KIND_REQUEST:
@@ -842,7 +843,8 @@ class ServeGateway:
             regs.append(st.registry)
         return "".join(prometheus_text(r) for r in regs)
 
-    def health_report(self, *, dump_flight: bool = False) -> dict:
+    def health_report(self, *, dump_flight: bool = False,
+                      route=None) -> dict:
         """Compact JSON health document (the HEALTH wire kind): draining
         flag, session count, cumulative ledgers, per-tenant pending, and
         the flight-ring state. ``dump_flight=True`` (a HEALTH request with
@@ -851,7 +853,14 @@ class ServeGateway:
         probe against a sick gateway leaves the evidence on disk. A plain
         probe (``orp top``'s per-refresh HEALTH) never writes — a
         read-only dashboard must not cause disk I/O in the serving
-        process."""
+        process.
+
+        When the host is a fleet router (``serve/fleet.py::FleetHost``)
+        the document additionally carries ``routing``: the routing-table
+        version, healthy set, per-replica health ages and the mapping of
+        a tenant sample (``route`` — a HEALTH request with ``{"route":
+        [...names...]}``; the default sample when omitted) — what ``orp
+        doctor --fleet`` compares across gateway processes."""
         dump = flight.RECORDER.dump() if dump_flight else None
         with self._lock:
             sessions = len(self._sessions)
@@ -859,7 +868,12 @@ class ServeGateway:
             name: {k: s[k] for k in ("live", "pending", "version")}
             for name, s in self.host.stats().items()
         }
+        routing = None
+        route_sample = getattr(self.host, "route_sample", None)
+        if route_sample is not None:
+            routing = route_sample(route)
         return {
+            **({"routing": routing} if routing is not None else {}),
             "draining": self._draining.is_set(),
             "aborted": self.aborted.is_set(),
             "sessions": sessions,
@@ -1021,13 +1035,19 @@ class GatewayClient:
             raise GatewayError(wire.decode_error(reply))
         return wire.decode_metrics(reply)
 
-    def health(self, *, dump_flight: bool = False) -> dict:
+    def health(self, *, dump_flight: bool = False, route=None) -> dict:
         """One HEALTH round trip: the gateway's JSON health document
         (draining flag, ledgers, per-tenant pending). ``dump_flight=True``
         asks the serving process to dump its flight recorder (when armed)
-        — the doctor's black-box hook; plain probes never cause writes."""
-        reply = self._roundtrip(wire.encode_health(
-            {"dump_flight": True} if dump_flight else None))
+        — the doctor's black-box hook; plain probes never cause writes.
+        ``route`` (a list of tenant names) asks a FLEET gateway for its
+        routing view of that sample (``routing`` in the document)."""
+        ask = {}
+        if dump_flight:
+            ask["dump_flight"] = True
+        if route is not None:
+            ask["route"] = list(route)
+        reply = self._roundtrip(wire.encode_health(ask or None))
         if wire.decode_kind(reply) == wire.KIND_ERROR:
             raise GatewayError(wire.decode_error(reply))
         return wire.decode_health(reply)

@@ -170,7 +170,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                   telemetry_dir=None, gateway=None, metrics=None,
-                  quality=None, perf=None,
+                  quality=None, perf=None, fleet=None,
                   gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
@@ -210,6 +210,16 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     still rooflines against the measured-matmul fallback, but the check
     says so in flag-speak because a fabricated-feeling fraction-of-peak is
     exactly what an operator should not discover mid-incident.
+    ``fleet``        — probe a whole serve fleet from its ``topology.json``
+    (``orp doctor --fleet topology.json``): PING every replica and every
+    fleet gateway, read each gateway's routing view (the HEALTH wire
+    kind's ``routing`` section — version, healthy set, per-replica health
+    age, tenant-sample mapping) and verify ROUTING AGREEMENT: every
+    gateway must map the same tenant sample to the same replicas under
+    the same table version (disagreement means per-process salt crept
+    into the hash — the ORP018 failure — or the gateways see different
+    replica sets). Per-replica health ages are reported as the maximum
+    staleness any gateway observes.
     ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
@@ -408,7 +418,11 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                    fix="no live scrape at that address — probe the ingest "
                        "port of a running `orp serve-gateway` (the METRICS "
                        "wire kind shares it), or fix host:port")
-    # 9) performance observatory: profiler + trace dir, ledger, peak table
+    # 9) the fleet: every replica + gateway answers, and every gateway
+    # agrees on the routing table (the fleet's founding invariant)
+    if fleet is not None:
+        _fleet_checks(checks, fleet, timeout_s=float(gateway_timeout_s))
+    # 10) performance observatory: profiler + trace dir, ledger, peak table
     if perf is not None:
         import tempfile
 
@@ -489,3 +503,98 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
             _check(checks, "perf_peaks", False, f"{type(e).__name__}: {e}",
                    fix="no jax backend came up — fix JAX_PLATFORMS first")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}
+
+
+def _fleet_checks(checks: list, topology, *, timeout_s: float) -> None:
+    """The ``--fleet`` probe battery: replica liveness, gateway liveness,
+    routing-table agreement across gateways, per-replica health age."""
+    from orp_tpu.serve.fleet import ROUTE_SAMPLE, FleetError, load_topology
+    from orp_tpu.serve.gateway import GatewayClient
+
+    try:
+        topo = load_topology(topology)
+    except FleetError as e:
+        _check(checks, "fleet_topology", False, str(e),
+               fix='write topology.json as {"gateways": ["host:port", …], '
+                   '"replicas": {"name": "host:port", …}}')
+        return
+    _check(checks, "fleet_topology", True,
+           f"{topology}: {len(topo['replicas'])} replica(s), "
+           f"{len(topo['gateways'])} gateway(s)")
+    # every replica: one PING + health round trip through its own gateway
+    for r in topo["replicas"]:
+        try:
+            with GatewayClient(r.addr, r.port, timeout_s=timeout_s) as c:
+                ok = c.ping()
+                doc = c.health()
+            draining = bool(doc.get("draining"))
+            _check(checks, f"replica:{r.name}", ok and not draining,
+                   f"{r.addr}:{r.port}: PING "
+                   f"{'ok' if ok else 'FAILED'}"
+                   + ("; DRAINING (its tenants are remapping)"
+                      if draining else ""),
+                   fix=f"restart the replica's serve-gateway on "
+                       f"{r.addr}:{r.port} (its tenants rendezvous onto "
+                       "the survivors meanwhile)")
+        except (OSError, ValueError, RuntimeError) as e:
+            _check(checks, f"replica:{r.name}", False,
+                   f"{r.addr}:{r.port}: {type(e).__name__}: {e}",
+                   fix=f"restart the replica's serve-gateway on "
+                       f"{r.addr}:{r.port} (its tenants rendezvous onto "
+                       "the survivors meanwhile)")
+    # every gateway: liveness + its ROUTING VIEW over a fixed tenant sample
+    views = {}
+    for addr, port in topo["gateways"]:
+        target = f"{addr}:{port}"
+        try:
+            with GatewayClient(addr, port, timeout_s=timeout_s) as c:
+                ok = c.ping()
+                doc = c.health(route=list(ROUTE_SAMPLE))
+            routing = doc.get("routing")
+            if routing is None:
+                _check(checks, f"gateway:{target}", False,
+                       f"{target}: answers but exports no routing view",
+                       fix="this is a plain serving gateway, not a fleet "
+                           "router — start it with `orp serve-gateway "
+                           "--fleet topology.json`")
+                continue
+            views[target] = routing
+            unhealthy = [n for n in routing.get("replicas", ())
+                         if n not in (routing.get("healthy") or ())]
+            _check(checks, f"gateway:{target}", ok,
+                   f"{target}: routing {routing.get('version')}, "
+                   f"{len(routing.get('healthy') or ())}/"
+                   f"{len(routing.get('replicas') or ())} replicas "
+                   "healthy"
+                   + (f" (unhealthy: {unhealthy})" if unhealthy else ""),
+                   fix=f"restart the fleet gateway on {target}")
+        except (OSError, ValueError, RuntimeError) as e:
+            _check(checks, f"gateway:{target}", False,
+                   f"{target}: {type(e).__name__}: {e}",
+                   fix=f"start the fleet gateway: `orp serve-gateway "
+                       f"--fleet {topology} --port {port}`")
+    # routing agreement: same sample -> same replica from EVERY gateway
+    if len(views) >= 1:
+        versions = {v.get("version") for v in views.values()}
+        maps = [v.get("map") or {} for v in views.values()]
+        agree = len(versions) == 1 and all(m == maps[0] for m in maps[1:])
+        # worst case wins deterministically: None (never probed ok) beats
+        # any numeric age, larger beats smaller — order-independent
+        ages = {}
+        for v in views.values():
+            for name, age in (v.get("ages_s") or {}).items():
+                if name in ages and (ages[name] is None or age is None):
+                    ages[name] = None
+                elif name not in ages or age > ages[name]:
+                    ages[name] = age
+        _check(checks, "fleet_routing", agree,
+               (f"{len(views)} gateway(s) agree: version "
+                f"{next(iter(versions))}, {len(maps[0])} sampled tenants "
+                f"map identically; health ages (max) {ages}"
+                if agree else
+                f"gateways DISAGREE: versions {sorted(versions)} — same "
+                "tenant sample maps differently across gateways"),
+               fix="the rendezvous table diverged: make sure every "
+                   "gateway runs the same topology.json and the same "
+                   "build (per-process salt in routing code is the "
+                   "ORP018 lint failure)")

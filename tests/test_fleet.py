@@ -1,0 +1,628 @@
+"""Horizontal-fleet oracles (orp_tpu/serve/{fleet,shm}.py + the batcher's
+cross-connection coalescing): the rendezvous routing table is salt-free
+and IDENTICAL across gateway processes (pinned by loading fleet.py
+standalone in subprocesses under different PYTHONHASHSEED), a dropped
+replica moves ONLY its own tenants, coalesced multi-block dispatches
+slice back out bitwise what per-block dispatches serve, a killed replica
+re-routes its in-flight blocks to the rendezvous successor with zero
+lost rows and zero duplicate serves, and the shared-memory ring survives
+wrap-around, detects torn writes, and answers a full ring with BUSY
+parity (refuse + resend, never shed). All tier-1; no sleep > 50ms."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.guard.serve import GuardPolicy
+from orp_tpu.serve import (
+    GatewayClient,
+    HedgeEngine,
+    MicroBatcher,
+    ServeGateway,
+    ServeHost,
+    export_bundle,
+)
+from orp_tpu.serve.fleet import (
+    ROUTE_SAMPLE,
+    FleetError,
+    FleetHost,
+    NoHealthyReplica,
+    ReplicaHealth,
+    ReplicaSpec,
+    RoutingTable,
+    fleet_snapshot,
+    load_topology,
+)
+from orp_tpu.serve.metrics import ServingMetrics
+from orp_tpu.serve.shm import RingClient, RingError, RingPair, RingServer
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+def _rows(n, nf=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return (1.0 + 0.1 * rng.standard_normal((n, nf))).astype(np.float32)
+
+
+def _specs(n, base=7500):
+    return [ReplicaSpec(f"r{i}", "127.0.0.1", base + i) for i in range(n)]
+
+
+# -- routing table ------------------------------------------------------------
+
+
+def test_routing_identical_across_processes_despite_hash_salt(tmp_path):
+    """THE fleet invariant: two gateway PROCESSES with different
+    PYTHONHASHSEED (the per-process salt builtin hash() bakes into every
+    str hash — the ORP018 hazard) compute bit-identical routing tables.
+    fleet.py is loaded standalone by file path, so the subprocesses pay
+    no jax import."""
+    import orp_tpu.serve.fleet as fleet_mod
+
+    script = (
+        "import importlib.util, json, sys\n"
+        "spec = importlib.util.spec_from_file_location('fleet_sa', "
+        "sys.argv[1])\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "sys.modules['fleet_sa'] = m\n"
+        "spec.loader.exec_module(m)\n"
+        "reps = [m.ReplicaSpec(f'r{i}', '127.0.0.1', 7500 + i) "
+        "for i in range(5)]\n"
+        "t = m.RoutingTable(reps)\n"
+        "print(json.dumps({'version': t.version(), "
+        "'map': t.mapping(list(m.ROUTE_SAMPLE))}))\n"
+    )
+    views = []
+    for seed in ("1", "31337"):
+        env = {**os.environ, "PYTHONHASHSEED": seed}
+        out = subprocess.run(
+            [sys.executable, "-c", script, fleet_mod.__file__],
+            capture_output=True, text=True, env=env, timeout=60, check=True)
+        views.append(json.loads(out.stdout))
+    assert views[0] == views[1], (
+        "two processes with different hash salts computed different "
+        "routing tables — the fleet's view split")
+    assert len(views[0]["map"]) == len(ROUTE_SAMPLE)
+
+
+def test_rendezvous_drop_moves_only_the_dead_replicas_tenants():
+    table = RoutingTable(_specs(4))
+    tenants = [f"desk-{i}" for i in range(64)]
+    before = table.mapping(tenants)
+    after = RoutingTable(_specs(4), healthy={"r0", "r1", "r3"}).mapping(
+        tenants)
+    moved = {t for t in tenants if before[t] != after[t]}
+    assert moved, "r2 served no tenants out of 64 — suspicious rendezvous"
+    assert all(before[t] == "r2" for t in moved), (
+        "a healthy replica's tenant moved when r2 dropped — rendezvous "
+        "minimal movement broken")
+    assert all(after[t] != "r2" for t in tenants)
+    # and the version fingerprint tracks the healthy view
+    assert table.version() != RoutingTable(
+        _specs(4), healthy={"r0", "r1", "r3"}).version()
+
+
+def test_no_healthy_replica_fails_loudly():
+    table = RoutingTable(_specs(2), healthy=())
+    with pytest.raises(NoHealthyReplica, match="start replicas"):
+        table.replica_for("desk-a")
+
+
+def test_load_topology_refuses_malformations(tmp_path):
+    bad = tmp_path / "t.json"
+    bad.write_text("not json")
+    with pytest.raises(FleetError, match="expected a JSON object"):
+        load_topology(bad)
+    bad.write_text(json.dumps({"replicas": {"r0": "no-port-here"}}))
+    with pytest.raises(FleetError, match="host:port"):
+        load_topology(bad)
+    bad.write_text(json.dumps({"replicas": {}}))
+    with pytest.raises(FleetError, match="zero replicas"):
+        load_topology(bad)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "gateways": ["127.0.0.1:7433"],
+        "replicas": {"r0": "127.0.0.1:7500", "r1": "127.0.0.1:7501"},
+    }))
+    topo = load_topology(good)
+    assert [r.name for r in topo["replicas"]] == ["r0", "r1"]
+    assert topo["gateways"] == [("127.0.0.1", 7433)]
+
+
+# -- cross-connection block coalescing ----------------------------------------
+
+
+def test_coalesced_blocks_bitwise_vs_uncoalesced_per_connection(trained):
+    """The coalescing contract: N small blocks sharing one executable key
+    merge into ONE device dispatch, and each origin's sliced-back reply
+    is BITWISE the reply its own dispatch would have served."""
+    engine = HedgeEngine(trained)
+    nf = engine.model.n_features
+    blocks = [_rows(16, nf, seed=s) for s in range(6)]
+    results = {}
+    dispatches = {}
+    for coalesce in (True, False):
+        metrics = ServingMetrics()
+        with MicroBatcher(engine, max_batch=16 * len(blocks),
+                          max_wait_us=5000.0, metrics=metrics,
+                          coalesce_blocks=coalesce) as mb:
+            futures = [mb.submit_block(0, b) for b in blocks]
+            results[coalesce] = [f.result(timeout=60) for f in futures]
+        dispatches[coalesce] = metrics.summary()["dispatches"]
+    for a, b in zip(results[True], results[False]):
+        np.testing.assert_array_equal(a.phi, b.phi)
+        np.testing.assert_array_equal(a.psi, b.psi)
+        np.testing.assert_array_equal(a.status, b.status)
+    # the merge actually happened: fewer launches than blocks
+    assert dispatches[True] < dispatches[False]
+    assert dispatches[False] >= len(blocks)
+    # and the coalesced columns are ALSO bitwise a direct evaluation
+    for blk, res in zip(blocks, results[True]):
+        phi, psi, _ = engine.evaluate(0, blk)
+        np.testing.assert_array_equal(res.phi, phi)
+        np.testing.assert_array_equal(res.psi, psi)
+
+
+def test_coalescing_keeps_guard_status_columns(trained):
+    """Blocks with expired per-row deadlines shed BY MASK before the
+    merge — the coalesced dispatch carries only live rows, and each
+    origin's status column still marks its own shed rows."""
+    engine = HedgeEngine(trained)
+    nf = engine.model.n_features
+    b1, b2 = _rows(8, nf, seed=1), _rows(8, nf, seed=2)
+    # block 2's first 3 rows are born expired
+    dl = np.full(8, 60.0)
+    dl[:3] = -1.0
+    with MicroBatcher(engine, max_batch=64, max_wait_us=5000.0,
+                      policy=GuardPolicy(deadline_ms=50.0),
+                      coalesce_blocks=True) as mb:
+        f1 = mb.submit_block(0, b1)
+        f2 = mb.submit_block(0, b2, deadlines=dl)
+        r1, r2 = f1.result(timeout=60), f2.result(timeout=60)
+    assert not r1.status.any()
+    assert (r2.status[:3] != 0).all() and not r2.status[3:].any()
+    phi1, _, _ = engine.evaluate(0, b1)
+    np.testing.assert_array_equal(r1.phi, phi1)
+    phi2, _, _ = engine.evaluate(0, b2[3:])
+    np.testing.assert_array_equal(r2.phi[3:], phi2)
+
+
+# -- fleet fan-out ------------------------------------------------------------
+
+
+def _replica(trained, tenants):
+    host = ServeHost(max_live_engines=max(4, len(tenants)))
+    for t in tenants:
+        host.add_tenant(t, trained)
+    gw = ServeGateway(host, port=0)
+    return host, gw
+
+
+FAST_RETRY = GuardPolicy(max_retries=2, backoff_ms=2.0, backoff_cap_ms=10.0)
+
+
+def test_fleet_forwards_bitwise_with_routing_agreement(trained):
+    """Two FleetHosts (two gateway processes' worth of routing state) fan
+    tenants over two replicas: identical routing views, and every served
+    block bitwise a direct engine evaluation."""
+    engine = HedgeEngine(trained)
+    nf = engine.model.n_features
+    tenants = [f"desk-{i}" for i in range(4)]
+    hosts_gws = [_replica(trained, tenants) for _ in range(2)]
+    specs = [ReplicaSpec(f"r{i}", *hg[1].address)
+             for i, hg in enumerate(hosts_gws)]
+    fleets = [FleetHost(specs, retry=FAST_RETRY,
+                        health=ReplicaHealth(specs, start=False))
+              for _ in range(2)]
+    try:
+        views = [fh.route_sample(tenants) for fh in fleets]
+        assert views[0]["version"] == views[1]["version"]
+        assert views[0]["map"] == views[1]["map"]
+        assert set(views[0]["map"].values()) == {"r0", "r1"}, (
+            "4 tenants all rendezvoused onto one replica — suspicious")
+        for i, t in enumerate(tenants):
+            feats = _rows(16, nf, seed=10 + i)
+            res = fleets[i % 2].submit_block(t, 0, feats).result(timeout=60)
+            phi, psi, _ = engine.evaluate(0, feats)
+            np.testing.assert_array_equal(res.phi, phi)
+            np.testing.assert_array_equal(res.psi, psi)
+            assert not res.status.any()
+        stats = fleets[0].stats()
+        assert set(stats) == {"r0", "r1"}
+        assert all(s["live"] for s in stats.values())
+    finally:
+        for fh in fleets:
+            fh.close()
+        for h, g in hosts_gws:
+            g.close(timeout=5.0)
+            h.close()
+
+
+def test_kill_one_replica_remaps_tenants_zero_loss(trained):
+    """The fleet drill at test scale: a replica is ABORTED (chaos
+    SIGKILL) and its tenants' blocks re-route to the rendezvous
+    successor — bits equal, nothing lost, nothing served twice, and the
+    routing table remaps away from the corpse."""
+    engine = HedgeEngine(trained)
+    nf = engine.model.n_features
+    tenants = [f"desk-{i}" for i in range(6)]
+    hosts_gws = [_replica(trained, tenants) for _ in range(2)]
+    specs = [ReplicaSpec(f"r{i}", *hg[1].address)
+             for i, hg in enumerate(hosts_gws)]
+    fleet = FleetHost(specs, retry=FAST_RETRY, timeout_s=30.0,
+                      health=ReplicaHealth(specs, start=False))
+    try:
+        mapping = fleet.table().mapping(tenants)
+        victim = mapping[tenants[0]]
+        vi = int(victim[1:])
+        affected = [t for t in tenants if mapping[t] == victim]
+        # warm the forwarding clients on the clean path first
+        warm = {t: fleet.submit_block(t, 0, _rows(8, nf, seed=50))
+                for t in tenants}
+        for t, fut in warm.items():
+            assert not fut.result(timeout=60).status.any()
+        # kill the victim REPLICA mid-fleet
+        hosts_gws[vi][1].abort()
+        blocks = {t: _rows(16, nf, seed=60 + i)
+                  for i, t in enumerate(tenants)}
+        futs = {t: fleet.submit_block(t, 0, blocks[t]) for t in tenants}
+        for t, fut in futs.items():
+            res = fut.result(timeout=60)
+            phi, psi, _ = engine.evaluate(0, blocks[t])
+            np.testing.assert_array_equal(res.phi, phi)
+            np.testing.assert_array_equal(res.psi, psi)
+            assert not res.status.any(), f"rows shed for {t} — rows lost"
+        # exactly-once-serve held one hop deeper: no forwarding client
+        # saw a duplicate reply
+        dups = sum(c.stats["duplicate_replies"]
+                   for c in fleet._clients.values())
+        assert dups == 0
+        # the health view remapped away from the corpse
+        remapped = fleet.table().mapping(tenants)
+        assert all(r != victim for r in remapped.values())
+        moved = {t for t in tenants if mapping[t] != remapped[t]}
+        assert moved == set(affected), (
+            "the kill moved a survivor's tenants too — rendezvous "
+            "minimal movement broken under failure")
+    finally:
+        fleet.close()
+        for h, g in hosts_gws:
+            g.close(timeout=5.0)
+            h.close()
+
+
+def test_poison_frame_error_passes_through_without_reroute(trained):
+    """A structured ERROR reply (unknown tenant — the replica is ALIVE
+    and answered) is the producer's error, not a health signal: the
+    future raises it, nothing re-routes, and the replica stays in the
+    healthy set (found live: before the fix, one poison frame marked
+    every replica suspect until NoHealthyReplica took the fleet down)."""
+    from orp_tpu.serve.gateway import GatewayError
+
+    host, rep_gw = _replica(trained, ["desk-0"])
+    specs = [ReplicaSpec("r0", *rep_gw.address),
+             ReplicaSpec("r1", *rep_gw.address)]  # same live backend twice
+    fleet = FleetHost(specs, retry=FAST_RETRY,
+                      health=ReplicaHealth(specs, start=False))
+    try:
+        nf = HedgeEngine(trained).model.n_features
+        with pytest.raises(GatewayError, match="(?i)tenant"):
+            fleet.submit_block("nope", 0, _rows(4, nf)).result(timeout=60)
+        # the replica that ANSWERED is still healthy and still serves
+        assert fleet.table().healthy == frozenset({"r0", "r1"})
+        res = fleet.submit_block("desk-0", 0, _rows(4, nf)).result(
+            timeout=60)
+        assert not res.status.any()
+    finally:
+        fleet.close()
+        rep_gw.close(timeout=5.0)
+        host.close()
+
+
+def test_health_probe_drops_dead_replica_and_readmits():
+    """ReplicaHealth's active probe: a dead address leaves the healthy
+    set after fail_after consecutive failures (no sleeps — probe_once is
+    called directly), and on_change fires outside the lock."""
+    # one real listener so ONE replica probes healthy
+    import socket
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    changes = []
+    # r0 answers TCP but not the wire protocol -> probe fails; keep
+    # fail_after=1 so one round decides
+    specs = [ReplicaSpec("r0", "127.0.0.1", port),
+             ReplicaSpec("r1", "127.0.0.1", 1)]  # port 1: refused
+    h = ReplicaHealth(specs, start=False, fail_after=1, timeout_s=0.3,
+                      on_change=lambda s: changes.append(s))
+    try:
+        healthy = h.probe_once()
+        assert healthy == frozenset()
+        assert changes and changes[-1] == frozenset()
+        ages = h.ages()
+        assert ages["r0"] is None and ages["r1"] is None
+        # suspect marking is idempotent on an unknown name
+        h.mark_suspect("nope")
+    finally:
+        h.close()
+        lsock.close()
+
+
+def test_fleet_snapshot_aggregates_and_flags_split_routing():
+    snap_a = {"requests": 10.0, "rows": 100.0, "gateway_rows": 100.0,
+              "shed": 1.0, "busy": 0.0, "errors": 0.0,
+              "rates": {"requests_per_s": 5.0},
+              "queue_age_p99_ms": 2.0}
+    snap_b = {**snap_a, "rates": {"requests_per_s": 7.0}}
+    per = {
+        "g1": {"snap": snap_a, "routing": {"version": "aaa"}},
+        "g2": {"snap": snap_b, "routing": {"version": "aaa"}},
+    }
+    agg = fleet_snapshot(per)
+    assert agg["routing_consistent"] is True
+    assert agg["rates"]["requests_per_s"] == pytest.approx(12.0)
+    assert agg["gateway_rows"] == pytest.approx(200.0)
+    per["g2"]["routing"] = {"version": "bbb"}
+    split = fleet_snapshot(per)
+    assert split["routing_consistent"] is False
+    assert split["routing_versions"] == ["aaa", "bbb"]
+    # a gateway with NO routing view (a plain serving gateway listed as a
+    # fleet gateway) must never read as agreement
+    per["g2"]["routing"] = None
+    noview = fleet_snapshot(per)
+    assert noview["routing_consistent"] is False
+    assert noview["routing_viewless"] == ["g2"]
+
+
+# -- shared-memory ring -------------------------------------------------------
+
+
+def test_ring_wraparound_preserves_every_frame_bitwise():
+    """Frames of awkward (unaligned) sizes pushed far past the ring's
+    capacity: every pop returns the exact bytes, across many laps and
+    wrap markers."""
+    pair = RingPair.create(req_capacity=4096, rep_capacity=4096)
+    try:
+        ring = pair.request
+        rng = np.random.default_rng(7)
+        for i in range(200):
+            frame = rng.integers(0, 256, size=int(rng.integers(1, 700)),
+                                 dtype=np.uint8).tobytes() + bytes([i % 256])
+            assert ring.push(frame) is True
+            got = ring.pop()
+            assert got == frame, f"frame {i} corrupted across the ring"
+        assert ring.pop() is None and ring.depth() == 0
+    finally:
+        pair.unlink()
+
+
+def test_ring_full_refuses_with_busy_parity_then_drains():
+    pair = RingPair.create(req_capacity=4096, rep_capacity=4096)
+    try:
+        ring = pair.request
+        frame = bytes(900)
+        pushed = 0
+        while ring.push(frame):
+            pushed += 1
+            assert pushed < 100, "ring never filled"
+        # full: push refuses (BUSY parity), nothing shed; drain one,
+        # and the SAME frame goes through on resend
+        assert ring.push(frame) is False
+        assert ring.pop() == frame
+        assert ring.push(frame) is True
+        # oversized frames refuse loudly instead of deadlocking the lane
+        from orp_tpu.serve import wire
+
+        with pytest.raises(wire.WireError, match="record cap"):
+            ring.push(bytes(4096))
+    finally:
+        pair.unlink()
+
+
+def test_ring_torn_write_detected_not_consumed():
+    """A cursor seqlock stuck odd (the peer died mid-publish) surfaces as
+    a clean RingError — never as garbage frames."""
+    import struct
+
+    pair = RingPair.create(req_capacity=4096, rep_capacity=4096)
+    try:
+        assert pair.request.push(b"frame-before-the-crash")
+        # simulate the producer dying INSIDE a head-cursor publish: the
+        # seqlock counter is left odd
+        struct.pack_into("<Q", pair._mm, 64, 1)
+        with pytest.raises(RingError, match="torn write"):
+            pair.request.pop()
+    finally:
+        pair.unlink()
+
+
+def test_ring_attach_refuses_foreign_and_truncated(tmp_path):
+    foreign = tmp_path / "foreign.shm"
+    foreign.write_bytes(b"\x00" * 256)
+    with pytest.raises(RingError, match="bad magic"):
+        RingPair.attach(foreign)
+    tiny = tmp_path / "tiny.shm"
+    tiny.write_bytes(b"\x00" * 8)
+    with pytest.raises(RingError, match="no orp shm ring"):
+        RingPair.attach(tiny)
+    pair = RingPair.create(path=tmp_path / "real.shm",
+                           req_capacity=4096, rep_capacity=4096)
+    try:
+        with open(pair.path, "r+b") as f:
+            f.truncate(512)
+        with pytest.raises(RingError, match="truncated ring"):
+            RingPair.attach(pair.path)
+    finally:
+        pair.unlink()
+
+
+def test_ring_client_server_end_to_end_bitwise(trained):
+    """The shm lane's acceptance pin: RingClient -> RingServer ->
+    ServeHost over a file-backed RingPair serves BITWISE what a direct
+    engine evaluation serves, with duplicate_replies pinned 0 and the
+    windowed pipeline keeping frames sequenced."""
+    engine = HedgeEngine(trained)
+    nf = engine.model.n_features
+    blocks = [_rows(32, nf, seed=80 + i) for i in range(12)]
+    with ServeHost(max_live_engines=1) as host:
+        host.add_tenant("shm", trained)
+        pair = RingPair.create(req_capacity=1 << 18, rep_capacity=1 << 18)
+        try:
+            with RingServer(host, pair, default_tenant="shm") as server:
+                with RingClient(pair, window=4) as client:
+                    assert client.ping(timeout_s=10.0)
+                    futs = [client.submit_block_async("shm", 0, b)
+                            for b in blocks]
+                    results = [f.result(timeout=60) for f in futs]
+                totals = server.totals()
+            for blk, res in zip(blocks, results):
+                phi, psi, _ = engine.evaluate(0, blk)
+                np.testing.assert_array_equal(res.phi, phi)
+                np.testing.assert_array_equal(res.psi, psi)
+                assert not res.status.any()
+            assert client.stats["duplicate_replies"] == 0
+            assert totals["submitted_frames"] == len(blocks)
+            assert totals["rows"] == sum(b.shape[0] for b in blocks)
+            assert totals["errors"] == 0
+        finally:
+            pair.unlink()
+
+
+def test_ring_server_answers_malformed_frames_with_error(trained):
+    with ServeHost(max_live_engines=1) as host:
+        host.add_tenant("shm", trained)
+        pair = RingPair.create(req_capacity=1 << 16, rep_capacity=1 << 16)
+        try:
+            with RingServer(host, pair, default_tenant="shm") as server:
+                with RingClient(pair, window=4) as client:
+                    # a malformed frame straight onto the ring, then a
+                    # valid block: the lane answers ERROR and keeps serving
+                    assert pair.request.push(b"GARBAGE-NOT-A-FRAME" * 3)
+                    res = client.submit_block(
+                        "shm", 0, _rows(8, HedgeEngine(
+                            trained).model.n_features, seed=5))
+                    assert not res.status.any()
+                assert server.totals()["errors"] >= 1
+        finally:
+            pair.unlink()
+
+
+# -- doctor + CLI -------------------------------------------------------------
+
+
+def test_doctor_fleet_probe_agreement_and_failures(tmp_path, trained):
+    """`orp doctor --fleet topology.json`: healthy fleet probes ok with
+    the routing-agreement row; a topology naming a dead replica fails in
+    flag-speak."""
+    from orp_tpu.serve.health import doctor_report
+
+    tenants = list(ROUTE_SAMPLE[:2])
+    host, rep_gw = _replica(trained, tenants)
+    specs = [ReplicaSpec("r0", *rep_gw.address)]
+    fleet = FleetHost(specs, retry=FAST_RETRY,
+                      health=ReplicaHealth(specs, start=False))
+    fleet_gw = ServeGateway(fleet, port=0)
+    try:
+        topo = tmp_path / "topology.json"
+        topo.write_text(json.dumps({
+            "gateways": ["%s:%d" % fleet_gw.address],
+            "replicas": {"r0": "%s:%d" % rep_gw.address},
+        }))
+        rep = doctor_report(fleet=str(topo), gateway_timeout_s=5.0)
+        by = {c["check"]: c for c in rep["checks"]}
+        assert by["replica:r0"]["ok"], by["replica:r0"]
+        assert by["fleet_routing"]["ok"], by["fleet_routing"]
+        assert rep["ok"]
+        # a dead replica in the topology -> failing row, flag-speak fix
+        topo.write_text(json.dumps({
+            "gateways": ["%s:%d" % fleet_gw.address],
+            "replicas": {"r0": "%s:%d" % rep_gw.address,
+                         "r9": "127.0.0.1:1"},
+        }))
+        rep2 = doctor_report(fleet=str(topo), gateway_timeout_s=2.0)
+        by2 = {c["check"]: c for c in rep2["checks"]}
+        assert not rep2["ok"]
+        assert not by2["replica:r9"]["ok"]
+        assert "restart the replica" in by2["replica:r9"]["fix"]
+    finally:
+        fleet_gw.close(timeout=5.0)
+        fleet.close()
+        rep_gw.close(timeout=5.0)
+        host.close()
+
+
+def test_gateway_health_carries_routing_view(trained):
+    """A FLEET gateway's HEALTH reply carries the routing section (what
+    `orp doctor --fleet` and `orp top --fleet` consume); a plain serving
+    gateway's does not."""
+    tenants = ["desk-0"]
+    host, rep_gw = _replica(trained, tenants)
+    specs = [ReplicaSpec("r0", *rep_gw.address)]
+    fleet = FleetHost(specs, retry=FAST_RETRY,
+                      health=ReplicaHealth(specs, start=False))
+    fleet_gw = ServeGateway(fleet, port=0)
+    try:
+        with GatewayClient(*fleet_gw.address) as c:
+            doc = c.health(route=["desk-0", "desk-1"])
+        routing = doc["routing"]
+        assert routing["version"]
+        assert routing["map"] == {"desk-0": "r0", "desk-1": "r0"}
+        assert routing["healthy"] == ["r0"]
+        with GatewayClient(*rep_gw.address) as c:
+            plain = c.health()
+        assert "routing" not in plain
+    finally:
+        fleet_gw.close(timeout=5.0)
+        fleet.close()
+        rep_gw.close(timeout=5.0)
+        host.close()
+
+
+def test_cli_serve_bench_fleet_quick_smoke(tmp_path, capsys, trained):
+    """The CI satellite: `serve-bench --fleet --quick` runs the fleet
+    phase at tiny scale and every contract is gate-enforced — routing
+    agreement, bitwise-vs-direct bits, the coalescing merge, and (at 2
+    replicas) the kill drill's rows_lost 0 / duplicate_serves 0."""
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    cli.main([
+        "serve-bench", "--bundle", str(bdir), "--requests", "8",
+        "--batcher-requests", "8", "--sweep-concurrency", "",
+        "--fleet", "--quick", "--out", "",
+    ])
+    rec = json.loads(capsys.readouterr().out.strip())
+    fl = rec["fleet"]
+    assert fl["replica_counts"] == [1, 2]
+    for level in fl["levels"]:
+        assert level["routing_consistent"] is True
+        assert level["bitwise_equal"] is True
+        assert level["rows_per_s"] > 0
+    assert fl["coalesce"]["bitwise_equal"] is True
+    assert (fl["coalesce"]["dispatches_coalesced"]
+            < fl["coalesce"]["dispatches_uncoalesced"])
+    drill = fl["kill_drill"]
+    assert drill["rows_lost"] == 0
+    assert drill["duplicate_serves"] == 0
+    assert drill["tenants_remapped"] >= 1
+    assert drill["mttr_ms"] >= 0
+    assert rec["fleet_rows_per_s"] == max(
+        fl["levels"], key=lambda lv: lv["replicas"])["rows_per_s"]
+    assert rec["fleet_mttr_ms"] == drill["mttr_ms"]

@@ -26,7 +26,8 @@ def codes(src, **kw):
 def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
-                             "ORP014", "ORP015", "ORP016", "ORP017"})
+                             "ORP014", "ORP015", "ORP016", "ORP017",
+                             "ORP018"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1299,6 +1300,68 @@ def test_orp017_noqa_suppresses():
             return dt
     """
     assert codes(src) == []
+
+
+# -- ORP018: salted hash/random in routing-decision code ----------------------
+
+ORP018_POS = """
+    import random
+    import numpy as np
+
+    def replica_for_route(tenant, replicas):
+        return replicas[hash(tenant) % len(replicas)]   # per-process salt
+
+    def shard_of(key, n):
+        return random.randrange(n)                      # process-local stream
+
+    def pick_placement(nodes):
+        rng = np.random.default_rng()                   # unseeded generator
+        return nodes[rng.integers(len(nodes))]
+"""
+
+ORP018_NEG = """
+    import hashlib
+    import numpy as np
+
+    def replica_for_route(tenant, replicas):
+        h = hashlib.blake2b(tenant.encode(), digest_size=8)
+        return replicas[int.from_bytes(h.digest(), "big") % len(replicas)]
+
+    def shard_of(key, n):
+        rng = np.random.default_rng(seed=17)            # seeded: identical
+        return int(rng.integers(n))                     # in every process
+
+    def jitter_backoff(attempt):
+        import random
+        return random.uniform(0, 0.1 * attempt)         # not a routing fn
+"""
+
+
+def test_orp018_flags_salted_routing_decisions():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP018_POS),
+                                       path="orp_tpu/serve/fleet.py")]
+    assert got == ["ORP018", "ORP018", "ORP018"]
+
+
+def test_orp018_clean_negative():
+    assert lint_source(textwrap.dedent(ORP018_NEG),
+                       path="orp_tpu/serve/fleet.py") == []
+
+
+def test_orp018_scoped_to_serve():
+    # the same source outside serve/ is out of scope: per-process hashing
+    # only splits a FLEET's view; single-process code may hash freely
+    assert lint_source(textwrap.dedent(ORP018_POS),
+                       path="orp_tpu/train/backward.py") == []
+
+
+def test_orp018_noqa_suppresses():
+    src = """
+        def routing_debug_sample(tenants):
+            return [t for t in tenants if hash(t) % 7 == 0]  # orp: noqa[ORP018] -- debug sampling, never a placement decision
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/serve/fleet.py") == []
 
 
 # -- suppressions ------------------------------------------------------------
