@@ -41,7 +41,10 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 (per-request vs ``submit_block`` vs gateway loopback, bits
                 pinned equal, ``submit_ns_per_row`` headline);
                 ``--gateway-drill`` appends the kill-at-frame-k delivery
-                drill (frame-level MTTR, ``rows_lost: 0``)
+                drill (frame-level MTTR, ``rows_lost: 0``); ``--density``
+                appends the tenant-density sweep (catalog tenants through
+                one host: per-tier activation histograms, CAS dedup
+                ratio, the tenants-at-p99 curve)
 - ``serve-gateway`` serve a bundle over the ``orp-ingest`` TCP front
                 (``orp_tpu/serve/gateway.py``): length-prefixed columnar
                 frames in, columnar replies out — the non-Python-per-row
@@ -96,9 +99,17 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 thing to run on a broken pod. ``--quality BUNDLE`` probes
                 the model-health plumbing: baked baseline sketch +
                 validation-set fingerprint present, quality record
-                parseable with a nonzero RQMC CI
+                parseable with a nonzero RQMC CI; ``--store ROOT`` probes
+                a content-addressed bundle store (catalog parseable, CAS
+                writable, no dangling references)
+- ``store``     operate a content-addressed bundle store
+                (``orp_tpu/store``): ``put`` publishes an exported bundle
+                under catalog tenant names (identical trees dedup to
+                shared blobs), ``stat`` reports occupancy + dedup ratio,
+                ``gc`` reclaims unreferenced blobs against the catalog
+                closure
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP017 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP019 — recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
@@ -108,7 +119,9 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 code, unbounded socket I/O, dynamic obs instrument names /
                 hot-path instrument construction, numeric acceptance gates
                 that never record their measurement, stop-clocks read
-                before the block on jit-dispatched work); exits non-zero
+                before the block on jit-dispatched work, bare writes in
+                store/bundle persistence code that must go through
+                utils/atomic); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -803,6 +816,8 @@ def cmd_serve_bench(args):
     fleet_replicas = tuple(int(x) for x in args.fleet_replicas.split(","))
     fleet_gateways, fleet_tenants = args.fleet_gateways, args.fleet_tenants
     fleet_blocks, fleet_rows = args.fleet_blocks, args.fleet_rows
+    density_tenants = args.density_tenants
+    density_max_live = args.density_max_live
     repeats = args.repeats
     if args.quick:
         # the CI smoke shape: tiny block counts, same lanes, same pins —
@@ -817,7 +832,12 @@ def cmd_serve_bench(args):
         fleet_tenants = min(fleet_tenants, 3)
         fleet_blocks = min(fleet_blocks, 3)
         fleet_rows = min(fleet_rows, 16)
-        if args.fleet:
+        # two same-policy tenants through a one-engine host still exercise
+        # every tier transition and both density gates (dedup > 1, warm
+        # compiles == 0) without thousand-tenant spend
+        density_tenants = min(density_tenants, 2)
+        density_max_live = 1
+        if args.fleet or args.density:
             repeats = 1
     if any(n < 1 for n in fleet_replicas):
         raise SystemExit("error: --fleet-replicas counts must be >= 1")
@@ -856,6 +876,11 @@ def cmd_serve_bench(args):
         fleet_tenants=fleet_tenants,
         fleet_blocks=fleet_blocks,
         fleet_block_rows=fleet_rows,
+        density=args.density,
+        density_tenants=density_tenants,
+        density_rows=args.density_rows,
+        density_max_live=density_max_live,
+        density_budget_ms=args.density_budget_ms,
         repeats=repeats,
         previous=previous,
     )
@@ -1100,7 +1125,7 @@ def cmd_doctor(args):
                         telemetry_dir=args.telemetry_dir,
                         gateway=args.gateway, metrics=args.metrics,
                         quality=args.quality, perf=args.perf,
-                        fleet=args.fleet,
+                        fleet=args.fleet, store=args.store,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -1113,6 +1138,68 @@ def cmd_doctor(args):
         print("healthy" if rep["ok"] else "NOT healthy")
     if not rep["ok"]:
         raise SystemExit(1)
+
+
+def cmd_store(args):
+    """``orp store put|stat|gc`` — operate a content-addressed bundle
+    store: publish an exported bundle under catalog tenant names (put),
+    report the dedup/occupancy picture (stat), or reclaim unreferenced
+    blobs (gc — never touches anything the catalog still points at)."""
+    from orp_tpu.store import open_store
+
+    store = open_store(args.root)
+    if args.action == "put":
+        tenants = [t for t in (args.tenants or "").split(",") if t]
+        if not args.bundle or not tenants:
+            raise SystemExit(
+                "error: store put needs --bundle DIR (an `orp export` "
+                "output) and --tenants NAME[,NAME…] (the catalog names "
+                "to publish under)")
+        try:
+            published = store.publish_many(tenants, args.bundle)
+        except ValueError as e:
+            raise SystemExit(f"error: {e}") from None
+        out = {"root": str(args.root), "published": published,
+               "stats": store.stats()}
+        if args.json:
+            print(json.dumps(out))
+        else:
+            for name, ent in published.items():
+                print(f"published {name}@v{ent['version']} "
+                      f"manifest {ent['manifest'][:12]} "
+                      f"({ent['files']} files)")
+            st = out["stats"]
+            print(f"store: {st['blobs']} blobs, {st['blob_bytes']} bytes, "
+                  f"dedup ratio {st['dedup_ratio']}")
+    elif args.action == "stat":
+        # stats() counts tenants; the report names them (dict wins the key)
+        out = {"root": str(args.root), **store.stats(),
+               "tenants": store.tenants()}
+        if args.json:
+            print(json.dumps(out))
+        else:
+            print(f"{out['root']}: {len(out['tenants'])} tenants, "
+                  f"{out['manifests']} manifests, {out['blobs']} blobs "
+                  f"({out['blob_bytes']} bytes), dedup ratio "
+                  f"{out['dedup_ratio']}")
+            if out["dangling_refs"]:
+                print(f"WARNING: {out['dangling_refs']} dangling blob "
+                      "reference(s) — the catalog points at bytes the CAS "
+                      "no longer holds; re-publish with `orp store put`")
+            if out["orphan_blobs"]:
+                print(f"{out['orphan_blobs']} orphan blob(s), "
+                      f"{out['orphan_bytes']} bytes reclaimable via "
+                      "`orp store gc`")
+    else:  # gc
+        out = {"root": str(args.root),
+               **store.gc(dry_run=args.dry_run)}
+        if args.json:
+            print(json.dumps(out))
+        else:
+            verb = "would remove" if out["dry_run"] else "removed"
+            print(f"{verb} {out['removed']} blob(s), "
+                  f"{out['removed_bytes']} bytes; kept {out['kept']} "
+                  "referenced blob(s)")
 
 
 def cmd_top(args):
@@ -1913,6 +2000,28 @@ def build_parser():
                      help="blocks each tenant streams per measurement")
     psb.add_argument("--fleet-rows", type=int, default=64,
                      help="rows per fleet block")
+    psb.add_argument("--density", action="store_true",
+                     help="append the tenant-density sweep: publish "
+                          "--density-tenants distinct catalog tenants into "
+                          "a content-addressed store (one shared policy — "
+                          "the dedup ratio is measured, gated > 1) and "
+                          "serve them through one host capped at "
+                          "--density-max-live engines; records cold/warm/"
+                          "hot activation histograms, the tenants-at-p99 "
+                          "curve against --density-budget-ms, and pins "
+                          "warm re-activation at ZERO XLA compiles — the "
+                          "phase FAILS when either contract is violated")
+    psb.add_argument("--density-tenants", type=int, default=1000,
+                     help="distinct catalog tenants the density sweep "
+                          "publishes and touches")
+    psb.add_argument("--density-rows", type=int, default=8,
+                     help="rows per density request")
+    psb.add_argument("--density-max-live", type=int, default=8,
+                     help="live-engine cap of the density host (evictions "
+                          "drive the warm tier)")
+    psb.add_argument("--density-budget-ms", type=float, default=500.0,
+                     help="cold-activation p99 budget the tenants-within-"
+                          "budget headline is scored against")
     psb.add_argument("--quick", action="store_true",
                      help="CI smoke shape: shrink the ingest sweep, the "
                           "gateway drill and the fleet phase to tiny "
@@ -2114,6 +2223,12 @@ def build_parser():
                            "ROUTING-TABLE AGREEMENT (same tenant sample → "
                            "same replica from every gateway, same table "
                            "version) plus per-replica health ages")
+    pdoc.add_argument("--store", default=None, metavar="ROOT",
+                      help="probe a content-addressed bundle store: catalog "
+                           "parseable, CAS directory writable, and the "
+                           "catalog closure free of dangling blob "
+                           "references (orphan blobs report as reclaimable "
+                           "via `orp store gc`, not as failures)")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "
@@ -2121,6 +2236,35 @@ def build_parser():
     pdoc.add_argument("--json", action="store_true",
                       help="machine-readable report")
     pdoc.set_defaults(fn=cmd_doctor)
+
+    pst = sub.add_parser(
+        "store",
+        help="operate a content-addressed bundle store (orp_tpu/store): "
+             "put publishes an exported bundle under catalog tenant "
+             "names (identical trees dedup to shared blobs), stat "
+             "reports tenants/blobs/dedup-ratio/orphans, gc reclaims "
+             "unreferenced blobs — never anything the catalog points at",
+    )
+    pst.add_argument("action", choices=("put", "stat", "gc"),
+                     help="put: publish --bundle under --tenants; "
+                          "stat: occupancy + dedup report; "
+                          "gc: drop unreferenced blobs")
+    pst.add_argument("--root", required=True,
+                     help="store root directory (holds blobs/, "
+                          "catalog.json and the shared warm/ cache)")
+    pst.add_argument("--bundle", default=None,
+                     help="exported bundle directory to publish "
+                          "(`orp export --out`; put only)")
+    pst.add_argument("--tenants", default=None, metavar="NAME[,NAME…]",
+                     help="catalog names to publish the bundle under "
+                          "(put only; one bundle, many tenants — the "
+                          "whole-book shape)")
+    pst.add_argument("--dry-run", action="store_true",
+                     help="gc only: report what would be removed "
+                          "without unlinking anything")
+    pst.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    pst.set_defaults(fn=cmd_store)
 
     prep = sub.add_parser(
         "report",
@@ -2143,8 +2287,9 @@ def build_parser():
              "single-device assumptions, per-row ingest work, unbounded "
              "socket I/O, dynamic obs instrument names, unrecorded "
              "numeric acceptance gates, stop-clocks read before the "
-             "block on jitted work — rules "
-             "ORP001-ORP017); non-zero "
+             "block on jitted work, bare writes in store/bundle "
+             "persistence code — rules "
+             "ORP001-ORP019); non-zero "
              "exit on findings",
     )
     pl.add_argument("paths", nargs="*", default=None,

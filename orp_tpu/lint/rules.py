@@ -125,6 +125,19 @@ ORP018  per-process-salted hashing in routing/sharding/placement code:
         digest (``hashlib.blake2b`` — ``serve/fleet.py::route_weight``)
         or a seeded generator; a function that genuinely wants
         process-local randomness says so with a noqa.
+ORP019  bare writes in store/bundle persistence code: everything under
+        ``orp_tpu/store/`` plus ``serve/bundle.py`` persists artifacts
+        other processes read concurrently — a catalog a ServeHost is
+        resolving from, a CAS blob a warm-prefetch is materializing, a
+        bundle a gateway is loading. A bare ``open(..., "w")`` /
+        ``write_text`` / ``write_bytes`` leaves a TORN file visible at
+        its final name the moment the process dies mid-write (a
+        half-written catalog.json bricks every tenant; a short blob
+        fails its own digest on the next read). Every write goes through
+        ``utils/atomic.py`` (``atomic_write_text`` /
+        ``atomic_write_bytes``: temp file + fsync + ``os.replace``);
+        a site that genuinely wants a bare write (scratch no reader
+        races on) says so with a noqa.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -1407,6 +1420,65 @@ def check_salted_routing_hash(ctx: FileContext) -> Iterator[Finding]:
                     "keyed digest or a generator seeded from the "
                     "routing key",
                 )
+
+
+# -- ORP019 ------------------------------------------------------------------
+
+# the persistence surfaces other processes read concurrently: the bundle
+# store (catalog + CAS + warm cache) and the serve bundle exporter
+_ORP019_SCOPE_DIRS = ("store/",)
+_ORP019_SCOPE_FILES = ("serve/bundle.py",)
+_ORP019_WRITE_METHODS = {"write_text", "write_bytes"}
+
+
+def _orp019_open_mode(node: ast.Call) -> str | None:
+    """The literal mode string of an ``open()`` call, or None when absent
+    or dynamic (a dynamic mode is out of heuristic reach)."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if mode is None:
+        return ""  # open(p) defaults to "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+@rule("ORP019", "bare write in store/bundle persistence code (use utils/atomic)")
+def check_bare_persistence_writes(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not (any(d in path for d in _ORP019_SCOPE_DIRS)
+            or path.endswith(_ORP019_SCOPE_FILES)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            mode = _orp019_open_mode(node)
+            if mode is not None and any(c in mode for c in "wax"):
+                yield ctx.finding(
+                    node, "ORP019",
+                    f"open(..., {mode!r}) in persistence code — a crash "
+                    "mid-write leaves a torn file at its final name for "
+                    "every concurrent reader (a half-written catalog "
+                    "bricks its tenants); write through "
+                    "utils/atomic.atomic_write_text/_bytes "
+                    "(temp + fsync + os.replace)",
+                )
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _ORP019_WRITE_METHODS):
+            yield ctx.finding(
+                node, "ORP019",
+                f".{node.func.attr}() in persistence code — the "
+                "in-place write is torn the moment the process dies "
+                "mid-call; write through "
+                "utils/atomic.atomic_write_text/_bytes "
+                "(temp + fsync + os.replace)",
+            )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

@@ -118,13 +118,21 @@ def _model_from_meta(meta: dict) -> HedgeMLP:
     )
 
 
-def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
+def export_bundle(result, directory: str | pathlib.Path, *,
+                  store=None, tenant: str | None = None) -> PolicyBundle:
     """Export a trained ``PipelineResult`` as a policy bundle under
     ``directory`` (created; must not already hold a different bundle).
 
     ``result`` must carry its model (every pipeline sets
     ``PipelineResult.model``) and per-date params. Returns the in-memory
     ``PolicyBundle`` equivalent of what was written.
+
+    With ``store`` (a ``BundleStore`` or its root directory) the finished
+    export is additionally PUBLISHED into the content-addressed catalog
+    under ``tenant`` (default: the bundle directory's name) — the bundle
+    becomes a manifest of CAS pointers other replicas resolve via
+    ``store://<root>#<tenant>`` sources, files shared with already-
+    published tenants deduplicating to existing blobs.
     """
     model = getattr(result, "model", None)
     if model is None:
@@ -186,6 +194,11 @@ def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
 
         shutil.rmtree(policy_dir)
     save_checkpoint(policy_dir, 0, state)
+    if store is not None:
+        from orp_tpu.store.catalog import open_store
+
+        st = store if hasattr(store, "publish") else open_store(store)
+        st.publish(tenant if tenant is not None else d.name, d)
     return PolicyBundle(
         model=model, backward=BackwardResult.from_policy_state(state),
         times=times, adjustment_factor=float(result.adjustment_factor),
@@ -199,7 +212,18 @@ def export_bundle(result, directory: str | pathlib.Path) -> PolicyBundle:
 
 def load_bundle(directory: str | pathlib.Path) -> PolicyBundle:
     """Load and VERIFY a bundle: fingerprint side file against the recorded
-    metadata, restored params against the recorded architecture."""
+    metadata, restored params against the recorded architecture.
+
+    ``directory`` may also be a ``store://<root>#<tenant>[@version]`` URI:
+    the tenant's manifest is resolved from the catalog, its CAS blobs
+    digest-verified and materialized into the store's shared warm
+    directory, and the load proceeds from there — bitwise identical to
+    loading the directory the tenant was published from."""
+    if isinstance(directory, str) and directory.startswith("store://"):
+        from orp_tpu.store.catalog import open_store, parse_store_uri
+
+        root, tenant_name, version = parse_store_uri(directory)
+        return open_store(root).load(tenant_name, version)
     d = pathlib.Path(directory)
     meta_file = d / _META
     if not meta_file.exists():

@@ -138,6 +138,18 @@ class RoutingTable:
         compares across gateway processes."""
         return {t: self.replica_for(t).name for t in tenants}
 
+    def assigned(self, tenants, replica: str) -> list:
+        """The subset of ``tenants`` this table maps to ``replica`` — a
+        replica's predictive-prefetch working set. Because the assignment
+        is pure rendezvous, the replica can compute its OWN set from the
+        shared topology view with no coordination; feed it to
+        ``ServeHost.prefetch`` (see ``orp_tpu.store.tier
+        .prefetch_assigned``) on bring-up and from
+        ``ReplicaHealth.on_change``, so a remap warms the newly-landed
+        tenants before their rerouted first request arrives."""
+        return [t for t in tenants
+                if self.replica_for(t).name == str(replica)]
+
     def version(self) -> str:
         """Fingerprint of the routing view (replica set + healthy set):
         gateways agreeing on the version agree on every mapping."""

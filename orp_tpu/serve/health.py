@@ -170,7 +170,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                   telemetry_dir=None, gateway=None, metrics=None,
-                  quality=None, perf=None, fleet=None,
+                  quality=None, perf=None, fleet=None, store=None,
                   gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
@@ -220,6 +220,13 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     into the hash — the ORP018 failure — or the gateways see different
     replica sets). Per-replica health ages are reported as the maximum
     staleness any gateway observes.
+    ``store``       — probe a content-addressed bundle store
+    (``orp doctor --store ROOT``): the catalog must parse, the CAS blob
+    directory must be writable, and the catalog closure must be free of
+    DANGLING references (a manifest pointing at bytes the CAS no longer
+    holds means tenants that cannot activate — the failing row says which
+    command re-publishes); orphan blobs are reported as reclaimable via
+    ``orp store gc``, never as failures.
     ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
@@ -502,6 +509,43 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
         except Exception as e:  # orp: noqa[ORP009] -- the report IS the emission: the probe failure becomes a failing check row
             _check(checks, "perf_peaks", False, f"{type(e).__name__}: {e}",
                    fix="no jax backend came up — fix JAX_PLATFORMS first")
+    # 11) the bundle store: catalog parseable, CAS writable, closure clean
+    if store is not None:
+        from orp_tpu.store.catalog import open_store
+
+        try:
+            st = open_store(store)
+            stats = st.stats()
+        except (OSError, ValueError, KeyError) as e:
+            _check(checks, "store_catalog", False, f"{store}: {e}",
+                   fix="the catalog does not parse as orp-catalog-v1 — "
+                       "move it aside and re-publish the tenants with "
+                       "`orp store put --root ROOT --bundle DIR "
+                       "--tenants NAME[,…]`")
+        else:
+            _check(checks, "store_catalog", True,
+                   f"{store}: {stats['tenants']} tenant(s), "
+                   f"{stats['manifests']} manifest(s), {stats['blobs']} "
+                   f"blob(s) ({stats['blob_bytes']} bytes), dedup ratio "
+                   f"{stats['dedup_ratio']}")
+            ok, detail = _dir_writable(st.cas.blobs_dir)
+            _check(checks, "store_cas", ok, detail,
+                   fix="the CAS blob directory must be writable for "
+                       "`orp store put` / export publishing to land")
+            # dangling refs FAIL (tenants that cannot activate); orphan
+            # blobs are just bytes awaiting gc — ok, with the reclaim note
+            orphan_note = (
+                f"; {stats['orphan_blobs']} orphan blob(s) "
+                f"({stats['orphan_bytes']} bytes) reclaimable via "
+                "`orp store gc`" if stats["orphan_blobs"] else "")
+            _check(checks, "store_refs", stats["dangling_refs"] == 0,
+                   (f"catalog closure clean{orphan_note}"
+                    if stats["dangling_refs"] == 0 else
+                    f"{stats['dangling_refs']} DANGLING blob reference(s) "
+                    "— the catalog points at bytes the CAS no longer "
+                    "holds; those tenants cannot activate"),
+                   fix="re-publish the affected tenants with `orp store "
+                       "put` (the missing blobs re-land content-addressed)")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}
 
 

@@ -61,11 +61,13 @@ from orp_tpu.guard import inject as _inject
 from orp_tpu.guard.serve import GuardPolicy, Rejection
 from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import flight
+from orp_tpu.obs import observe as obs_observe
 from orp_tpu.obs import state as obs_state
 from orp_tpu.obs.registry import Registry
 from orp_tpu.serve.batcher import MicroBatcher, SlimFuture
 from orp_tpu.serve.engine import HedgeEngine
 from orp_tpu.serve.metrics import LATENCY_HISTOGRAM, ServingMetrics
+from orp_tpu.store.tier import TierManager
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,11 +120,13 @@ class _Tenant:
     __slots__ = ("name", "source", "policy", "max_pending", "slo",
                  "engine", "batcher", "metrics", "pending", "activations",
                  "last_used", "build_lock", "in_submit", "version",
-                 "drift", "drift_band")
+                 "drift", "drift_band", "warm")
 
     def __init__(self, name, source, policy, max_pending, slo, drift_band):
         self.name = name
         self.source = source          # bundle dir (str/Path) or policy object
+        self.warm = None              # warm tier: the DESERIALIZED policy,
+        # retained across evictions (tier.py bounds how many tenants keep it)
         self.policy = policy
         self.max_pending = max_pending
         self.slo = slo
@@ -164,11 +168,17 @@ class ServeHost:
                  registry: Registry | None = None,
                  engine_kwargs: dict | None = None,
                  batcher_kwargs: dict | None = None,
-                 promotion_chain=None):
+                 promotion_chain=None,
+                 tiers: TierManager | None = None):
         if max_live_engines < 1:
             raise ValueError(
                 f"max_live_engines={max_live_engines} must be >= 1")
         self.max_live_engines = int(max_live_engines)
+        # hot/warm/cold tier bookkeeping (orp_tpu/store/tier.py): eviction
+        # demotes hot->warm (the deserialized policy is retained for a
+        # zero-compile rebuild) instead of dropping everything; pass a
+        # configured TierManager to bound warm retention differently
+        self.tiers = tiers if tiers is not None else TierManager()
         # the promotions manifest chain (obs/manifest.py) reload_tenant
         # appends its verdicts to; None = resolve per reload from the active
         # telemetry session's export dir (still None -> no chain, verdicts
@@ -219,6 +229,48 @@ class ServeHost:
             self._tenants[name] = _Tenant(name, source, policy, max_pending,
                                           slo, drift_band)
 
+    def prefetch(self, names) -> list:
+        """Predictively warm tenants WITHOUT building engines: each cold
+        path/store source is resolved into its deserialized policy and
+        retained on the warm tier, so the tenant's first request pays an
+        engine build (a warm activation), not a cold directory load.
+        Already-live and already-warm tenants are skipped; unknown names
+        are ignored (the routing table may know tenants this host was
+        never given). The fleet's routing-assignment hook
+        (``orp_tpu.store.tier.prefetch_assigned``) drives this; it is also
+        directly callable with an expected working set. Returns the names
+        actually warmed."""
+        warmed = []
+        for name in names:
+            with self._lock:
+                if self._closed:
+                    break
+                t = self._tenants.get(name)
+                if t is None or t.batcher is not None or t.warm is not None:
+                    continue
+            with t.build_lock:
+                with self._lock:
+                    if t.batcher is not None or t.warm is not None:
+                        continue
+                source = t.source
+                if (isinstance(source, (str, bytes))
+                        or hasattr(source, "__fspath__")):
+                    from orp_tpu.serve.bundle import load_bundle
+
+                    source = load_bundle(source)
+                with self._lock:
+                    if t.batcher is not None:
+                        continue  # an activation won the race; already hot
+                    t.warm = source
+                for cold_name in self.tiers.note_warm(name):
+                    with self._lock:
+                        other = self._tenants.get(cold_name)
+                        if other is not None and other.engine is None:
+                            other.warm = None
+                obs_count("store/prefetch", tenant=name)
+            warmed.append(name)
+        return warmed
+
     def _activate(self, name: str):
         """Touch ``name`` in the LRU, building its engine/batcher if cold.
         Returns ``(tenant, batcher, evicted_batchers)``. Called WITHOUT the
@@ -245,12 +297,24 @@ class ServeHost:
             with self._lock:
                 batcher = t.batcher
             if batcher is None:
-                source = t.source
-                if (isinstance(source, (str, bytes))
-                        or hasattr(source, "__fspath__")):
-                    from orp_tpu.serve.bundle import load_bundle
+                t_build = time.perf_counter()
+                # tier ladder: a retained deserialized policy (warm) skips
+                # the directory load entirely — the engine rebuild hits the
+                # process-wide jit executable cache / the bundle's AOT
+                # blobs, so a warm re-activation costs zero XLA compiles.
+                # An in-memory source (PolicyBundle passed to add_tenant)
+                # is warm by construction; only a path source without a
+                # retained policy pays the cold load.
+                source = t.warm
+                tier = "warm"
+                if source is None:
+                    source = t.source
+                    if (isinstance(source, (str, bytes))
+                            or hasattr(source, "__fspath__")):
+                        from orp_tpu.serve.bundle import load_bundle
 
-                    source = load_bundle(source)
+                        tier = "cold"
+                        source = load_bundle(source)
                 engine = HedgeEngine(source, **self.engine_kwargs)
                 metrics = ServingMetrics(registry=self.registry,
                                          labels={"tenant": t.name})
@@ -269,9 +333,13 @@ class ServeHost:
                     t.metrics = metrics
                     t.drift = drift
                     t.batcher = batcher
+                    t.warm = source
                     t.activations += 1
                     evicted = self._sweep_locked(t)
-                obs_count("serve/tenant_activate", tenant=t.name)
+                self.tiers.note_hot(t.name)
+                obs_count("serve/tenant_activate", tenant=t.name, tier=tier)
+                obs_observe("serve/activation_seconds",
+                            time.perf_counter() - t_build, tier=tier)
         return t, batcher, evicted
 
     def _build_drift(self, t: _Tenant, policy):
@@ -324,7 +392,20 @@ class ServeHost:
         # t.metrics stays: the façade interns shared-registry series, so a
         # reactivation accumulates into the same instruments and stats()
         # keeps reporting what an evicted tenant served
-        obs_count("serve/tenant_evict", tenant=t.name)
+        # hot -> WARM, not cold: t.warm keeps the deserialized policy so
+        # re-activation is an engine rebuild (zero XLA compiles), not a
+        # directory re-read. Past the tier manager's warm cap the
+        # longest-idle warm tenants genuinely go cold — their retained
+        # policies are released here
+        if t.warm is not None:
+            for cold_name in self.tiers.note_warm(t.name):
+                other = self._tenants.get(cold_name)
+                if other is not None and other.engine is None:
+                    other.warm = None
+        else:
+            self.tiers.note_cold(t.name)
+        obs_count("serve/tenant_evict", tenant=t.name,
+                  tier=self.tiers.tier_of(t.name))
         return batcher
 
     # -- request path --------------------------------------------------------
@@ -743,6 +824,9 @@ class ServeHost:
                     t.batcher = batcher
                     t.engine = engine
                     t.source = new_source
+                    t.warm = policy  # the retained warm policy must track
+                    # the swap — a later warm re-activation serves the NEW
+                    # bundle's bits, never a stale pre-swap policy
                     if new_drift is not None:
                         t.drift = new_drift
                     t.version += 1
@@ -853,6 +937,7 @@ class ServeHost:
             return {
                 t.name: {
                     "live": t.engine is not None,
+                    "tier": self.tiers.tier_of(t.name),
                     "pending": t.pending,
                     "activations": t.activations,
                     "max_pending": t.max_pending,

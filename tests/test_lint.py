@@ -27,7 +27,7 @@ def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
                              "ORP014", "ORP015", "ORP016", "ORP017",
-                             "ORP018"})
+                             "ORP018", "ORP019"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1362,6 +1362,80 @@ def test_orp018_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/serve/fleet.py") == []
+
+
+# -- ORP019: bare writes in store/bundle persistence code ---------------------
+
+ORP019_POS = """
+    import json
+    import pathlib
+
+    def flush_catalog(path, doc):
+        with open(path, "w") as f:                      # torn on crash
+            json.dump(doc, f)
+
+    def write_blob(path, data):
+        pathlib.Path(path).write_bytes(data)            # in-place write
+
+    def stamp(path, text):
+        pathlib.Path(path).write_text(text)             # in-place write
+
+    def append_log(path, line):
+        with open(path, mode="a") as f:                 # append is a write
+            f.write(line)
+"""
+
+ORP019_NEG = """
+    import json
+
+    from orp_tpu.utils.atomic import atomic_write_bytes, atomic_write_text
+
+    def flush_catalog(path, doc):
+        atomic_write_text(path, json.dumps(doc))
+
+    def write_blob(path, data):
+        atomic_write_bytes(path, data)
+
+    def read_blob(path):
+        with open(path, "rb") as f:                     # reads are free
+            return f.read()
+
+    def read_default_mode(path):
+        with open(path) as f:                           # default "r"
+            return f.read()
+"""
+
+
+def test_orp019_flags_bare_persistence_writes():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP019_POS),
+                                       path="orp_tpu/store/catalog.py")]
+    assert got == ["ORP019", "ORP019", "ORP019", "ORP019"]
+
+
+def test_orp019_clean_negative():
+    assert lint_source(textwrap.dedent(ORP019_NEG),
+                       path="orp_tpu/store/cas.py") == []
+
+
+def test_orp019_scoped_to_persistence_surfaces():
+    # the same source outside store/ + serve/bundle.py is out of scope:
+    # only the artifacts OTHER processes read concurrently need the
+    # atomic-replace discipline
+    assert lint_source(textwrap.dedent(ORP019_POS),
+                       path="orp_tpu/serve/bench.py") == []
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP019_POS),
+                                       path="orp_tpu/serve/bundle.py")]
+    assert got == ["ORP019"] * 4
+
+
+def test_orp019_noqa_suppresses():
+    src = """
+        def scratch_note(path, text):
+            with open(path, "w") as f:  # orp: noqa[ORP019] -- scratch file no reader races on
+                f.write(text)
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/store/cas.py") == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -144,9 +144,17 @@ def test_profile_overhead_phase_shape():
     out = _profile_overhead(100.0, block=1024)
     assert out["gate_pct"] == PROFILE_OVERHEAD_GATE_PCT == 5.0
     assert out["profile_bill_us_per_dispatch"] > 0
-    # the bill amortizes over a 1024-row block: even on a loaded CI box a
-    # few µs per dispatch is well under the gate against a ~100ns/row lane
-    assert out["overhead_pct"] < PROFILE_OVERHEAD_GATE_PCT
+    # pin the ESTIMATOR, not the box: overhead_pct is the per-row bill
+    # over the caller's disabled-lane denominator (here 100ns/row). The
+    # absolute bill is Python speed — measured 2-9µs/dispatch across
+    # boxes — and the bench gates against the MEASURED lane, so a fixed
+    # 5%-of-100ns bound on the raw bill is a coin flip on a slow box.
+    bill_ns_per_row = out["profile_bill_us_per_dispatch"] * 1e3 / out["block"]
+    assert out["overhead_pct"] == pytest.approx(bill_ns_per_row, rel=0.05)
+    # against a denominator 100x the measured bill the gate clears with
+    # room to spare — the committed record's regime (bill ≪ lane)
+    roomy = _profile_overhead(bill_ns_per_row * 100.0, block=1024)
+    assert roomy["overhead_pct"] < PROFILE_OVERHEAD_GATE_PCT
 
 
 # -- the orp-perf-v1 ledger ----------------------------------------------------
@@ -281,8 +289,11 @@ def test_perf_gate_same_code_green_and_injected_delay_trips(trained,
                                                             tmp_path):
     """THE gate acceptance pin: repeated runs of the same code never trip
     (no self-regression from noise), and an engine synthetically slowed
-    through the existing guard fault site (serve/execute delay, 20ms,
-    under the 50ms budget) trips a REAL regression."""
+    through the existing guard fault site (serve/execute delay) trips a
+    REAL regression. The injected delay is sized off the MEASURED noise
+    floor of the green history, never a fixed number: on a loaded
+    container the green runs can carry wall noise that swallows a delay
+    sized for a quiet box (a flaky non-trip)."""
     from orp_tpu import guard
 
     led = tmp_path / "led.jsonl"
@@ -294,7 +305,18 @@ def test_perf_gate_same_code_green_and_injected_delay_trips(trained,
     records, _ = perf.read_ledger(led)
     assert len(records) == 3  # every gate run appended its measurement
 
-    plan = guard.FaultPlan(delay={"serve/execute": (10_000, 0.02)})
+    # four times the trip threshold the gate will actually apply to THIS
+    # history (k*scale and the relative floor both) is decisively outside
+    # any band the green runs can justify; max-min of the medians over-
+    # estimates their IQR, which only widens the margin further
+    meds = sorted(r["median"] for r in records)
+    iqrs = sorted(r["iqr"] for r in records)
+    scale = max(iqrs[len(iqrs) // 2], meds[-1] - meds[0])
+    need_s = 4.0 * max(perf.GATE_K * scale,
+                       perf.GATE_REL_FLOOR * meds[len(meds) // 2])
+    delay_s = max(0.02, need_s / 6)  # each sample times 6 evaluate calls
+
+    plan = guard.FaultPlan(delay={"serve/execute": (10_000, delay_s)})
     with guard.faults(plan):
         slow = perf.gate_cli(ledger=led, bundle=trained, repeats=5,
                              evals=6, rows=32)

@@ -377,6 +377,33 @@ def test_serve_host_multi_tenant_routing_and_lru(tmp_path, trained):
         host.submit("mem", 0, feats)
 
 
+def test_serve_host_eviction_demotes_to_warm_and_reactivates_compile_free(
+        tmp_path, trained):
+    """The warm tier as the eviction target: a bundle-backed tenant the
+    LRU sweep evicts keeps its DESERIALIZED policy (hot → warm, pinned
+    via stats), and its re-activation rebuilds the engine from that
+    retained policy with ZERO XLA compiles — no disk load, no compile,
+    just engine construction against the process-wide jit cache."""
+    feats = np.ones((3, 1), np.float32)
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    with ServeHost(max_live_engines=1) as host:
+        host.add_tenant("disk", str(bdir))
+        host.add_tenant("mem", trained)
+        host.evaluate("disk", 0, feats)  # cold: loads the bundle from disk
+        host.evaluate("mem", 0, feats)  # evicts disk — hot -> warm
+        st = host.stats()
+        assert st["disk"]["tier"] == "warm" and not st["disk"]["live"]
+        assert st["mem"]["tier"] == "hot" and st["mem"]["live"]
+        ref_phi, ref_psi, _ = HedgeEngine(trained).evaluate(0, feats)
+        phi, psi, _ = host.evaluate("disk", 0, feats)  # warm re-activation
+        np.testing.assert_array_equal(phi, ref_phi)
+        np.testing.assert_array_equal(psi, ref_psi)
+        # THE warm-tier pin: the rebuild hit the existing executables
+        assert host._tenants["disk"].engine.cache_info()["xla_compiles"] == 0
+        assert host.stats()["disk"]["tier"] == "hot"
+
+
 def test_serve_host_slo_burn_rate(trained):
     """SLO burn rates read straight off the registry latency histograms: a
     generous objective reports ~0 burn, an impossible one reports every

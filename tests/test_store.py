@@ -1,0 +1,319 @@
+"""Bundle-plane oracles (orp_tpu/store): the CAS refuses tampered bytes
+and never garbage-collects a catalog-referenced blob, concurrent puts of
+the same content are idempotent (one blob, one digest), publishing N
+same-policy tenants stores the tree ONCE (dedup ratio > 1), and a tenant
+served cold → warm → hot returns bits identical to a direct
+``load_bundle`` — plus the ``orp store`` / ``orp doctor --store`` /
+``serve-bench --density --quick`` CLI smokes that keep the whole plane
+tier-1-gated."""
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+import pytest
+
+from orp_tpu.api import (
+    EuropeanConfig,
+    SimConfig,
+    TrainConfig,
+    european_hedge,
+)
+from orp_tpu.serve import ServeHost, export_bundle, load_bundle
+from orp_tpu.store import (
+    COLD,
+    HOT,
+    WARM,
+    CasIntegrityError,
+    CasStore,
+    TierManager,
+    blob_digest,
+    open_store,
+    parse_store_uri,
+    prefetch_assigned,
+)
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=256, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=4, epochs_warm=2)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+@pytest.fixture(scope="module")
+def bundle_dir(trained, tmp_path_factory):
+    d = tmp_path_factory.mktemp("bundle") / "b"
+    export_bundle(trained, d)
+    return d
+
+
+def _params_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a.backward.params1_by_date)
+    lb = jax.tree_util.tree_leaves_with_path(b.backward.params1_by_date)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    for (path, x), (_, y) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=str(path))
+
+
+# -- CAS ----------------------------------------------------------------------
+
+
+def test_cas_put_get_roundtrip_idempotent(tmp_path):
+    cas = CasStore(tmp_path / "store")
+    data = b"the policy bytes"
+    digest = cas.put(data)
+    assert digest == blob_digest(data)
+    assert cas.put(data) == digest  # idempotent: same content, same name
+    assert cas.has(digest)
+    assert cas.get(digest) == data
+    assert cas.size_of(digest) == len(data)
+    assert cas.stats() == {"blobs": 1, "bytes": len(data)}
+
+
+def test_cas_refuses_tampered_blob(tmp_path):
+    """Digest verification on READ: a blob whose bytes no longer hash to
+    its name (bit rot, tampering) is refused, never returned."""
+    cas = CasStore(tmp_path / "store")
+    digest = cas.put(b"original bytes")
+    blob = cas._blob_path(digest)
+    blob.chmod(0o644)
+    blob.write_bytes(b"tampered bytes!")  # same length, different content
+    with pytest.raises(CasIntegrityError, match="does not hash"):
+        cas.get(digest)
+    # a MISSING blob is a dangling reference, flag-speak included
+    with pytest.raises(KeyError, match="orp store put"):
+        cas.get("0" * 64)
+
+
+def test_cas_concurrent_put_idempotent(tmp_path):
+    """16 threads racing the same content land exactly ONE blob (atomic
+    temp + os.replace — no torn blob, no duplicate)."""
+    cas = CasStore(tmp_path / "store")
+    data = b"x" * 4096
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        digests = list(pool.map(lambda _: cas.put(data), range(16)))
+    assert set(digests) == {blob_digest(data)}
+    assert cas.stats() == {"blobs": 1, "bytes": len(data)}
+    assert cas.get(digests[0]) == data
+
+
+def test_cas_gc_never_collects_referenced(tmp_path):
+    cas = CasStore(tmp_path / "store")
+    kept = cas.put(b"referenced")
+    doomed = cas.put(b"orphan")
+    dry = cas.gc({kept}, dry_run=True)
+    assert dry["dry_run"] and dry["removed"] == 1 and cas.has(doomed)
+    out = cas.gc({kept})
+    assert out["removed"] == 1 and out["kept"] == 1
+    assert cas.has(kept) and not cas.has(doomed)
+    assert cas.get(kept) == b"referenced"
+
+
+# -- catalog: publish / dedup / resolve ---------------------------------------
+
+
+def test_publish_many_dedups_to_one_tree(tmp_path, bundle_dir):
+    """The whole-book shape: N near-identical tenants referencing one
+    trained policy share every file blob — the dedup ratio the density
+    bench commits is measured here at unit scale."""
+    store = open_store(tmp_path / "store")
+    out = store.publish_many(["alpha", "beta", "gamma"], bundle_dir)
+    assert set(out) == {"alpha", "beta", "gamma"}
+    assert len({ent["tree"] for ent in out.values()}) == 1  # shared tree
+    # manifests differ (the tenant name is part of the document) but the
+    # file tree is stored once: ref_bytes counts it three times
+    stats = store.stats()
+    assert stats["tenants"] == 3
+    assert stats["dedup_ratio"] > 1.0
+    assert stats["dangling_refs"] == 0 and stats["orphan_blobs"] == 0
+    # republish unchanged: version stays (same manifest digest)
+    again = store.publish("alpha", bundle_dir)
+    assert again["version"] == out["alpha"]["version"]
+
+
+def test_store_uri_parse_and_load_bitwise(tmp_path, bundle_dir, trained):
+    store_root = tmp_path / "store"
+    store = open_store(store_root)
+    store.publish("alpha", bundle_dir)
+    root, tenant, version = parse_store_uri(f"store://{store_root}#alpha")
+    assert (root, tenant, version) == (str(store_root), "alpha", None)
+    assert parse_store_uri(f"store://{store_root}#alpha@2")[2] == 2
+    # load_bundle resolves store:// URIs; bits identical to the direct load
+    via_store = load_bundle(f"store://{store_root}#alpha")
+    direct = load_bundle(bundle_dir)
+    _params_equal(via_store, direct)
+    assert via_store.fingerprint == direct.fingerprint
+
+
+def test_export_bundle_publishes_into_store(tmp_path, trained):
+    store = open_store(tmp_path / "store")
+    export_bundle(trained, tmp_path / "b2", store=store, tenant="pub")
+    assert "pub" in store.tenants()
+    assert load_bundle(f"store://{tmp_path / 'store'}#pub").n_dates == 4
+
+
+def test_catalog_gc_keeps_every_referenced_blob(tmp_path, bundle_dir):
+    store = open_store(tmp_path / "store")
+    store.publish("alpha", bundle_dir)
+    orphan = store.cas.put(b"unreferenced scratch")
+    out = store.gc()
+    assert out["removed"] == 1 and not store.cas.has(orphan)
+    # everything the catalog references survived — the tenant still loads
+    assert load_bundle(f"store://{tmp_path / 'store'}#alpha").n_dates == 4
+    assert store.stats()["dangling_refs"] == 0
+
+
+# -- tiered activation through ServeHost --------------------------------------
+
+
+def test_cold_warm_hot_round_trip_bitwise(tmp_path, bundle_dir, trained):
+    """The activation ladder end to end: cold (catalog resolve +
+    materialize + load), warm (retained policy, engine rebuild), hot
+    (live engine) — every tier's served bits equal a direct load_bundle
+    evaluation, and the warm rebuild pays ZERO XLA compiles."""
+    store_root = tmp_path / "store"
+    open_store(store_root).publish_many(["a", "b"], bundle_dir)
+    direct = load_bundle(bundle_dir)
+    from orp_tpu.serve import HedgeEngine
+
+    rng = np.random.default_rng(7)
+    feats = (1.0 + 0.1 * rng.standard_normal(
+        (8, direct.model.n_features))).astype(np.float32)
+    want_phi, want_psi, _ = HedgeEngine(direct).evaluate(1, feats)
+
+    def assert_bits_equal(served):
+        phi, psi, _ = served
+        np.testing.assert_array_equal(np.asarray(phi), np.asarray(want_phi))
+        np.testing.assert_array_equal(np.asarray(psi), np.asarray(want_psi))
+
+    with ServeHost(max_live_engines=1,
+                   tiers=TierManager(max_warm=4)) as host:
+        host.add_tenant("a", f"store://{store_root}#a")
+        host.add_tenant("b", f"store://{store_root}#b")
+        assert_bits_equal(host.evaluate("a", 1, feats))  # cold
+        host.evaluate("b", 1, feats)  # evicts a (hot -> warm)
+        st = host.stats()
+        assert st["a"]["tier"] == WARM and not st["a"]["live"]
+        assert st["b"]["tier"] == HOT and st["b"]["live"]
+        assert_bits_equal(host.evaluate("a", 1, feats))  # warm
+        # the warm re-activation rebuilt the engine from the RETAINED
+        # policy: the module-level jit cache already holds the
+        # executables, so the rebuild compiles NOTHING
+        assert host._tenants["a"].engine.cache_info()["xla_compiles"] == 0
+        assert_bits_equal(host.evaluate("a", 1, feats))  # hot
+        assert host.stats()["a"]["activations"] == 2  # hot didn't activate
+
+
+def test_prefetch_assigned_warms_only_this_replicas_tenants(
+        tmp_path, bundle_dir):
+    """Predictive warm-prefetch off the routing table: a replica warms
+    exactly the tenants rendezvous assigns to IT, so a remap's rerouted
+    first request lands on a warm policy instead of a cold load."""
+    from orp_tpu.serve.fleet import ReplicaSpec, RoutingTable
+
+    store_root = tmp_path / "store"
+    names = [f"t{i}" for i in range(6)]
+    open_store(store_root).publish_many(names, bundle_dir)
+    table = RoutingTable([ReplicaSpec("r1", "127.0.0.1", 1),
+                          ReplicaSpec("r2", "127.0.0.1", 2)])
+    mine = table.assigned(names, "r1")
+    assert (sorted(mine + table.assigned(names, "r2")) == sorted(names)
+            and mine)  # a partition, and r1 owns some of it
+    with ServeHost(max_live_engines=2) as host:
+        for n in names:
+            host.add_tenant(n, f"store://{store_root}#{n}")
+        warmed = prefetch_assigned(host, table, names, "r1")
+        assert sorted(warmed) == sorted(mine)
+        st = host.stats()
+        for n in names:
+            assert st[n]["tier"] == (WARM if n in mine else COLD)
+            assert not st[n]["live"]  # prefetch warms, never activates
+
+
+# -- doctor / CLI -------------------------------------------------------------
+
+
+def test_doctor_store_probe(tmp_path, bundle_dir):
+    from orp_tpu.serve.health import doctor_report
+
+    store_root = tmp_path / "store"
+    store = open_store(store_root)
+    store.publish("alpha", bundle_dir)
+    rep = doctor_report(store=str(store_root))
+    by = {c["check"]: c for c in rep["checks"]}
+    assert rep["ok"]
+    assert by["store_catalog"]["ok"] and "dedup ratio" in (
+        by["store_catalog"]["detail"])
+    assert by["store_cas"]["ok"] and by["store_refs"]["ok"]
+    # orphan blobs: still ok, with the reclaim note
+    store.cas.put(b"orphan bytes")
+    rep = doctor_report(store=str(store_root))
+    by = {c["check"]: c for c in rep["checks"]}
+    assert by["store_refs"]["ok"] and "orp store gc" in (
+        by["store_refs"]["detail"])
+    # a DANGLING reference fails, fix in flag-speak: delete a referenced
+    # blob behind the catalog's back
+    ref = sorted(store.referenced())[0]
+    blob = store.cas._blob_path(ref)
+    blob.chmod(0o644)
+    blob.unlink()
+    rep = doctor_report(store=str(store_root))
+    by = {c["check"]: c for c in rep["checks"]}
+    assert not rep["ok"] and not by["store_refs"]["ok"]
+    assert "orp store put" in by["store_refs"]["fix"]
+
+
+def test_cli_store_put_stat_gc(tmp_path, bundle_dir, capsys):
+    from orp_tpu import cli
+
+    root = str(tmp_path / "store")
+    cli.main(["store", "put", "--root", root, "--bundle", str(bundle_dir),
+              "--tenants", "alpha,beta", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out["published"]) == {"alpha", "beta"}
+    assert out["stats"]["dedup_ratio"] > 1.0
+    cli.main(["store", "stat", "--root", root, "--json"])
+    st = json.loads(capsys.readouterr().out.strip())
+    assert set(st["tenants"]) == {"alpha", "beta"}
+    assert st["dangling_refs"] == 0
+    open_store(root).cas.put(b"scratch orphan")
+    cli.main(["store", "gc", "--root", root, "--dry-run", "--json"])
+    dry = json.loads(capsys.readouterr().out.strip())
+    assert dry["dry_run"] and dry["removed"] == 1
+    cli.main(["store", "gc", "--root", root, "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["removed"] == 1 and not out["dry_run"]
+    # put without --bundle/--tenants: flag-speak refusal
+    with pytest.raises(SystemExit, match="--tenants"):
+        cli.main(["store", "put", "--root", root])
+
+
+def test_cli_serve_bench_density_quick_smoke(tmp_path, capsys, trained):
+    """The CI satellite: `serve-bench --density --quick` runs the tenant-
+    density phase at two-tenant scale and both gates are enforced — the
+    dedup ratio on two same-policy tenants must exceed 1 (the CAS shares,
+    never copies) and the warm re-activation pays zero XLA compiles."""
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    cli.main([
+        "serve-bench", "--bundle", str(bdir), "--requests", "8",
+        "--batcher-requests", "8", "--sweep-concurrency", "",
+        "--density", "--quick", "--out", "",
+    ])
+    rec = json.loads(capsys.readouterr().out.strip())
+    dn = rec["density"]
+    assert dn["tenants"] == 2 and dn["max_live_engines"] == 1
+    assert dn["dedup_ratio"] > 1.0
+    assert dn["warm_xla_compiles"] == 0
+    assert dn["activation_ms"]["cold"]["count"] == 2
+    assert dn["activation_ms"]["warm"]["count"] >= 1
+    assert dn["levels"][-1]["tenants"] == 2
+    assert rec["density_tenants"] == 2
+    assert rec["density_dedup_ratio"] == dn["dedup_ratio"]
