@@ -1526,7 +1526,9 @@ def cmd_lint(args):
     usage errors exit 2."""
     from orp_tpu.lint.engine import run_cli
 
-    rc = run_cli(args.paths, args.select, args.json)
+    rc = run_cli(args.paths, args.select, args.json, fmt=args.fmt,
+                 concurrency=args.concurrency, changed=args.changed,
+                 list_rules=args.list_rules, markdown=args.markdown)
     if rc:
         raise SystemExit(rc)
 
@@ -2289,16 +2291,14 @@ def build_parser():
              "numeric acceptance gates, stop-clocks read before the "
              "block on jitted work, bare writes in store/bundle "
              "persistence code — rules "
-             "ORP001-ORP019); non-zero "
+             "ORP001-ORP019 — plus the project-wide --concurrency pass: "
+             "guarded-by drift, blocking work under a lock, lock-order "
+             "cycles — rules ORP020-ORP022); non-zero "
              "exit on findings",
     )
-    pl.add_argument("paths", nargs="*", default=None,
-                    help="files or directories (default: the orp_tpu "
-                         "package, resolved from any cwd)")
-    pl.add_argument("--select", default=None, metavar="ORP00X[,ORP00Y]",
-                    help="run only these rules")
-    pl.add_argument("--json", action="store_true",
-                    help="machine-readable findings document")
+    from orp_tpu.lint.__main__ import add_lint_arguments
+
+    add_lint_arguments(pl)
     pl.set_defaults(fn=cmd_lint)
 
     pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")

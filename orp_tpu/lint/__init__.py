@@ -1,27 +1,42 @@
-"""orp_tpu.lint — JAX/TPU-aware static analyzer + runtime compile auditor.
+"""orp_tpu.lint — JAX/TPU-aware static analyzer + runtime auditors.
 
-Static side (``orp lint [--json] [paths]``, ``python -m orp_tpu.lint``):
-an AST rules engine (orp_tpu/lint/engine.py) with ten rules targeting
-this codebase's real hazards (orp_tpu/lint/rules.py, ORP001-ORP010) and
-per-line ``# orp: noqa[RULE] -- reason`` suppressions. The package lints
-itself clean in CI (tests/test_lint_self.py); ``tools/lint_all.py`` is the
-commit gate.
+Static side (``orp lint [--json|--format sarif] [paths]``, ``python -m
+orp_tpu.lint``): an AST rules engine (orp_tpu/lint/engine.py) with
+per-file rules targeting this codebase's real hazards
+(orp_tpu/lint/rules.py, ORP001-ORP019) plus a PROJECT-WIDE lock-discipline
+pass (orp_tpu/lint/concurrency.py, ORP020-ORP022: guarded-by drift,
+blocking work under a lock, lock-order cycles across the
+serve/store/obs/guard planes) and per-line ``# orp: noqa[RULE] -- reason``
+suppressions. The package lints itself clean in CI
+(tests/test_lint_self.py); ``tools/lint_all.py`` is the commit gate;
+``orp lint --changed`` scopes the per-file pass to the git diff for the
+inner edit loop; ``orp lint --list --markdown`` generates the README rule
+table (pinned by a drift test).
 
-Runtime side (orp_tpu/lint/trace_audit.py): ``CompileAudit`` counts XLA
-compiles per jitted callable and enforces budgets — the serve engine's
-one-compile-per-bucket and the backward walk's constant-compile-count
-invariants run as tier-1 regression tests.
+Runtime side: ``CompileAudit`` (orp_tpu/lint/trace_audit.py) counts XLA
+compiles per jitted callable and enforces budgets; ``LockAudit``
+(orp_tpu/lint/lock_audit.py) wraps named locks to record per-thread
+acquisition order and hold times, failing tests on lock-order inversions
+and hold-budget breaches — the dynamic counterpart of ORP020-ORP022.
 """
 
 from orp_tpu.lint.engine import (
     Finding,
     RULES,
+    all_rule_summaries,
     format_findings,
     format_json,
+    format_rule_list,
+    format_sarif,
     lint_paths,
     lint_source,
 )
-from orp_tpu.lint import rules as _rules  # noqa: F401  (registers ORP001-010)
+from orp_tpu.lint import rules as _rules  # noqa: F401  (registers ORP001-019)
+from orp_tpu.lint.concurrency import (
+    CONCURRENCY_RULES,
+    analyze_paths,
+    analyze_sources,
+)
 from orp_tpu.lint.trace_audit import (
     CompileAudit,
     CompileBudgetExceeded,
@@ -29,15 +44,33 @@ from orp_tpu.lint.trace_audit import (
     watch_backward_walk,
     watch_serve_engine,
 )
+from orp_tpu.lint.lock_audit import (
+    HoldBudgetExceeded,
+    LockAudit,
+    LockAuditError,
+    LockOrderInversion,
+    audit_host,
+)
 
 __all__ = [
+    "CONCURRENCY_RULES",
     "CompileAudit",
     "CompileBudgetExceeded",
     "Finding",
+    "HoldBudgetExceeded",
+    "LockAudit",
+    "LockAuditError",
+    "LockOrderInversion",
     "RULES",
+    "all_rule_summaries",
+    "analyze_paths",
+    "analyze_sources",
+    "audit_host",
     "compile_count",
     "format_findings",
     "format_json",
+    "format_rule_list",
+    "format_sarif",
     "lint_paths",
     "lint_source",
     "watch_backward_walk",

@@ -1,4 +1,5 @@
-"""``python -m orp_tpu.lint [--json] [--select RULES] [paths...]``."""
+"""``python -m orp_tpu.lint [--json|--format F] [--select RULES]
+[--concurrency] [--changed [BASE]] [--list [--markdown]] [paths...]``."""
 
 import argparse
 import sys
@@ -7,19 +8,49 @@ from orp_tpu.lint import RULES
 from orp_tpu.lint.engine import run_cli
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(
-        prog="python -m orp_tpu.lint",
-        description="JAX/TPU-aware static analyzer (rules ORP001-ORP007)",
-    )
+def add_lint_arguments(p: argparse.ArgumentParser) -> None:
+    """The lint CLI surface, shared verbatim by ``orp lint`` and
+    ``python -m orp_tpu.lint`` (one definition, two entry points)."""
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories (default: the orp_tpu package)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable findings document")
+                   help="machine-readable findings document "
+                        "(same as --format json)")
+    p.add_argument("--format", dest="fmt", default=None,
+                   choices=("human", "json", "sarif"),
+                   help="output format; sarif emits a SARIF 2.1.0 document "
+                        "for CI code annotations")
     p.add_argument("--select", default=None, metavar="ORP00X[,ORP00Y]",
-                   help=f"run only these rules (known: {', '.join(sorted(RULES))})")
+                   help="run only these rules (ORP020-ORP022 route to the "
+                        "project-wide concurrency pass)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="also run the project-wide lock-discipline pass "
+                        "(ORP020 guarded-by drift, ORP021 blocking under a "
+                        "lock, ORP022 lock-order cycles) over the "
+                        "serve/store/obs/guard planes")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="report only findings in files touched vs BASE "
+                        "(default HEAD): the inner-edit-loop scope; the "
+                        "concurrency pass still indexes project-wide")
+    p.add_argument("--list", dest="list_rules", action="store_true",
+                   help="list every rule and exit")
+    p.add_argument("--markdown", action="store_true",
+                   help="with --list: render the README rule table")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m orp_tpu.lint",
+        description="JAX/TPU-aware static analyzer "
+                    f"({', '.join(sorted(RULES))} + concurrency rules "
+                    "ORP020-ORP022)",
+    )
+    add_lint_arguments(p)
     args = p.parse_args(argv)
-    return run_cli(args.paths, args.select, args.json)
+    return run_cli(args.paths, args.select, args.json, fmt=args.fmt,
+                   concurrency=args.concurrency, changed=args.changed,
+                   list_rules=args.list_rules, markdown=args.markdown)
 
 
 if __name__ == "__main__":

@@ -404,22 +404,117 @@ def format_findings(findings: list[Finding]) -> str:
 DEFAULT_LINT_ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-def run_cli(paths, select: str | None, as_json: bool) -> int:
+def all_rule_summaries() -> dict[str, str]:
+    """Every rule the lint surface knows: the per-file registry plus the
+    project-wide concurrency rules (which cannot run per-file and so live
+    in their own registry). Imported lazily — concurrency.py imports this
+    module at its top, so the reverse edge must stay call-time."""
+    from orp_tpu.lint.concurrency import CONCURRENCY_RULES
+
+    out = {code: r.summary for code, r in RULES.items()}
+    out.update(CONCURRENCY_RULES)
+    return dict(sorted(out.items()))
+
+
+RULE_TABLE_BEGIN = ("<!-- BEGIN ORP RULE TABLE "
+                    "(generated: orp lint --list --markdown) -->")
+RULE_TABLE_END = "<!-- END ORP RULE TABLE -->"
+
+
+def format_rule_list(markdown: bool = False) -> str:
+    """``orp lint --list``: one line per rule; ``--markdown`` renders the
+    README table VERBATIM (tests/test_lint.py pins README against this
+    output, so the table can never drift from the registry again)."""
+    rules = all_rule_summaries()
+    if not markdown:
+        return "\n".join(f"{code}  {summary}" for code, summary in
+                         rules.items())
+    lines = ["| Rule | Checks for |", "| --- | --- |"]
+    lines += [f"| `{code}` | {summary} |" for code, summary in rules.items()]
+    return "\n".join(lines)
+
+
+def changed_files(base: str = "HEAD") -> set[pathlib.Path]:
+    """The repo's .py files touched vs ``base`` (committed diff + working
+    tree + untracked), resolved absolute — the ``--changed`` scope that
+    keeps the project-wide pass out of the inner edit loop."""
+    import subprocess
+
+    def git(*args: str) -> str:
+        r = subprocess.run(["git", *args], capture_output=True, text=True)
+        if r.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args[:2])} failed: "
+                f"{r.stderr.strip() or 'not a git checkout?'}")
+        return r.stdout
+
+    root = pathlib.Path(git("rev-parse", "--show-toplevel").strip())
+    names = git("diff", "--name-only", "-z", base, "--").split("\0")
+    names += git("ls-files", "-o", "--exclude-standard", "-z").split("\0")
+    return {
+        (root / n).resolve() for n in names
+        if n.endswith(".py") and (root / n).exists()
+    }
+
+
+def run_cli(paths, select: str | None, as_json: bool = False, *,
+            fmt: str | None = None, concurrency: bool = False,
+            changed: str | None = None, list_rules: bool = False,
+            markdown: bool = False) -> int:
     """The ONE lint CLI contract, shared by ``orp lint`` and ``python -m
     orp_tpu.lint``: prints findings, returns 1 on findings, 2 on usage
     errors (unknown rule / bad path — distinct so CI can tell a typo from
-    a finding), 0 on clean."""
+    a finding), 0 on clean.
+
+    ``concurrency`` adds the project-wide ORP020-ORP022 pass; selecting an
+    ORP02x code routes there automatically. ``changed`` limits reported
+    findings to files touched vs that git ref (the concurrency pass still
+    INDEXES project-wide — a changed file can break another file's lock
+    discipline). ``fmt`` is human/json/sarif (``as_json`` is the
+    pre-SARIF spelling of json)."""
     import sys
 
+    if list_rules:
+        print(format_rule_list(markdown=markdown))
+        return 0
+    fmt = fmt or ("json" if as_json else "human")
+    if fmt not in ("human", "json", "sarif"):
+        print(f"error: unknown format {fmt!r} (human, json, sarif)",
+              file=sys.stderr)
+        return 2
+    from orp_tpu.lint.concurrency import CONCURRENCY_RULES, analyze_paths
+
+    roots = paths or [DEFAULT_LINT_ROOT]
+    sel = select.split(",") if select else None
+    file_sel = conc_sel = None
+    if sel is not None:
+        conc_sel = [c for c in sel if c in CONCURRENCY_RULES]
+        file_sel = [c for c in sel if c not in CONCURRENCY_RULES]
+        concurrency = concurrency or bool(conc_sel)
     try:
-        findings = lint_paths(
-            paths or [DEFAULT_LINT_ROOT],
-            select=select.split(",") if select else None,
-        )
+        scope = changed_files(changed) if changed is not None else None
+        findings: list[Finding] = []
+        if sel is None or file_sel:
+            for f in iter_python_files(roots):
+                if scope is not None and f.resolve() not in scope:
+                    continue
+                findings.extend(lint_source(f.read_text(), path=str(f),
+                                            select=file_sel))
+        if concurrency:
+            conc = analyze_paths(roots, select=conc_sel or None)
+            if scope is not None:
+                conc = [f for f in conc
+                        if pathlib.Path(f.path).resolve() in scope]
+            findings.extend(conc)
     except (FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    print(format_json(findings) if as_json else format_findings(findings))
+    if fmt == "json":
+        print(format_json(findings))
+    elif fmt == "sarif":
+        print(format_sarif(findings))
+    else:
+        print(format_findings(findings))
     return 1 if findings else 0
 
 
@@ -431,5 +526,38 @@ def format_json(findings: list[Finding]) -> str:
         "version": JSON_SCHEMA_VERSION,
         "findings": [f.as_dict() for f in findings],
         "counts": dict(sorted(counts.items())),
-        "rules": {code: r.summary for code, r in sorted(RULES.items())},
+        "rules": all_rule_summaries(),
+    })
+
+
+def format_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 — the interchange shape CI annotators ingest. Columns
+    are 1-based in SARIF; ``Finding.col`` is the AST's 0-based offset."""
+    return json.dumps({
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "orp-lint",
+                "rules": [
+                    {"id": code, "shortDescription": {"text": summary}}
+                    for code, summary in all_rule_summaries().items()
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "warning",
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": f.line,
+                                       "startColumn": f.col + 1},
+                        }
+                    }],
+                }
+                for f in findings
+            ],
+        }],
     })

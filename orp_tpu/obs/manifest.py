@@ -184,7 +184,7 @@ def chain_append(path: str | pathlib.Path, record: dict) -> dict:
         # satisfy, the very links verify checks
         stamped = {**record, "schema": CHAIN_SCHEMA, "seq": int(seq),
                    "ts_unix": time.time(), "prev": prev}
-        with open(p, "a") as f:
+        with open(p, "a") as f:  # orp: noqa[ORP021] -- _CHAIN_LOCK exists to serialize tail-read + append; the file I/O IS the critical section
             if not ends_nl:
                 # a torn tail has no newline — never concatenate the new
                 # record onto it (that would corrupt THIS record too)

@@ -1446,12 +1446,12 @@ def _density_phase(policy, *, tenants: int, rows: int, max_live: int,
             for r in range(max(1, int(repeats))):
                 walls = []
                 for i, name in enumerate(sample):
-                    if host._tenants[name].batcher is not None:
+                    if host._tenants[name].batcher is not None:  # orp: noqa[ORP020] -- single-threaded bench harness peeking at tier state between phases; no concurrent mutator exists
                         continue  # currently hot: not a re-activation
                     t1 = time.perf_counter()
                     host.evaluate(name, i % n_dates, feats)
                     walls.append((time.perf_counter() - t1) * 1e3)
-                    info = host._tenants[name].engine.cache_info()
+                    info = host._tenants[name].engine.cache_info()  # orp: noqa[ORP020] -- single-threaded bench harness; the evaluate() above already quiesced this tenant
                     if info["xla_compiles"]:
                         warm_compiles = max(warm_compiles,
                                             int(info["xla_compiles"]))

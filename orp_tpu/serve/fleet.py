@@ -247,9 +247,9 @@ class ReplicaHealth:
         """Passive health: the forwarding path observed this replica dead
         (reconnect budget exhausted). Take it out of the healthy set NOW —
         the active prober re-admits it when it answers again."""
-        if name not in self._fails:
-            return
         with self._lock:
+            if name not in self._fails:
+                return
             self._fails[name] = self.fail_after
         self._obs_count("fleet/replica_suspect", replica=name)
         self._recompute()
@@ -440,14 +440,13 @@ class FleetHost:
                                        window=self.window, retry=self.retry,
                                        timeout_s=self.timeout_s)
         with self._lock:
-            if self._closed:
-                pass  # raced close(): nothing may own this client now
-            else:
+            closed = self._closed
+            if not closed:  # raced close(): nothing may own this client now
                 cur = self._clients.get(spec.name)
                 if cur is None or cur.dead:
                     self._clients[spec.name] = fresh
                     return fresh
-        if self._closed:
+        if closed:
             fresh.close()
             raise FleetError("FleetHost is closed")
         # lost the build race to a concurrent forward: use the winner

@@ -334,7 +334,11 @@ class ServeGateway:
             t.start()
 
     def _serve_conn(self, conn: socket.socket, cid: int) -> None:
-        stats = self._conns[cid]
+        # the accept loop registers cid under _lock before starting this
+        # thread; take the same lock for the lookup so the read is ordered
+        # against concurrent registrations mutating the dict
+        with self._lock:
+            stats = self._conns[cid]
         st = _Conn(conn, stats)
         try:
             while not self._closed.is_set():

@@ -546,6 +546,30 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                     "holds; those tenants cannot activate"),
                    fix="re-publish the affected tenants with `orp store "
                        "put` (the missing blobs re-land content-addressed)")
+    # always-on: the project-wide lock-discipline pass (pure AST over the
+    # installed package — no device, ~100 ms). A finding here means a
+    # deployed build whose serve/store planes carry a known race or
+    # deadlock shape; the fleet drill should not be how it is discovered.
+    try:
+        from orp_tpu.lint.concurrency import analyze_paths, build_analyzer
+        from orp_tpu.lint.engine import DEFAULT_LINT_ROOT
+
+        conc = analyze_paths([DEFAULT_LINT_ROOT])
+        stats = build_analyzer([DEFAULT_LINT_ROOT]).stats()
+        _check(checks, "lint_concurrency", not conc,
+               (f"{stats['classes']} classes / {stats['locks']} locks / "
+                f"{stats['edges']} order edges indexed; "
+                + (f"{len(conc)} unsuppressed finding(s): "
+                   + "; ".join(f.render() for f in conc[:3])
+                   if conc else "no unsuppressed findings")),
+               fix="run `orp lint --concurrency` and fix (or reasoned-"
+                   "noqa) every ORP020/ORP021/ORP022 finding" if conc
+                   else None)
+    except Exception as e:  # orp: noqa[ORP009] -- the report IS the emission: the probe failure becomes a failing check row the CLI prints
+        _check(checks, "lint_concurrency", False,
+               f"{type(e).__name__}: {e}",
+               fix="the concurrency analyzer crashed on this install — "
+                   "run `orp lint --concurrency` for the traceback")
     return {"ok": all(c["ok"] for c in checks), "checks": checks}
 
 

@@ -304,8 +304,11 @@ class ServeHost:
                 # blobs, so a warm re-activation costs zero XLA compiles.
                 # An in-memory source (PolicyBundle passed to add_tenant)
                 # is warm by construction; only a path source without a
-                # retained policy pays the cold load.
-                source = t.warm
+                # retained policy pays the cold load. Snapshot under the
+                # host lock: _unlink clears other tenants' warm refs under
+                # it, and build_lock alone does not exclude that writer.
+                with self._lock:
+                    source = t.warm
                 tier = "warm"
                 if source is None:
                     source = t.source
@@ -793,7 +796,11 @@ class ServeHost:
                     f"{cand_rec['hedge_error']['ci95']:.2g} on the "
                     "pinned validation set)",
                     stage="quality", quality=quality)
-        batcher = MicroBatcher(engine, metrics=t.metrics,
+        # snapshot the live metrics façade under the host lock — _activate
+        # installs it under self._lock, and this builder runs outside it
+        with self._lock:
+            metrics = t.metrics
+        batcher = MicroBatcher(engine, metrics=metrics,
                                policy=t.policy, **self.batcher_kwargs)
         # a promoted candidate's baked sketch is the NEW drift baseline (a
         # retrain's training distribution is the reference its serving
@@ -934,11 +941,18 @@ class ServeHost:
         """Per-tenant serving state: live/pending/activations plus the
         metrics summary of everything served so far."""
         with self._lock:
+            # pending counters are _pending_lock state (the submit path
+            # updates them without the host lock): snapshot them under
+            # their own lock so a mid-increment read cannot tear.
+            # Canonical order: _lock -> _pending_lock (ARCHITECTURE.md).
+            with self._pending_lock:
+                pending = {t.name: t.pending
+                           for t in self._tenants.values()}
             return {
                 t.name: {
                     "live": t.engine is not None,
                     "tier": self.tiers.tier_of(t.name),
-                    "pending": t.pending,
+                    "pending": pending[t.name],
                     "activations": t.activations,
                     "max_pending": t.max_pending,
                     "version": t.version,

@@ -611,11 +611,11 @@ class RingClient:
                     raise GatewayError(  # orp: noqa[ORP016] -- the busy counter above recorded the backpressure before this verdict
                         f"ring full for {self.timeout_s}s — the consumer "
                         "stopped draining; restart the serving process")
-                time.sleep(self._retry.backoff_s(min(attempt, 8)))
+                time.sleep(self._retry.backoff_s(min(attempt, 8)))  # orp: noqa[ORP021] -- the ring is FULL: every sender must wait, and releasing _send_lock between retries would reorder frames
 
     def _read_loop(self) -> None:
         idle = 0
-        while not self._closed:
+        while not self._closed:  # orp: noqa[ORP020] -- monotonic shutdown flag: a stale read costs one extra poll iteration, never a wrong result
             try:
                 frame = self.pair.reply.pop()
             except RingError:

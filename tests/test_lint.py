@@ -7,12 +7,26 @@ flagging its negative) fails here, not in a mystery-slow TPU run later.
 """
 
 import json
+import pathlib
 import textwrap
 
 import pytest
 
-from orp_tpu.lint import RULES, format_findings, format_json, lint_source
-from orp_tpu.lint.engine import JSON_SCHEMA_VERSION
+from orp_tpu.lint import (
+    CONCURRENCY_RULES,
+    RULES,
+    format_findings,
+    format_json,
+    format_rule_list,
+    format_sarif,
+    lint_source,
+)
+from orp_tpu.lint.engine import (
+    JSON_SCHEMA_VERSION,
+    RULE_TABLE_BEGIN,
+    RULE_TABLE_END,
+    all_rule_summaries,
+)
 
 
 def lint(src, **kw):
@@ -1503,7 +1517,9 @@ def test_json_output_schema():
     for f in doc["findings"]:
         assert set(f) == {"path", "line", "col", "rule", "message"}
         assert f["path"] == "fixture.py" and f["line"] >= 1
-    assert set(doc["rules"]) == set(RULES)
+    # the rules map advertises the FULL registry — per-file + concurrency —
+    # so a SARIF/JSON consumer can resolve any ruleId it might ever see
+    assert set(doc["rules"]) == set(RULES) | set(CONCURRENCY_RULES)
     # human renderer: one clickable path:line:col line per finding + summary
     human = format_findings(findings)
     assert human.count("fixture.py:") == len(findings)
@@ -1513,3 +1529,84 @@ def test_json_output_schema():
 def test_clean_run_renders_clean():
     assert format_findings([]) == "orp lint: clean"
     assert json.loads(format_json([]))["findings"] == []
+
+
+# -- SARIF output ------------------------------------------------------------
+
+def test_sarif_output_schema():
+    # Pin the SARIF 2.1.0 shape a code-scanning consumer relies on: a rule
+    # change that renames the driver, drops rule metadata, or breaks the
+    # 1-based column convention fails here, not in the CI upload step.
+    findings = lint(ORP001_POS)
+    assert findings
+    doc = json.loads(format_sarif(findings))
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"] == "https://json.schemastore.org/sarif-2.1.0.json"
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "orp-lint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert rule_ids == set(all_rule_summaries())
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+    assert len(run["results"]) == len(findings)
+    for res, f in zip(run["results"], findings):
+        assert res["ruleId"] == f.rule
+        assert res["level"] == "warning"
+        assert res["message"]["text"] == f.message
+        (loc,) = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"] == f.path
+        assert phys["region"]["startLine"] == f.line
+        # SARIF columns are 1-based; Finding.col is 0-based ast col_offset
+        assert phys["region"]["startColumn"] == f.col + 1
+
+
+def test_sarif_clean_run_has_empty_results():
+    doc = json.loads(format_sarif([]))
+    assert doc["runs"][0]["results"] == []
+
+
+# -- rule-registry listing + README drift ------------------------------------
+
+def test_rule_list_covers_full_registry():
+    plain = format_rule_list()
+    md = format_rule_list(markdown=True)
+    for code, summary in all_rule_summaries().items():
+        assert f"{code}  {summary}" in plain
+        assert f"| `{code}` | {summary} |" in md
+    # markdown form is a well-formed two-column table
+    lines = md.splitlines()
+    assert lines[0] == "| Rule | Checks for |"
+    assert lines[1] == "| --- | --- |"
+    assert len(lines) == 2 + len(all_rule_summaries())
+
+
+def test_readme_rule_table_matches_registry():
+    # The README table is GENERATED (`orp lint --list --markdown`), not
+    # hand-maintained. Adding a rule without regenerating the table — or
+    # editing the table by hand — fails here.
+    readme = pathlib.Path(__file__).resolve().parent.parent / "README.md"
+    text = readme.read_text()
+    assert RULE_TABLE_BEGIN in text and RULE_TABLE_END in text
+    block = text.split(RULE_TABLE_BEGIN, 1)[1].split(RULE_TABLE_END, 1)[0]
+    # the table sits inside a bullet, indented two spaces for list continuation
+    table = "\n".join(
+        line[2:] if line.startswith("  ") else line
+        for line in block.splitlines()
+    ).strip("\n")
+    assert table == format_rule_list(markdown=True)
+
+
+# -- --changed scope ---------------------------------------------------------
+
+def test_changed_files_resolves_against_this_checkout():
+    from orp_tpu.lint.engine import changed_files
+
+    # the diff-scoped set is absolute, .py-only, and existing-files-only
+    got = changed_files("HEAD")
+    assert all(p.is_absolute() and p.suffix == ".py" and p.exists()
+               for p in got)
+    # a bad base is a usage error (exit 2 in run_cli), not a finding
+    with pytest.raises(ValueError, match="git diff .* failed"):
+        changed_files("no-such-ref-xyzzy")

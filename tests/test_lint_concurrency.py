@@ -1,0 +1,568 @@
+"""Lock-discipline tooling oracles: the project-wide static analyzer
+(ORP020 guarded-by drift, ORP021 blocking-under-lock, ORP022 lock-order
+cycles — orp_tpu/lint/concurrency.py) pins one true positive and one
+clean case per rule, including a TWO-MODULE cycle; the runtime
+``LockAudit`` (orp_tpu/lint/lock_audit.py) proves a deliberately-injected
+order inversion and hold-budget breach are reported with the offending
+sites named, and its instrumentation overhead is measured and gated the
+way the obs/perf overhead budgets are; and a threaded warm-tier stress
+test hammers ServeHost activate/evict/prefetch/stats concurrently UNDER
+the audit — the regression test for the ORP020 fixes this analyzer
+surfaced in serve/host.py (``stats()`` reading pending counters without
+the pending lock, ``_activate`` reading ``t.warm`` without the host
+lock)."""
+
+import textwrap
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from orp_tpu.lint import (
+    CompileAudit,
+    CompileBudgetExceeded,
+    CONCURRENCY_RULES,
+    HoldBudgetExceeded,
+    LockAudit,
+    LockOrderInversion,
+    analyze_sources,
+    audit_host,
+)
+
+
+def conc(sources: dict, select=None):
+    """Rule codes per path from an in-memory fixture project."""
+    fs = analyze_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}, select=select)
+    return [(f.path, f.rule) for f in fs], fs
+
+
+# -- ORP020: inconsistently-guarded shared field ------------------------------
+
+ORP020_POS = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def inc(self):
+            with self._lock:
+                self.n += 1
+
+        def dec(self):
+            with self._lock:
+                self.n -= 1
+
+        def reset(self):
+            with self._lock:
+                self.n = 0
+
+        def peek(self):
+            return self.n
+"""
+
+
+def test_orp020_flags_the_bare_site_and_names_the_inferred_lock():
+    codes, fs = conc({"serve/counter.py": ORP020_POS})
+    assert codes == [("serve/counter.py", "ORP020")]
+    [f] = fs
+    # the message carries the inference: which lock, how lopsided
+    assert "Counter.n" in f.message and "Counter._lock" in f.message
+    assert "3/4" in f.message
+
+
+def test_orp020_clean_when_every_site_is_guarded():
+    src = ORP020_POS.replace(
+        "    def peek(self):\n            return self.n",
+        "    def peek(self):\n            with self._lock:\n"
+        "                return self.n")
+    codes, _ = conc({"serve/counter.py": src})
+    assert codes == []
+
+
+def test_orp020_ignores_fields_never_written_after_init():
+    # a config read everywhere bare but written only in __init__ cannot
+    # tear — flagging it would bury the real races in noise
+    src = """
+        import threading
+
+        class Cfg:
+            def __init__(self, k):
+                self._lock = threading.Lock()
+                self.k = k
+
+            def a(self):
+                with self._lock:
+                    return self.k
+
+            def b(self):
+                with self._lock:
+                    return self.k
+
+            def c(self):
+                with self._lock:
+                    return self.k
+
+            def d(self):
+                return self.k
+    """
+    codes, _ = conc({"serve/cfg.py": src})
+    assert codes == []
+
+
+def test_orp020_noqa_with_reason_suppresses():
+    src = ORP020_POS.replace(
+        "return self.n",
+        "return self.n  # orp: noqa[ORP020] -- advisory peek: a stale "
+        "read is acceptable here")
+    codes, _ = conc({"serve/counter.py": src})
+    assert codes == []
+
+
+def test_orp020_credits_private_helpers_with_their_callers_locks():
+    # the _sweep_locked shape: a private helper ONLY ever called under the
+    # lock must not light up, even though its own body takes nothing
+    src = """
+        import threading
+
+        class Host:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.live = {}
+
+            def add(self, k, v):
+                with self._lock:
+                    self.live[k] = v
+                    self._sweep_locked()
+
+            def drop(self, k):
+                with self._lock:
+                    self.live.pop(k, None)
+                    self._sweep_locked()
+
+            def size(self):
+                with self._lock:
+                    return len(self.live)
+
+            def _sweep_locked(self):
+                while len(self.live) > 4:
+                    self.live.pop(next(iter(self.live)))
+    """
+    codes, _ = conc({"serve/host2.py": src})
+    assert codes == []
+
+
+# -- ORP021: blocking work while holding a lock -------------------------------
+
+ORP021_POS = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.last = None
+
+        def poll(self, sock):
+            with self._lock:
+                data = sock.recv(1024)
+                time.sleep(0.1)
+                self.last = data
+"""
+
+
+def test_orp021_flags_socket_and_sleep_under_lock():
+    codes, fs = conc({"serve/poller.py": ORP021_POS})
+    assert codes == [("serve/poller.py", "ORP021")] * 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "recv" in msgs and "time.sleep" in msgs
+    assert "Poller._lock" in msgs
+
+
+def test_orp021_clean_when_blocking_work_moves_outside():
+    src = """
+        import threading
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.last = None
+
+            def poll(self, sock):
+                data = sock.recv(1024)
+                with self._lock:
+                    self.last = data
+    """
+    codes, _ = conc({"serve/poller.py": src})
+    assert codes == []
+
+
+def test_orp021_build_lock_exemption_and_cv_wait_shape():
+    # the two sanctioned holds: a build serializer EXISTS to hold
+    # construction (ORP012 precedent), and cv.wait() RELEASES the cv's own
+    # lock — neither is a stall
+    src = """
+        import threading
+
+        class Builder:
+            def __init__(self):
+                self._build_lock = threading.Lock()
+                self._cv = threading.Condition(self._build_lock)
+                self.engine = None
+
+            def build(self, path):
+                with self._build_lock:
+                    self.engine = open(path).read()
+
+            def await_ready(self):
+                with self._cv:
+                    while self.engine is None:
+                        self._cv.wait()
+    """
+    codes, _ = conc({"serve/builder.py": src})
+    assert codes == []
+
+
+def test_orp021_bare_wait_flags_only_the_other_held_lock():
+    # waiting on cv while ALSO holding an unrelated lock parks every
+    # thread queued on that other lock behind an unbounded wait
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+                self.ready = False
+
+            def block(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait()
+    """
+    codes, fs = conc({"serve/w.py": src})
+    assert codes == [("serve/w.py", "ORP021")]
+    assert "W._lock" in fs[0].message
+
+
+# -- ORP022: lock-order cycles ------------------------------------------------
+
+CYCLE_A = """
+    import threading
+
+    class AHost:
+        def __init__(self, tiers: "BTier"):
+            self._lock = threading.Lock()
+            self.tiers = tiers
+
+        def evict(self):
+            with self._lock:
+                self.tiers.note()
+
+        def refresh(self):
+            with self._lock:
+                return None
+"""
+
+CYCLE_B = """
+    import threading
+
+    class BTier:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.host = None
+
+        def bind(self, host: "AHost"):
+            self.host = host
+
+        def note(self):
+            with self._lock:
+                return None
+
+        def flush(self):
+            with self._lock:
+                self.host.refresh()
+"""
+
+
+def test_orp022_two_module_lock_order_cycle():
+    # serve evicts under its lock into the tier (A -> B); the tier flushes
+    # under ITS lock back into serve (B -> A): the deadlock only a
+    # project-wide pass can see — neither file alone contains it
+    codes, fs = conc({"serve/a.py": CYCLE_A, "store/b.py": CYCLE_B})
+    assert ("ORP022" in {c for _p, c in codes})
+    [f] = [f for f in fs if f.rule == "ORP022"]
+    assert "AHost._lock" in f.message and "BTier._lock" in f.message
+    assert "cycle" in f.message
+
+
+def test_orp022_clean_when_one_direction_drops_the_lock():
+    fixed = CYCLE_B.replace(
+        "    def flush(self):\n            with self._lock:\n"
+        "                self.host.refresh()",
+        "    def flush(self):\n            with self._lock:\n"
+        "                pass\n            self.host.refresh()")
+    codes, _ = conc({"serve/a.py": CYCLE_A, "store/b.py": fixed})
+    assert codes == []
+
+
+def test_orp022_non_reentrant_self_reacquire():
+    # a plain Lock re-acquired through a helper on a path that already
+    # holds it: instant self-deadlock, the length-1 cycle
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.v = 0
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    self.v += 1
+    """
+    codes, fs = conc({"serve/s.py": src}, select=["ORP022"])
+    assert codes == [("serve/s.py", "ORP022")]
+    assert "re-acquired" in fs[0].message and "S._lock" in fs[0].message
+
+
+def test_orp022_reentrant_rlock_self_reacquire_is_clean():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.v = 0
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    self.v += 1
+    """
+    codes, _ = conc({"serve/s.py": src}, select=["ORP022"])
+    assert codes == []
+
+
+def test_concurrency_rule_registry():
+    assert set(CONCURRENCY_RULES) == {"ORP020", "ORP021", "ORP022"}
+    with pytest.raises(ValueError, match="unknown concurrency rule"):
+        analyze_sources({}, select=["ORP099"])
+
+
+# -- LockAudit: runtime order/hold sanitizer ----------------------------------
+
+
+def test_lock_audit_reports_injected_inversion_with_both_sites():
+    audit = LockAudit()
+    a, b = audit.wrap("A"), audit.wrap("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    with pytest.raises(LockOrderInversion) as ei:
+        audit.check()
+    msg = str(ei.value)
+    # both acquisition orders named, each with its file:line site
+    assert "A -> B" in msg and "B -> A" in msg
+    assert msg.count("test_lint_concurrency.py:") == 4
+    assert audit.report()["violations"]
+
+
+def test_lock_audit_reports_hold_budget_breach_with_site():
+    audit = LockAudit(hold_budget_s=0.01)
+    lk = audit.wrap("ServeHost._lock")
+    with lk:
+        time.sleep(0.03)
+    with pytest.raises(HoldBudgetExceeded) as ei:
+        audit.check()
+    msg = str(ei.value)
+    assert "ServeHost._lock" in msg and "budget" in msg
+    assert "test_lint_concurrency.py:" in msg
+
+
+def test_lock_audit_condition_wait_ends_the_hold():
+    # Condition(wrapped) routes wait() through _release_save/_acquire_
+    # restore: the wait is NOT billed as a hold, so a long wait under a
+    # tight budget stays green
+    audit = LockAudit(hold_budget_s=0.05)
+    lk = audit.wrap("cv_lock", threading.RLock())
+    cv = threading.Condition(lk)
+    done = []
+
+    def waiter():
+        with cv:
+            while not done:
+                cv.wait(timeout=1.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.15)  # waiter sits in wait() far past the hold budget
+    with cv:
+        done.append(1)
+        cv.notify_all()
+    t.join()
+    audit.check()
+    assert audit.report()["acquires"]["cv_lock"] >= 2
+
+
+def test_lock_audit_reentrant_acquire_is_one_hold():
+    audit = LockAudit(hold_budget_s=0.04)
+    lk = audit.wrap("r", threading.RLock())
+    with lk:
+        with lk:  # nested: not a second hold, clock keeps running
+            time.sleep(0.02)
+        time.sleep(0.015)
+    audit.check()
+    hold = audit.report()["max_hold_s"]["r"]["hold_s"]
+    assert 0.03 < hold < 0.04  # ONE hold spanning both sleeps
+
+
+def test_lock_audit_overhead_measured_and_gated():
+    # the obs/perf-style overhead budget: the auditor exists to run inside
+    # tier-1 stress tests, so its per-acquire cost is measured HERE and
+    # gated — a regression in the auditor shows up as a failing number,
+    # not as quietly inflated hold-times in every test it wires
+    n = 20_000
+    raw = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with raw:
+            pass
+    raw_s = time.perf_counter() - t0
+    audited = LockAudit().wrap("bench")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with audited:
+            pass
+    audited_s = time.perf_counter() - t0
+    per_op_us = (audited_s - raw_s) / n * 1e6
+    assert per_op_us < 100.0, (
+        f"LockAudit overhead {per_op_us:.2f} us/acquire "
+        f"(raw {raw_s / n * 1e6:.2f} us, audited {audited_s / n * 1e6:.2f} "
+        "us) blew the 100 us budget")
+
+
+def test_compile_audit_reports_injected_extra_compile_by_name():
+    # the CompileAudit twin of the inversion fixture: inject one compile
+    # past a zero budget and the report names the offending callable
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.jit(lambda x: x * 2)
+    g(jnp.ones(3))  # warm the first shape
+    audit = CompileAudit()
+    audit.watch("g", g, budget=0)
+    with pytest.raises(CompileBudgetExceeded, match="g: 1 compiles"):
+        with audit:
+            g(jnp.ones(5))  # fresh shape: the injected extra compile
+    assert audit.deltas() == {"g": 1}
+
+
+# -- warm-tier thread stress under the audit ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained():
+    from orp_tpu.api import (
+        EuropeanConfig,
+        SimConfig,
+        TrainConfig,
+        european_hedge,
+    )
+
+    return european_hedge(
+        EuropeanConfig(),
+        SimConfig(n_paths=256, T=1.0, dt=1 / 8, rebalance_every=2),
+        TrainConfig(dual_mode="mse_only", epochs_first=4, epochs_warm=2),
+    )
+
+
+def test_warm_tier_stress_green_under_lock_audit(trained):
+    """Hammer ServeHost activate/evict/prefetch/stats from threads with
+    every host/tier lock audited: no order inversion (the static ORP022
+    graph's canonical order holds at runtime too), no hold-budget breach
+    (nothing blocks under a serving lock), and the submit/stats paths this
+    PR re-guarded (pending counters, warm refs) survive the churn."""
+    from orp_tpu.serve import ServeHost
+    from orp_tpu.store import TierManager
+
+    rng = np.random.default_rng(7)
+    feats = (1.0 + 0.1 * rng.standard_normal(
+        (8, trained.model.n_features))).astype(np.float32)
+    names = [f"t{i}" for i in range(4)]
+    audit = LockAudit(hold_budget_s=0.5)
+    with ServeHost(max_live_engines=2,
+                   tiers=TierManager(max_warm=2)) as host:
+        for n in names:
+            host.add_tenant(n, trained)
+        audit_host(host, audit)
+        errors = []
+
+        def submitter(k):
+            try:
+                for i in range(8):
+                    # rotate tenants so the 2-engine cap forces
+                    # activate/evict churn on every lap
+                    host.evaluate(names[(k + i) % len(names)], i % 4, feats)
+            except Exception as e:  # orp: noqa[ORP009] -- re-raised via the errors list assertion below
+                errors.append(e)
+
+        def prefetcher():
+            try:
+                for i in range(6):
+                    host.prefetch([names[i % len(names)]])
+            except Exception as e:  # orp: noqa[ORP009] -- re-raised via the errors list assertion below
+                errors.append(e)
+
+        def observer():
+            try:
+                for _ in range(12):
+                    st = host.stats()  # the re-guarded pending-counter read
+                    assert all(v["pending"] >= 0 for v in st.values())
+            except Exception as e:  # orp: noqa[ORP009] -- re-raised via the errors list assertion below
+                errors.append(e)
+
+        with ThreadPoolExecutor(max_workers=5) as pool:
+            for k in range(3):
+                pool.submit(submitter, k)
+            pool.submit(prefetcher)
+            pool.submit(observer)
+        assert errors == []
+    audit.check()  # raises on inversion or hold-budget breach
+    rep = audit.report()
+    assert rep["violations"] == []
+    # the audited run actually exercised the contended locks
+    assert rep["acquires"]["ServeHost._lock"] > 20
+    assert rep["acquires"]["ServeHost._pending_lock"] > 20
+    # the runtime order edges respect the static canonical order: the host
+    # lock is taken INSIDE build locks and OUTSIDE tier/pending locks,
+    # never the other way around
+    edges = {(e["from"], e["to"]) for e in rep["edges"]}
+    for a, b in edges:
+        assert (b, a) not in edges, f"inverted pair {a} <-> {b}"
+    assert not any(a in ("ServeHost._pending_lock", "TierManager._lock")
+                   and b == "ServeHost._lock" for a, b in edges), edges

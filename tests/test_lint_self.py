@@ -17,11 +17,13 @@ import pytest
 from orp_tpu.lint import (
     CompileAudit,
     CompileBudgetExceeded,
+    analyze_paths,
     compile_count,
     format_findings,
     lint_paths,
     watch_serve_engine,
 )
+from orp_tpu.lint.concurrency import build_analyzer
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -40,6 +42,33 @@ def test_repo_scripts_lint_clean():
         REPO / "bench.py", REPO / "tests" / "conftest.py",
     ])
     assert findings == [], "\n" + format_findings(findings)
+
+
+def test_concurrency_pass_runs_clean_on_the_package():
+    """The project-wide lock-discipline pass (ORP020-ORP022) over the
+    serve/store/obs/guard planes: zero unsuppressed findings. Every
+    intentional site carries a reasoned `# orp: noqa[ORP02x]`; every real
+    one was fixed (and is pinned by a thread-stress regression test in
+    tests/test_lint_concurrency.py), not suppressed."""
+    findings = analyze_paths([REPO / "orp_tpu"])
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_lock_order_graph_is_acyclic_and_nontrivial():
+    """The canonical acquisition order documented in ARCHITECTURE.md is the
+    analyzer's lock-order graph. Pin that the index actually sees the lock
+    family (a refactor that renames locks out of recognition would silently
+    turn the pass into a no-op) and that build_lock is the outermost lock."""
+    analyzer = build_analyzer([REPO / "orp_tpu"])
+    stats = analyzer.stats()
+    assert stats["locks"] >= 10 and stats["classes"] >= 30
+    edges = {(e["from"], e["to"]) for e in analyzer.lock_order_edges()}
+    assert ("_Tenant.build_lock", "ServeHost._lock") in edges
+    assert ("ServeHost._lock", "TierManager._lock") in edges
+    # acyclic is implied by the clean self-run (a cycle would be ORP022),
+    # but assert the direction explicitly: nothing re-enters the host lock
+    inner = {"TierManager._lock", "ServeHost._pending_lock"}
+    assert not any(a in inner for a, _ in edges)
 
 
 # -- compile auditor ---------------------------------------------------------

@@ -18,7 +18,12 @@ import sys
 HERE = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(HERE))
 
-from orp_tpu.lint import format_findings, format_json, lint_paths  # noqa: E402
+from orp_tpu.lint import (  # noqa: E402
+    analyze_paths,
+    format_findings,
+    format_json,
+    lint_paths,
+)
 
 # "orp_tpu" is the package DIRECTORY, so every subpackage — orp_tpu/guard
 # included — is gated automatically the moment it exists; no per-subsystem
@@ -32,6 +37,9 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     findings = lint_paths([HERE / g for g in GATED])
+    # the project-wide lock-discipline pass (ORP020-ORP022) rides the same
+    # gate: per-file rules can't see a lock acquired in another module
+    findings += analyze_paths([HERE / g for g in GATED])
     print(format_json(findings) if args.json else format_findings(findings))
     return 1 if findings else 0
 
