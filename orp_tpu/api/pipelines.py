@@ -373,6 +373,7 @@ def european_hedge(
     mesh=None,
     quantile_method: str = "sort",
     export_dir: str | None = None,
+    warm_start=None,
 ) -> PipelineResult:
     """Weekly-rebalanced European option hedge (``European Options.ipynb``).
 
@@ -381,6 +382,10 @@ def european_hedge(
     training with all inputs normalised by S0 (Euro#13). Default grid here is
     364 daily steps -> exactly 52 weekly rebalance dates (the reference's
     [::7] slice of 366 knots silently drops day 365; see module docstring).
+
+    ``warm_start``: optional ``(params1, params2)`` handed to
+    ``backward_induction(initial_params=...)`` — a retrain (``orp_tpu/pilot``)
+    continues from a serving policy's weights instead of the seeded init.
     """
     _check_quantile_method(quantile_method)
     _bind_run_manifest("european_hedge", euro, sim, train,
@@ -413,6 +418,7 @@ def european_hedge(
         _backward_cfg(train),
         mesh=mesh,
         bias_init=bias,
+        initial_params=warm_start,
     )
     times = np.asarray(coarse.times())
     with obs_span("pipeline/report"):
@@ -529,6 +535,7 @@ def heston_hedge(
     mesh=None,
     quantile_method: str = "sort",
     export_dir: str | None = None,
+    warm_start=None,
 ) -> PipelineResult:
     """European hedge under risk-neutral Heston stochastic vol (BASELINE.json
     config 4). The hedge net sees features ``(S_t/S0, v_t)`` — the variance
@@ -558,6 +565,7 @@ def heston_hedge(
         _backward_cfg(train),
         mesh=mesh,
         bias_init=(e_payoff_n, 0.0),
+        initial_params=warm_start,
     )
     times = np.asarray(coarse.times())
     with obs_span("pipeline/report"):

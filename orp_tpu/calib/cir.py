@@ -107,3 +107,55 @@ def estimate_cir_params(sigma_t) -> CIRParams:
     resid = y - X @ coef
     c = float(np.std(resid))
     return CIRParams(a=a, b=b, c=c)  # __post_init__ enforces Feller 2ab >= c^2
+
+
+@dataclasses.dataclass(frozen=True)
+class CalibrationFit:
+    """One complete calibration from a raw price series: the CIR vol
+    parameters plus the drift and current-vol state the hedging pipelines
+    consume (``StochVolConfig(a, b, c, v0)`` / ``EuropeanConfig(sigma=...)``).
+    """
+
+    params: CIRParams
+    mu: float        # annualized drift over the series
+    sigma0: float    # last rolling-window vol — the current vol state
+    n_prices: int
+    vol_window: int
+
+    def as_dict(self) -> dict:
+        return {"a": self.params.a, "b": self.params.b, "c": self.params.c,
+                "mu": self.mu, "sigma0": self.sigma0,
+                "n_prices": self.n_prices, "vol_window": self.vol_window}
+
+
+def calibrate_prices(prices, *, vol_window: int = 40, years: float | None = None,
+                     annualization: float = 252.0) -> CalibrationFit:
+    """The one-call calibration the CLI and the pilot loop drive: prices ->
+    log returns -> rolling vol -> OLS CIR params + drift + current vol.
+
+    ``years`` defaults to ``n_returns / annualization`` (daily prices);
+    pass it explicitly for non-daily sampling. Needs at least
+    ``vol_window + 3`` prices (``vol_window + 2`` returns give the 3 vol
+    observations the OLS requires)."""
+    p = np.asarray(prices, np.float64)
+    if p.ndim != 1:
+        raise ValueError(f"prices must be 1-D, got shape {p.shape}")
+    if p.shape[0] < vol_window + 3:
+        raise ValueError(
+            f"need >= {vol_window + 3} prices for vol_window={vol_window} "
+            f"(got {p.shape[0]}): the rolling vol needs vol_window + 2 "
+            "returns to yield the 3 observations the CIR OLS requires")
+    if (p <= 0).any():
+        raise ValueError("prices must be strictly positive")
+    r = log_returns(p)
+    sigma = rolling_volatility(r, window=vol_window,
+                               annualization=annualization)
+    if years is None:
+        years = r.shape[0] / annualization
+    return CalibrationFit(
+        params=estimate_cir_params(sigma),
+        mu=annualized_drift(p, years),
+        sigma0=float(sigma[-1]),
+        n_prices=int(p.shape[0]),
+        vol_window=int(vol_window),
+    )

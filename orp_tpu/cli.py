@@ -27,7 +27,17 @@ output for scripting. Commands mirror the reference's four entry shapes:
 - ``lookback``  fixed/floating-strike lookback call by exact bridge-extreme
                 sampling vs the Conze-Viswanathan / Goldman-Sosin-Gatto
                 closed forms (no reference analogue)
-- ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.ipynb)
+- ``calibrate`` CIR params from a price CSV (Extra: Stochastic Volatility.
+                ipynb); ``--prices CSV`` runs the pilot's rolling-window
+                form instead — the full ``orp_tpu/pilot`` fit with
+                RQMC-bootstrap confidence bands on every parameter (the
+                band a retrain trigger must leave)
+- ``pilot``     operate the closed-loop model CI/CD plane
+                (``orp_tpu/pilot``): ``retrain`` files a manual retrain
+                request into an ``orp-pilot-v1`` journal (the controller
+                consumes it on its next poll, debounced through the same
+                cooldown as drift/calibration triggers), ``status`` renders
+                the journal — last cycle, state, pending requests
 - ``export``    train a hedge pipeline and export the policy as a serve
                 bundle (``orp_tpu/serve/bundle.py``); the hedge commands'
                 ``--export-dir`` does the same inline after a full run.
@@ -44,7 +54,12 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 drill (frame-level MTTR, ``rows_lost: 0``); ``--density``
                 appends the tenant-density sweep (catalog tenants through
                 one host: per-tier activation histograms, CAS dedup
-                ratio, the tenants-at-p99 curve)
+                ratio, the tenants-at-p99 curve); ``--pilot`` appends the
+                closed-loop model-CI/CD drill (synthetic regime shift →
+                drift trip → recalibrate → warm-start retrain → canary:
+                one sabotaged reject, one zero-downtime promote under
+                concurrent traffic with ``rows_lost: 0``, one mid-training
+                kill resumed from the journal bitwise-identically)
 - ``serve-gateway`` serve a bundle over the ``orp-ingest`` TCP front
                 (``orp_tpu/serve/gateway.py``): length-prefixed columnar
                 frames in, columnar replies out — the non-Python-per-row
@@ -101,7 +116,10 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 validation-set fingerprint present, quality record
                 parseable with a nonzero RQMC CI; ``--store ROOT`` probes
                 a content-addressed bundle store (catalog parseable, CAS
-                writable, no dangling references)
+                writable, no dangling references); ``--pilot JOURNAL``
+                probes a closed-loop pilot (journal parseable +
+                appendable, last cycle's verdict chain-linked, trigger
+                sources reachable)
 - ``store``     operate a content-addressed bundle store
                 (``orp_tpu/store``): ``put`` publishes an exported bundle
                 under catalog tenant names (identical trees dedup to
@@ -109,7 +127,8 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 ``gc`` reclaims unreferenced blobs against the catalog
                 closure
 - ``lint``      JAX/TPU-aware static analysis of the package itself
-                (``orp_tpu/lint``: rules ORP001-ORP019 — recompile hazards,
+                (``orp_tpu/lint``: rules ORP001-ORP019 + ORP023 —
+                recompile hazards,
                 host syncs in jit code, x64 drift, PRNG key reuse, missing
                 donation, traced-value branches, unblocked timing, compile-
                 cache config outside orp_tpu/aot, silent broad excepts,
@@ -121,7 +140,9 @@ output for scripting. Commands mirror the reference's four entry shapes:
                 that never record their measurement, stop-clocks read
                 before the block on jit-dispatched work, bare writes in
                 store/bundle persistence code that must go through
-                utils/atomic); exits non-zero
+                utils/atomic, pilot transitions that skip their obs
+                emission or hold a lock across reload/training calls —
+                ORP023); exits non-zero
                 on findings so it gates commits (tools/lint_all.py)
 
 Hedge commands take ``--mesh N`` (an N-device ``("paths",)`` mesh:
@@ -881,6 +902,8 @@ def cmd_serve_bench(args):
         density_rows=args.density_rows,
         density_max_live=density_max_live,
         density_budget_ms=args.density_budget_ms,
+        pilot=args.pilot,
+        pilot_quick=args.quick,
         repeats=repeats,
         previous=previous,
     )
@@ -1126,6 +1149,7 @@ def cmd_doctor(args):
                         gateway=args.gateway, metrics=args.metrics,
                         quality=args.quality, perf=args.perf,
                         fleet=args.fleet, store=args.store,
+                        pilot=args.pilot,
                         gateway_timeout_s=args.gateway_timeout_s)
     if args.json:
         print(json.dumps(rep))
@@ -1538,7 +1562,45 @@ def cmd_calibrate(args):
         annualized_drift, estimate_cir_params, log_returns, rolling_volatility,
     )
 
-    prices = np.loadtxt(args.csv, delimiter=",", usecols=args.column, skiprows=args.skiprows)
+    src = args.prices if args.prices is not None else args.csv
+    if src is None:
+        raise SystemExit(
+            "error: calibrate needs a price series — pass a CSV "
+            "positionally (legacy point estimate) or via --prices CSV "
+            "(rolling fit with RQMC-bootstrap CI bands)")
+    try:
+        prices = np.loadtxt(src, delimiter=",", usecols=args.column,
+                            skiprows=args.skiprows)
+    except (OSError, ValueError) as e:
+        raise SystemExit(
+            f"error: could not read a price column from {src!r}: {e} — "
+            "expected one float per line (CSV); a header row needs "
+            "--skiprows 1, a multi-column file needs --column N") from None
+    if args.prices is not None:
+        # the pilot form: the full fit + the confidence band a retrain
+        # trigger must leave (pilot/calibrate.py's significance gate)
+        from orp_tpu.pilot import calibrate_window
+
+        try:
+            win = calibrate_window(prices, vol_window=args.window,
+                                   n_boot=args.boot, seed=0)
+        except ValueError as e:
+            raise SystemExit(
+                f"error: {e} — feed a longer --prices series, shrink "
+                "--window, or raise --boot") from None
+        if args.json:
+            print(json.dumps(win.to_meta()))
+            return
+        f = win.fit
+        print(f"CIRParams(a={f.params.a:.6f}, b={f.params.b:.6f}, "
+              f"c={f.params.c:.6f})  mu={f.mu:.5f}  sigma0={f.sigma0:.5f}  "
+              f"(n_prices={f.n_prices}, vol_window={f.vol_window})")
+        print(f"{int(win.level * 100)}% RQMC-bootstrap bands "
+              f"(n_boot={win.n_boot}, failed_resamples={win.n_failed}):")
+        for k in ("a", "b", "c", "mu", "sigma0"):
+            lo, hi = win.ci[k]
+            print(f"  {k:>6}: [{lo:.6f}, {hi:.6f}]")
+        return
     rets = log_returns(prices)
     vol = rolling_volatility(rets, window=args.window)
     try:
@@ -1554,6 +1616,86 @@ def cmd_calibrate(args):
     print(json.dumps(out) if args.json else
           f"CIRParams(a={params.a:.6f}, b={params.b:.6f}, c={params.c:.6f})  "
           f"mu={out['mu']:.5f}  sigma0={out['sigma0']:.5f}")
+
+
+def cmd_pilot(args):
+    """``orp pilot retrain|status`` — file a manual retrain request into an
+    ``orp-pilot-v1`` journal (the controller consumes it on its next poll,
+    debounced through the shared cooldown) or render the journal's state."""
+    import pathlib
+
+    from orp_tpu.pilot import (TERMINAL_STATES, journal_append, last_cycle,
+                               read_journal, unconsumed_requests)
+
+    jp = pathlib.Path(args.journal)
+    if args.action == "retrain":
+        try:
+            rec = journal_append(jp, {
+                "kind": "trigger_request", "source": "manual",
+                "tenant": args.tenant,
+                "reason": args.reason or "manual retrain request"})
+        except (OSError, ValueError) as e:
+            raise SystemExit(
+                f"error: {jp}: {e} — point --journal at the pilot's "
+                "workdir journal (PilotConfig.workdir/pilot.jsonl)"
+            ) from None
+        out = {"filed": True, "journal": str(jp), "seq": rec["seq"],
+               "tenant": args.tenant, "reason": rec["reason"]}
+        print(json.dumps(out) if args.json else
+              f"filed retrain request seq={rec['seq']} for tenant "
+              f"{args.tenant!r} in {jp} — the controller consumes it on "
+              "its next poll")
+        return
+    # status
+    try:
+        records, problems = read_journal(jp)
+    except ValueError as e:
+        raise SystemExit(f"error: {jp}: {e}") from None
+    if not jp.exists():
+        raise SystemExit(
+            f"error: {jp} does not exist — no pilot has journaled here "
+            "yet (a controller seeds it at construction, `orp pilot "
+            "retrain --journal PATH` seeds it with a request)")
+    cid, recs = last_cycle(records)
+    pending = unconsumed_requests(records)
+    out = {"journal": str(jp), "records": len(records),
+           "torn_tail_lines": len(problems),
+           "pending_requests": [
+               {"seq": r.get("seq"), "tenant": r.get("tenant"),
+                "reason": r.get("reason")} for r in pending]}
+    if cid is None:
+        out["last_cycle"] = None
+    else:
+        state = recs[-1].get("state")
+        out["last_cycle"] = {
+            "cycle": cid, "state": state,
+            "terminal": state in TERMINAL_STATES,
+            **({"resumable": True} if state not in TERMINAL_STATES else {}),
+        }
+        for key in ("why", "error", "version", "elapsed_s"):
+            if key in recs[-1]:
+                out["last_cycle"][key] = recs[-1][key]
+    if args.json:
+        print(json.dumps(out))
+        return
+    print(f"{jp}: {len(records)} record(s)"
+          + (f", {len(problems)} torn-tail line(s) tolerated"
+             if problems else ""))
+    lc = out["last_cycle"]
+    if lc is None:
+        print("no cycles journaled yet")
+    else:
+        extra = "".join(f"  {k}={lc[k]}" for k in
+                        ("why", "error", "version", "elapsed_s") if k in lc)
+        print(f"cycle {lc['cycle']}: {lc['state']}"
+              + ("" if lc["terminal"]
+                 else "  (resumable: PilotController.resume())") + extra)
+    if pending:
+        for r in out["pending_requests"]:
+            print(f"pending retrain request seq={r['seq']} "
+                  f"tenant={r['tenant']!r}: {r['reason']}")
+    else:
+        print("no pending retrain requests")
 
 
 def build_parser():
@@ -1955,7 +2097,7 @@ def build_parser():
                           "lanes; promotes submit_ns_per_row / "
                           "ingest_rows_per_s to record fields and fails if "
                           "columnar does not beat the per-request path. "
-                          "Also measures + gates (≤5%) the trace_overhead "
+                          "Also measures + gates (≤5%%) the trace_overhead "
                           "AND drift_overhead per-block bills, and embeds "
                           "the bundle's orp-quality-v1 hedge-error record "
                           "when it bakes a validation set")
@@ -2021,6 +2163,19 @@ def build_parser():
     psb.add_argument("--density-max-live", type=int, default=8,
                      help="live-engine cap of the density host (evictions "
                           "drive the warm tier)")
+    psb.add_argument("--pilot", action="store_true",
+                     help="append the closed-loop model-CI/CD drill "
+                          "(orp_tpu/pilot): a synthetic regime shift trips "
+                          "the drift monitor of a live host; the pilot "
+                          "recalibrates (RQMC-bootstrap bands), warm-start "
+                          "retrains and canary-promotes through the zero-"
+                          "downtime swap — one sabotaged cycle must REJECT "
+                          "with the incumbent bitwise-untouched, one "
+                          "honest cycle must promote under concurrent "
+                          "traffic with rows_lost=0, one mid-training kill "
+                          "must resume from the journal bitwise-"
+                          "identically; the phase raises on any violated "
+                          "contract (--quick shrinks it to smoke size)")
     psb.add_argument("--density-budget-ms", type=float, default=500.0,
                      help="cold-activation p99 budget the tenants-within-"
                           "budget headline is scored against")
@@ -2109,7 +2264,7 @@ def build_parser():
                           "queue/device seconds + the live device-"
                           "utilization gauge on the scrape path — the "
                           "`orp top` dev-util column; measured overhead "
-                          "≤5% of the columnar lane, zero when off")
+                          "≤5%% of the columnar lane, zero when off")
     pgw.add_argument("--metrics-port", type=int, default=None, metavar="P",
                      help="also serve plain-HTTP Prometheus scrape on this "
                           "port (GET /metrics = the live exposition, GET "
@@ -2231,6 +2386,14 @@ def build_parser():
                            "catalog closure free of dangling blob "
                            "references (orphan blobs report as reclaimable "
                            "via `orp store gc`, not as failures)")
+    pdoc.add_argument("--pilot", default=None, metavar="JOURNAL",
+                      help="probe a closed-loop pilot from its orp-pilot-v1 "
+                           "journal: parseable (torn tail tolerated) and "
+                           "appendable, the last cycle's verdict present on "
+                           "its hash-linked promotions chain with every "
+                           "link verifying, and the trigger sources named "
+                           "by the journaled config reachable (events_dir "
+                           "readable, prices_path >= calib_window rows)")
     pdoc.add_argument("--gateway-timeout-s", type=float, default=5.0,
                       help="bound on the gateway probe's connect and every "
                            "recv — a dead-but-accepting endpoint fails "
@@ -2290,8 +2453,10 @@ def build_parser():
              "socket I/O, dynamic obs instrument names, unrecorded "
              "numeric acceptance gates, stop-clocks read before the "
              "block on jitted work, bare writes in store/bundle "
-             "persistence code — rules "
-             "ORP001-ORP019 — plus the project-wide --concurrency pass: "
+             "persistence code, unobserved/lock-holding pilot "
+             "transitions — rules "
+             "ORP001-ORP019 + ORP023 — plus the project-wide "
+             "--concurrency pass: "
              "guarded-by drift, blocking work under a lock, lock-order "
              "cycles — rules ORP020-ORP022); non-zero "
              "exit on findings",
@@ -2301,14 +2466,49 @@ def build_parser():
     add_lint_arguments(pl)
     pl.set_defaults(fn=cmd_lint)
 
-    pc = sub.add_parser("calibrate", help="CIR calibration from a price CSV")
-    pc.add_argument("csv")
+    pc = sub.add_parser(
+        "calibrate",
+        help="CIR calibration from a price CSV; --prices CSV runs the "
+             "pilot's rolling-window form (full fit + RQMC-bootstrap CI "
+             "bands on every parameter — the band a retrain trigger must "
+             "leave)")
+    pc.add_argument("csv", nargs="?", default=None,
+                    help="price CSV (legacy point-estimate form)")
+    pc.add_argument("--prices", default=None, metavar="CSV",
+                    help="price CSV for the pilot form: CIRParams + mu + "
+                         "sigma0 with 95%% RQMC-bootstrap confidence bands "
+                         "(pilot/calibrate.py; --boot resamples)")
     pc.add_argument("--column", type=int, default=0)
     pc.add_argument("--skiprows", type=int, default=0)
-    pc.add_argument("--window", type=int, default=40)
+    pc.add_argument("--window", type=int, default=40,
+                    help="rolling-volatility window (both forms)")
+    pc.add_argument("--boot", type=int, default=64,
+                    help="bootstrap resamples per CI band (--prices form)")
     pc.add_argument("--years", type=float, default=10.0)
     pc.add_argument("--json", action="store_true")
     pc.set_defaults(fn=cmd_calibrate)
+
+    ppl = sub.add_parser(
+        "pilot",
+        help="operate the closed-loop model-CI/CD plane (orp_tpu/pilot): "
+             "retrain files a manual retrain request into an orp-pilot-v1 "
+             "journal (consumed by the controller's next poll, debounced "
+             "through the shared cooldown); status renders the journal — "
+             "last cycle, state, pending requests")
+    ppl.add_argument("action", choices=("retrain", "status"),
+                     help="retrain: file a trigger_request; "
+                          "status: render the journal state")
+    ppl.add_argument("--journal", required=True, metavar="PATH",
+                     help="the pilot journal (PilotConfig.workdir/"
+                          "pilot.jsonl)")
+    ppl.add_argument("--tenant", default=None,
+                     help="tenant the request targets (default: any — the "
+                          "hub matches its own tenant)")
+    ppl.add_argument("--reason", default=None,
+                     help="free-text reason journaled with the request")
+    ppl.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    ppl.set_defaults(fn=cmd_pilot)
     return p
 
 

@@ -20,6 +20,11 @@ package is the layer that keeps it standing when something breaks mid-run:
                   bundle ships that topology's AOT set) and replaying the
                   trapped requests — same bits, smaller mesh, MTTR
                   recorded;
+- ``cooldown``  — the :class:`Cooldown` gate for MINUTES-scale reactive
+                  actions (a pilot retrain, a fleet rebalance): base
+                  cool-down after every fire, reject-escalated backoff, an
+                  injected clock so chaos tests drive the schedule without
+                  sleeping;
 - ``inject``    — the deterministic, seed-driven fault injector the chaos
                   suite (``tests/test_guard.py``) drives: NaN-poisoned fit
                   targets, synthetic process death between checkpointed
@@ -35,6 +40,7 @@ clean path pays one module-global load per hook site, the same discipline
 ``orp_tpu.obs`` proved.
 """
 
+from orp_tpu.guard.cooldown import Cooldown
 from orp_tpu.guard.degrade import DegradeManager
 from orp_tpu.guard.inject import (FaultInjector, FaultPlan,
                                   InjectedDeviceLoss, InjectedFault,
@@ -47,6 +53,7 @@ from orp_tpu.guard.serve import (CircuitBreaker, DeviceLostError, GuardPolicy,
 
 __all__ = [
     "CircuitBreaker",
+    "Cooldown",
     "DegradeManager",
     "DeviceLostError",
     "FaultInjector",

@@ -3,7 +3,8 @@
 Static side (``orp lint [--json|--format sarif] [paths]``, ``python -m
 orp_tpu.lint``): an AST rules engine (orp_tpu/lint/engine.py) with
 per-file rules targeting this codebase's real hazards
-(orp_tpu/lint/rules.py, ORP001-ORP019) plus a PROJECT-WIDE lock-discipline
+(orp_tpu/lint/rules.py, ORP001-ORP019 + ORP023) plus a PROJECT-WIDE
+lock-discipline
 pass (orp_tpu/lint/concurrency.py, ORP020-ORP022: guarded-by drift,
 blocking work under a lock, lock-order cycles across the
 serve/store/obs/guard planes) and per-line ``# orp: noqa[RULE] -- reason``

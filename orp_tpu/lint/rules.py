@@ -138,6 +138,18 @@ ORP019  bare writes in store/bundle persistence code: everything under
         ``atomic_write_bytes``: temp file + fsync + ``os.replace``);
         a site that genuinely wants a bare write (scratch no reader
         races on) says so with a noqa.
+ORP023  pilot transitions that skip telemetry or hold a lock across heavy
+        work: the pilot state machine is the ONE writer that mutates what
+        a tenant serves, so every transition method under ``pilot/``
+        (``_enter_*``, ``*transition*``, ``advance``) must emit an obs
+        event/counter before it can return — a state change nobody can see
+        in telemetry is an invisible deploy — and must never call
+        ``reload_tenant``/``backward_induction``/``*_hedge``/``train_fn``
+        while holding a lock: a retrain takes seconds and ``reload_tenant``
+        takes the host's own locks, so a pilot-side lock held across either
+        head-of-line-blocks (or deadlocks) the serving plane the pilot
+        exists to keep warm. Same swap-under-the-lock, work-outside-it
+        discipline as ORP012, scoped to the control loop that automates it.
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -1479,6 +1491,77 @@ def check_bare_persistence_writes(ctx: FileContext) -> Iterator[Finding]:
                 "utils/atomic.atomic_write_text/_bytes "
                 "(temp + fsync + os.replace)",
             )
+
+
+# -- ORP023 ------------------------------------------------------------------
+
+# the pilot state-machine's transition methods: the explicit names the
+# controller uses (``_enter_calibrating`` .. ``_enter_terminal``) plus the
+# generic spellings a refactor might introduce
+_ORP023_FN_RE = re.compile(r"^_enter_|transition|^advance$")
+# the heavy calls a transition must never make while holding a lock:
+# reload_tenant re-enters the host's own locking, the other three are
+# seconds-scale training/pricing work
+_ORP023_HEAVY = {"reload_tenant", "backward_induction", "train_fn"}
+
+
+@rule("ORP023", "pilot transition without obs emission / heavy work under lock")
+def check_pilot_transition_discipline(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "pilot/" not in path:
+        return
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _ORP023_FN_RE.search(fdef.name):
+            continue
+        emit_lines = [n.lineno for n in walk_scope(fdef)
+                      if isinstance(n, ast.Call) and _orp016_is_emission(n)]
+        first_emit = min(emit_lines, default=None)
+        if first_emit is None:
+            yield ctx.finding(
+                fdef, "ORP023",
+                f"transition {fdef.name!r} never emits to obs — a pilot "
+                "state change nobody can see in telemetry is an invisible "
+                "deploy; emit obs_count('pilot/transition', ...) before "
+                "any other work",
+            )
+        else:
+            for node in walk_scope(fdef):
+                if (isinstance(node, ast.Return)
+                        and node.lineno < first_emit):
+                    yield ctx.finding(
+                        node, "ORP023",
+                        f"transition {fdef.name!r} returns before its obs "
+                        "emission — the early path leaves no telemetry "
+                        "trace of the state change; emit first, branch "
+                        "after",
+                    )
+        for node in walk_scope(fdef):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [name for name in
+                     (_lockish_name(item.context_expr)
+                      for item in node.items) if name]
+            if not locks:
+                continue
+            for sub in _walk_with_body(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted(sub.func)
+                tail = d.split(".")[-1] if d is not None else None
+                if tail is None:
+                    continue
+                if tail in _ORP023_HEAVY or tail.endswith("_hedge"):
+                    yield ctx.finding(
+                        sub, "ORP023",
+                        f"{tail} called while holding {locks[0]} in "
+                        f"{fdef.name!r} — reload_tenant takes the host's "
+                        "own locks and a retrain runs for seconds; either "
+                        "deadlocks or head-of-line-blocks the serving "
+                        "plane; do the work outside, swap state under the "
+                        "lock",
+                    )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

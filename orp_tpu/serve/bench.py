@@ -1511,6 +1511,300 @@ def _density_phase(policy, *, tenants: int, rows: int, max_live: int,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _pilot_market(n, *, a, b, c, mu, sigma0, seed, dt=1 / 252.0):
+    """Synthetic daily prices whose rolling vol follows the CIR the
+    calibrator fits: vol mean-reverts to ``b`` at speed ``a`` with
+    vol-of-vol ``c``, prices diffuse at drift ``mu`` under it — so
+    ``calibrate_window`` recovers the generator up to estimator noise and
+    a regime shift is literally a change of ``b``."""
+    rng = np.random.default_rng(seed)
+    sig = np.empty(n)
+    sig[0] = sigma0
+    for i in range(1, n):
+        sig[i] = abs(sig[i - 1] + a * (b - sig[i - 1]) * dt
+                     + c * np.sqrt(max(sig[i - 1], 1e-8) * dt)
+                     * rng.standard_normal())
+    ret = ((mu - 0.5 * sig[:-1] ** 2) * dt
+           + sig[:-1] * np.sqrt(dt) * rng.standard_normal(n - 1))
+    return 100.0 * np.exp(np.concatenate([np.zeros(1), np.cumsum(ret)]))
+
+
+def _pilot_phase(*, quick: bool, seed: int) -> dict:
+    """The closed-loop pilot drill (CLI ``serve-bench --pilot``): a synthetic
+    market regime shift replayed through a LIVE host and the full
+    ``orp_tpu/pilot`` loop — drift trip → recalibrate → warm-start retrain →
+    canary → promote — exercising all three trigger sources and every
+    terminal verdict:
+
+    - cycle 0 (``drift`` trigger): the retrain is sabotaged (sign-flipped
+      per-date params — finite but wrong) so the quality band REJECTS it;
+      the incumbent must keep serving bitwise-untouched and the cooldown
+      escalates (the next trigger is debounced until the window passes);
+    - cycle 1 (``calibration`` trigger): an honest warm-start retrain under
+      the shifted regime promotes through the zero-downtime swap while a
+      concurrent submitter hammers the tenant — ``rows_lost`` (submitted
+      minus served) is the contract, 0. The content-addressed checkpoint
+      dir makes this retrain a REPLAY of cycle 0's walk (the reject-then-
+      retry economics: identical inputs never retrain twice);
+    - cycle 2 (``manual`` trigger): ``FaultPlan(kill_after_step=1)`` kills
+      the pilot mid-training; a FRESH controller resumes from the journal,
+      finishes the cycle, and the promoted policy is BITWISE an
+      uninterrupted reference run's (the PR 9 resume guarantee carried
+      through the warm-start fingerprint).
+
+    Every verdict lands on the hash-linked promotions chain
+    (``chain_verify`` must stay green) and every transition in the
+    ``orp-pilot-v1`` journal. The drill builds its own tiny incumbent (the
+    benched ``policy``'s topology is arbitrary — a generic drill cannot
+    retrain it), so its numbers are self-contained."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import jax
+
+    from orp_tpu import guard
+    from orp_tpu.api import (EuropeanConfig, SimConfig, TrainConfig,
+                             european_hedge)
+    from orp_tpu.obs import flight
+    from orp_tpu.obs.manifest import chain_verify, read_chain
+    from orp_tpu.pilot import (PilotConfig, PilotController, TriggerHub,
+                               bake_calibration, calibrate_window,
+                               journal_append, read_journal, warm_params)
+    from orp_tpu.pilot.controller import _window_from_meta
+    from orp_tpu.serve.bundle import export_bundle, load_bundle
+    from orp_tpu.serve.host import ServeHost
+
+    n_paths = 256 if quick else 512
+    euro = EuropeanConfig()
+    sim = SimConfig(n_paths=n_paths, T=1.0, dt=1 / 8, rebalance_every=2)
+    first = TrainConfig(dual_mode="mse_only",
+                        epochs_first=12 if quick else 20,
+                        epochs_warm=6 if quick else 10)
+    retrain = TrainConfig(dual_mode="mse_only",
+                          epochs_first=6 if quick else 8,
+                          epochs_warm=3 if quick else 4)
+    calib_window = 160
+    n_boot = 12 if quick else 24
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="orp-pilot-drill-"))
+    try:
+        t_build = time.perf_counter()
+        incumbent = european_hedge(euro, sim, first)
+        inc_dir = workdir / "incumbent"
+        export_bundle(incumbent, inc_dir)
+        # the calm-regime band the shifted fit must leave: baked into the
+        # incumbent exactly as an exporting cycle would bake its own
+        calm = _pilot_market(240, a=4.0, b=0.15, c=0.2, mu=0.08,
+                             sigma0=0.15, seed=seed)
+        calm_win = calibrate_window(calm[-calib_window:], vol_window=40,
+                                    n_boot=n_boot, seed=seed)
+        bake_calibration(inc_dir, calm_win)
+        build_s = time.perf_counter() - t_build
+
+        # the regime shift: long-run vol triples (b 0.15 -> 0.45)
+        shifted = _pilot_market(calib_window + 16, a=4.0, b=0.45, c=0.3,
+                                mu=0.08, sigma0=0.4, seed=seed + 1)
+
+        clk = [0.0]  # injected cooldown clock: the drill never sleeps
+        hub = TriggerHub("desk", cooldown=guard.Cooldown(
+            cooldown_s=60.0, backoff=2.0, clock=lambda: clk[0]))
+        sabotage = [False]
+
+        def train_fn(window, warm, ckpt_dir):
+            res = european_hedge(
+                dataclasses.replace(euro, sigma=float(window.fit.sigma0)),
+                sim,
+                dataclasses.replace(retrain, checkpoint_dir=ckpt_dir),
+                warm_start=warm)
+            if sabotage[0]:
+                # finite-but-wrong: every hedge ratio inverted — exactly
+                # the candidate only the quality band can catch
+                bw = res.backward
+                res = dataclasses.replace(res, backward=dataclasses.replace(
+                    bw, params1_by_date=jax.tree.map(
+                        lambda x: -x, bw.params1_by_date)))
+            return res
+
+        flight.RECORDER.reset()
+        chain_path = workdir / "promotions.jsonl"
+        with ServeHost(promotion_chain=chain_path) as host:
+            host.add_tenant("desk", inc_dir)
+            sketch = load_bundle(inc_dir).feature_sketch
+
+            def traffic(n, shift, seed_):
+                r = np.random.default_rng(seed_)
+                mean = (np.asarray(sketch.mean)
+                        + shift * np.asarray(sketch.std))
+                return (mean + np.asarray(sketch.std)
+                        * r.standard_normal((n, sketch.n_features))
+                        ).astype(np.float32)
+
+            # drifted block-lane traffic trips the serve-side monitor
+            for i in range(4):
+                host.submit_block("desk", 0,
+                                  traffic(256, 5.0, seed + 10 + i)).result()
+            trips = [e for e in flight.RECORDER.snapshot()
+                     if e.get("kind") == "drift_trip"
+                     and e.get("tenant") == "desk"]
+
+            cfg = PilotConfig(tenant="desk", workdir=str(workdir),
+                              quality_band=0.25, vol_window=40,
+                              calib_window=calib_window, n_boot=n_boot,
+                              boot_seed=seed, cooldown_s=60.0)
+            ctl = PilotController(host, cfg, train_fn, hub=hub)
+            v0 = host.stats()["desk"]["version"]
+
+            # -- cycle 0: drift trigger, sabotaged candidate -> REJECT ----
+            evs = ctl.poll(flight_events=flight.RECORDER.snapshot())
+            drift_evs = [e for e in evs if e.source == "drift"]
+            if not drift_evs or not hub.accept(  # orp: noqa[ORP014] -- TriggerHub.accept is the debounce door, not a socket
+                    drift_evs[0]):
+                raise RuntimeError(
+                    "pilot drill: the drift trip never reached the trigger "
+                    "hub — the serve-side monitor or the flight recorder "
+                    "regressed; do not commit this record")
+            sabotage[0] = True
+            out_a = ctl.run_cycle(drift_evs[0], shifted)
+            sabotage[0] = False
+            v_after_reject = host.stats()["desk"]["version"]
+            source_after_reject = str(ctl.host.tenant_source("desk"))
+
+            # -- cycle 1: calibration trigger, honest retrain -> PROMOTE --
+            # the reject escalated the cooldown: the next event is
+            # debounced until the injected clock passes the window
+            evs = ctl.poll(calibration_prices=shifted)
+            cal_evs = [e for e in evs if e.source == "calibration"]
+            debounced = int(bool(cal_evs)
+                            and not hub.accept(cal_evs[0]))  # orp: noqa[ORP014] -- debounce door, not a socket
+            clk[0] += 1000.0
+            evs = ctl.poll(calibration_prices=shifted)
+            cal_evs = [e for e in evs if e.source == "calibration"]
+            if not cal_evs or not hub.accept(  # orp: noqa[ORP014] -- TriggerHub.accept is the debounce door, not a socket
+                    cal_evs[0]):
+                raise RuntimeError(
+                    "pilot drill: the calibration shift never fired after "
+                    "the cooldown reopened — the significance gate or the "
+                    "debounce regressed; do not commit this record")
+            stop = threading.Event()
+            counts = [0, 0]  # rows submitted, rows served
+
+            def pound():
+                # natural backpressure: at most 8 futures in flight, each
+                # consumed before more are submitted
+                futs: list = []
+                while not stop.is_set():
+                    futs.append(host.submit_block(
+                        "desk", 0, traffic(64, 0.0, seed + 50)))
+                    counts[0] += 64
+                    if len(futs) >= 8:
+                        for f in futs:
+                            counts[1] += f.result(timeout=60).n_served
+                        futs = []
+                for f in futs:
+                    counts[1] += f.result(timeout=60).n_served
+
+            th = threading.Thread(target=pound, daemon=True)
+            th.start()
+            try:
+                out_b = ctl.run_cycle(cal_evs[0], shifted)
+            finally:
+                stop.set()
+                th.join(timeout=120)
+
+            # -- cycle 2: manual trigger, kill mid-training, RESUME -------
+            journal_append(ctl.journal_path,
+                           {"kind": "trigger_request", "source": "manual",
+                            "tenant": "desk",
+                            "reason": "pilot drill: manual retrain"})
+            clk[0] += 10000.0
+            evs = ctl.poll()
+            man_evs = [e for e in evs if e.source == "manual"]
+            if not man_evs or not hub.accept(  # orp: noqa[ORP014] -- TriggerHub.accept is the debounce door, not a socket
+                    man_evs[0]):
+                raise RuntimeError(
+                    "pilot drill: the journaled manual request never "
+                    "surfaced as a trigger — unconsumed-request tracking "
+                    "regressed; do not commit this record")
+            killed = False
+            t_c = time.perf_counter()
+            try:
+                with guard.faults(guard.FaultPlan(kill_after_step=1)):
+                    ctl.run_cycle(man_evs[0], shifted)
+            except guard.WalkKilled:
+                killed = True
+            if not killed:
+                raise RuntimeError(
+                    "pilot drill: the injected mid-training kill never "
+                    "fired (checkpoint dir collision? warm start did not "
+                    "change after the promote?); do not commit this record")
+            # the pilot process "restarts": a FRESH controller on the same
+            # journal picks the parked cycle up
+            out_c = PilotController(host, cfg, train_fn, hub=hub).resume()
+            resume_s = time.perf_counter() - t_c
+
+            # bitwise pin: an uninterrupted reference run of the SAME
+            # journaled window + warm start (no checkpoints, no kill) must
+            # reproduce the kill-resumed promoted policy exactly
+            recs, problems = read_journal(ctl.journal_path)
+            train_rec = [r for r in recs
+                         if r.get("kind") == "transition"
+                         and r.get("cycle") == out_c["cycle"]
+                         and r.get("state") == "training"][-1]
+            ref = train_fn(_window_from_meta(train_rec["calibration"]),
+                           warm_params(load_bundle(train_rec["incumbent"])),
+                           None)
+            promoted = load_bundle(out_c["candidate"])
+            bits_equal = all(
+                np.array_equal(x, y) for x, y in zip(
+                    jax.tree.leaves(ref.backward.params1_by_date),
+                    jax.tree.leaves(promoted.backward.params1_by_date)))
+
+        cv = chain_verify(chain_path)
+        verdicts = [r.get("action") for r in read_chain(chain_path)]
+        return {
+            "quick": bool(quick),
+            "n_paths": n_paths,
+            "n_dates": int(promoted.n_dates),
+            "calib_window": calib_window,
+            "n_boot": n_boot,
+            "incumbent_build_s": round(build_s, 3),
+            "drift_trips": len(trips),
+            "debounced": debounced,
+            "trigger_sources": ["drift", "calibration", "manual"],
+            "baseline_b": round(calm_win.fit.params.b, 4),
+            "shifted_b": round(train_rec["calibration"]["fit"]["b"], 4),
+            "cycles": [
+                {"cycle": out_a["cycle"], "trigger": "drift",
+                 "outcome": out_a["outcome"], "why": out_a.get("why"),
+                 "elapsed_s": out_a["elapsed_s"]},
+                {"cycle": out_b["cycle"], "trigger": "calibration",
+                 "outcome": out_b["outcome"],
+                 "elapsed_s": out_b["elapsed_s"],
+                 "checkpoint_reuse": True},
+                {"cycle": out_c["cycle"], "trigger": "manual",
+                 "outcome": out_c["outcome"], "killed_mid_training": True,
+                 "elapsed_s": out_c["elapsed_s"]},
+            ],
+            "reject_left_incumbent": (v_after_reject == v0
+                                      and source_after_reject
+                                      == str(inc_dir)),
+            "time_to_promote_s": out_b["elapsed_s"],
+            "rows_submitted": counts[0],
+            "rows_served": counts[1],
+            "rows_lost": counts[0] - counts[1],
+            "resume": {"outcome": out_c["outcome"],
+                       "wall_s": round(resume_s, 3),
+                       "bits_equal": bool(bits_equal)},
+            "chain": {"ok": cv["ok"], "length": cv["length"],
+                      "verdicts": verdicts},
+            "journal_records": len(recs),
+            "journal_problems": len(problems),
+        }
+    finally:
+        flight.RECORDER.reset()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def serve_bench(
     policy,
     *,
@@ -1548,6 +1842,8 @@ def serve_bench(
     density_rows: int = 8,
     density_max_live: int = 8,
     density_budget_ms: float = 500.0,
+    pilot: bool = False,
+    pilot_quick: bool = False,
     repeats: int = DEFAULT_REPEATS,
     previous: dict | None = None,
 ) -> dict:
@@ -1598,6 +1894,17 @@ def serve_bench(
     warm tier's zero-XLA-compile pin (gated at exactly 0); headline fields
     ``density_tenants`` / ``density_dedup_ratio`` /
     ``density_warm_activation_ms`` ride first-class.
+    ``pilot=True`` (CLI ``--pilot``) appends the closed-loop model-CI/CD
+    drill (:func:`_pilot_phase`): a synthetic regime shift trips the drift
+    monitor of a live host, the ``orp_tpu/pilot`` controller recalibrates,
+    warm-start retrains and canary-promotes through the zero-downtime swap
+    — one sabotaged cycle must REJECT with the incumbent bitwise-untouched,
+    one honest cycle must promote under concurrent traffic with
+    ``rows_lost == 0``, and one mid-training kill must resume from the
+    journal to a bitwise-identical promoted policy; the phase RAISES when
+    any of those contracts is violated. ``pilot_quick`` shrinks the drill
+    to tier-1 smoke size. Headlines ``pilot_time_to_promote_s`` /
+    ``pilot_rows_lost`` ride first-class.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
     synchronous-tier baseline forward as ``batcher_before``."""
     engine = HedgeEngine(policy, mesh=mesh)
@@ -1788,6 +2095,30 @@ def serve_bench(
         if "warm_activation_ms" in dn:
             record["density_warm_activation_ms"] = (
                 dn["warm_activation_ms"]["median_ms"])
+    if pilot:
+        pl = _pilot_phase(quick=pilot_quick, seed=seed)
+        record["pilot"] = pl
+        # the closed-loop headlines, first-class like p99/mttr
+        record["pilot_time_to_promote_s"] = pl["time_to_promote_s"]
+        record["pilot_rows_lost"] = pl["rows_lost"]
+        outcomes = [c["outcome"] for c in pl["cycles"]]
+        if (pl["rows_lost"] or not pl["chain"]["ok"]
+                or "promoted" not in outcomes
+                or "rejected" not in outcomes
+                or not pl["reject_left_incumbent"]
+                or not pl["resume"]["bits_equal"]
+                or pl["drift_trips"] < 1):
+            # measured values recorded through obs BEFORE the verdict
+            # (ORP016): the record dict path below never runs on a raise
+            obs.count("quality/gate_trip", gate="pilot")
+            raise RuntimeError(
+                "pilot drill contract violated: "
+                f"rows_lost={pl['rows_lost']} "
+                f"chain_ok={pl['chain']['ok']} outcomes={outcomes} "
+                f"reject_left_incumbent={pl['reject_left_incumbent']} "
+                f"resume_bits_equal={pl['resume']['bits_equal']} "
+                f"drift_trips={pl['drift_trips']} — the closed loop "
+                "regressed; do not commit this record")
     if ingest:
         ing = _ingest_phase(policy, rows=ingest_rows,
                             block_sizes=ingest_block_sizes, seed=seed,
@@ -2000,6 +2331,22 @@ def ledger_records(record: dict) -> list[dict]:
                 fingerprint_extra=fp_density,
                 extra={"p99_ms": cold["p99_ms"],
                        "dedup_ratio": dn["dedup_ratio"]}))
+    pl = record.get("pilot")
+    if pl:
+        # one promote cycle per record: the history accumulates the
+        # repeats, the fingerprint binds the drill shape (quick and full
+        # drills must never pool into one gate history)
+        out.append(_perf.make_record_from_summary(
+            "serve_bench", "pilot_time_to_promote_s",
+            repeats=1, median=pl["time_to_promote_s"], iqr=0.0,
+            unit="s", direction="lower",
+            fingerprint_extra={**cfg, "calib_window": pl["calib_window"],
+                               "n_boot": pl["n_boot"],
+                               "pilot_n_paths": pl["n_paths"],
+                               "quick": pl["quick"]},
+            extra={"rows_lost": pl["rows_lost"],
+                   "resume_wall_s": pl["resume"]["wall_s"],
+                   "drift_trips": pl["drift_trips"]}))
     drill = record.get("gateway_drill")
     if drill and drill.get("mttr_ms") is not None and drill.get("mttr_runs"):
         out.append(_perf.make_record_from_summary(

@@ -171,7 +171,7 @@ def _dir_writable(d) -> tuple[bool, str]:
 def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                   telemetry_dir=None, gateway=None, metrics=None,
                   quality=None, perf=None, fleet=None, store=None,
-                  gateway_timeout_s: float = 5.0) -> dict:
+                  pilot=None, gateway_timeout_s: float = 5.0) -> dict:
     """One-shot environment/bundle self-check — the first thing to run on a
     broken pod. Returns ``{"ok": bool, "checks": [...]}`` where each check
     row carries ``check``/``ok``/``detail`` and, on failure, a ``fix`` in
@@ -227,6 +227,17 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
     holds means tenants that cannot activate — the failing row says which
     command re-publishes); orphan blobs are reported as reclaimable via
     ``orp store gc``, never as failures.
+    ``pilot``       — probe a closed-loop pilot's plumbing from its
+    ``orp-pilot-v1`` journal (``orp doctor --pilot JOURNAL``): the journal
+    must parse (a torn tail is tolerated, anything else is corruption) and
+    be appendable (``orp pilot retrain`` files requests into it), the last
+    cycle's verdict must be PRESENT on its hash-linked promotions chain
+    with every link verifying (a promoted/rejected cycle that left no
+    chain verdict is an unauditable deploy), and the trigger sources named
+    by the latest journaled config must be reachable — ``events_dir``
+    readable, ``prices_path`` carrying at least ``calib_window`` rows — so
+    a pilot that would silently never fire again is a failing row, not a
+    mystery.
     ``gateway_timeout_s`` bounds every probe's connect AND every recv — a
     dead-but-ACCEPTING endpoint (the listener is up, nothing answers)
     becomes a failing check row within this budget, never an indefinite
@@ -546,6 +557,135 @@ def doctor_report(bundle_dir=None, *, mesh=None, cache_dir=None,
                     "holds; those tenants cannot activate"),
                    fix="re-publish the affected tenants with `orp store "
                        "put` (the missing blobs re-land content-addressed)")
+    # 12) the pilot loop: journal parseable + appendable, the last cycle's
+    # verdict chain-linked, and every configured trigger source reachable
+    if pilot is not None:
+        import pathlib as _pathlib
+
+        from orp_tpu.pilot import journal as _pj
+
+        jp = _pathlib.Path(pilot)
+        records: list[dict] = []
+        try:
+            records, problems = _pj.read_journal(jp)
+            if jp.exists():
+                # appendable probe WITHOUT a side effect (perf-ledger
+                # discipline): open-for-append, never create
+                with open(jp, "a"):
+                    pass
+                app = "appendable"
+            else:
+                ok_dir, dir_detail = _dir_writable(
+                    jp.parent if str(jp.parent) else ".")
+                if not ok_dir:
+                    raise OSError(f"parent not writable ({dir_detail})")
+                app = "absent (the first cycle seeds it); parent writable"
+            _check(checks, "pilot_journal", True,
+                   f"{jp}: {len(records)} record(s), {app}"
+                   + (f", {len(problems)} torn-tail line(s) tolerated"
+                      if problems else ""))
+        except (OSError, ValueError) as e:
+            _check(checks, "pilot_journal", False, f"{jp}: {e}",
+                   fix="the journal was edited or its directory is not "
+                       "writable — move the corrupt file aside; the next "
+                       "cycle (or `orp pilot retrain --journal PATH`) "
+                       "reseeds it")
+        cid, recs = _pj.last_cycle(records)
+        if cid is None:
+            _check(checks, "pilot_cycle", True,
+                   "no cycles journaled yet (the loop has not fired)")
+        else:
+            state = recs[-1].get("state")
+            want = {"promoted": "promote", "rejected": "reject"}.get(state)
+            chain = recs[-1].get("chain")
+            if state not in _pj.TERMINAL_STATES:
+                _check(checks, "pilot_cycle", True,
+                       f"cycle {cid} parked at {state!r} — resumable "
+                       "(PilotController.resume() continues it from the "
+                       "journal)")
+            elif want is None:
+                _check(checks, "pilot_cycle", True,
+                       f"cycle {cid} failed: "
+                       f"{recs[-1].get('error', 'journaled error')} — the "
+                       "next accepted trigger starts a fresh cycle")
+            elif not chain:
+                _check(checks, "pilot_cycle", False,
+                       f"cycle {cid} {state} with NO promotions chain "
+                       "configured — the verdict is unauditable",
+                       fix="construct the ServeHost with "
+                           "promotion_chain=PATH (or run under "
+                           "--telemetry) so every pilot verdict lands "
+                           "hash-linked")
+            else:
+                from orp_tpu.obs.manifest import chain_verify, read_chain
+
+                try:
+                    cv = chain_verify(chain)
+                    actions = [r.get("action") for r in read_chain(chain)]
+                    ok = bool(cv["ok"]) and want in actions
+                    _check(checks, "pilot_cycle", ok,
+                           f"cycle {cid} {state}; chain {chain}: "
+                           f"{cv['length']} verdict(s), "
+                           + ("links verified" if cv["ok"] else
+                              f"BROKEN ({'; '.join(cv['problems'][:2])})")
+                           + ("" if want in actions else
+                              f"; no {want!r} verdict on the chain"),
+                           fix="the chain and the journal disagree about "
+                               "the last cycle — verify with `orp report`/"
+                               "chain_verify, move the edited chain aside, "
+                               "and let the next reload reseed it")
+                except OSError as e:
+                    _check(checks, "pilot_cycle", False,
+                           f"cycle {cid} {state}; chain {chain}: {e}",
+                           fix="the journaled chain path is unreadable — "
+                               "restore it or re-point the host's "
+                               "promotion_chain")
+        conf = _pj.latest_config(records)
+        if conf is None:
+            _check(checks, "pilot_triggers", True,
+                   "no config journaled yet — manual requests "
+                   "(`orp pilot retrain --journal PATH`) are the only "
+                   "reachable source until a controller runs")
+        else:
+            notes: list[str] = []
+            fails: list[str] = []
+            fixes: list[str] = []
+            ed = conf.get("events_dir")
+            if ed:
+                if _pathlib.Path(ed).is_dir():
+                    notes.append(f"events_dir {ed} readable")
+                else:
+                    fails.append(f"events_dir {ed} is not a readable "
+                                 "directory (drift trips unreachable)")
+                    fixes.append("point PilotConfig.events_dir at the "
+                                 "flight-recorder dump dir (RECORDER."
+                                 "arm(DIR))")
+            pp = conf.get("prices_path")
+            if pp:
+                need = conf.get("calib_window") or 0
+                try:
+                    with open(pp) as f:
+                        rows = sum(1 for ln in f if ln.strip())
+                    if rows >= need:
+                        notes.append(f"prices_path {pp}: {rows} row(s) "
+                                     f">= calib_window {need}")
+                    else:
+                        fails.append(f"prices_path {pp}: {rows} row(s) < "
+                                     f"calib_window {need} — calibration "
+                                     "triggers can never fire")
+                        fixes.append("widen the feed or lower "
+                                     "PilotConfig.calib_window")
+                except OSError as e:
+                    fails.append(f"prices_path {pp}: {e}")
+                    fixes.append("restore the market feed file or re-point "
+                                 "PilotConfig.prices_path")
+            if not ed and not pp:
+                notes.append("config names no events_dir/prices_path — "
+                             "drift and calibration polls are fed "
+                             "in-process; manual requests reachable")
+            _check(checks, "pilot_triggers", not fails,
+                   "; ".join(fails + notes) or "nothing configured",
+                   fix="; ".join(fixes) if fixes else None)
     # always-on: the project-wide lock-discipline pass (pure AST over the
     # installed package — no device, ~100 ms). A finding here means a
     # deployed build whose serve/store planes carry a known race or

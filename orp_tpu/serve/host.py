@@ -937,6 +937,17 @@ class ServeHost:
 
     # -- introspection -------------------------------------------------------
 
+    def tenant_source(self, name: str):
+        """The tenant's CURRENT bundle source (directory path or in-memory
+        policy) — what a control plane warm-starts a retrain from
+        (``orp_tpu/pilot``). Tracks promotions: after ``reload_tenant``
+        this is the promoted candidate's source."""
+        with self._lock:
+            if name not in self._tenants:
+                raise KeyError(f"unknown tenant {name!r}; registered: "
+                               f"{sorted(self._tenants)}")
+            return self._tenants[name].source
+
     def stats(self) -> dict:
         """Per-tenant serving state: live/pending/activations plus the
         metrics summary of everything served so far."""

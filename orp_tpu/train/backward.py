@@ -663,6 +663,7 @@ def backward_induction(
     *,
     mesh=None,
     bias_init: tuple[float, ...] | None = None,
+    initial_params=None,
     compile_audit=None,
 ) -> BackwardResult:
     """Run the backward hedge-training walk. All arrays may be device-sharded over
@@ -675,6 +676,16 @@ def backward_induction(
     params replicated — the supported multi-chip training path (SCALING §2).
     On the host-loop path the mesh rides in with the (already path-sharded)
     inputs; passing it here additionally records the topology in telemetry.
+
+    ``initial_params``: optional ``(params1, params2)`` warm start — replaces
+    the seeded ``model.init`` draws, so a retrain continues from a serving
+    policy's fitted weights instead of noise (``orp_tpu/pilot``: fewer warm
+    epochs to converge after a regime shift). ``params2`` may be ``None``
+    (falls back to the seeded init; ignored under ``dual_mode="shared"``).
+    The key stream is untouched — the same ``cfg.seed`` splits are consumed
+    in walk order either way — and the checkpoint fingerprint folds in a
+    digest of the warm params, so a warm-started directory never resumes a
+    cold-started walk (or vice versa, or a different warm source).
 
     ``compile_audit``: optional ``orp_tpu.lint.CompileAudit`` — registers the
     walk's jitted pieces so the caller's audit region can enforce the walk's
@@ -696,7 +707,8 @@ def backward_induction(
         watch_backward_walk(compile_audit, mesh=mesh)
     args = (model, features, y_prices, b_prices, terminal_values, cfg)
     if not obs_enabled():
-        return _walk_impl(*args, mesh=mesh, bias_init=bias_init)
+        return _walk_impl(*args, mesh=mesh, bias_init=bias_init,
+                          initial_params=initial_params)
     from orp_tpu.lint.trace_audit import CompileAudit, watch_backward_walk
 
     # count-only audit (no budgets): telemetry OBSERVES compiles, the
@@ -710,7 +722,8 @@ def backward_induction(
         "dual_mode": cfg.dual_mode,
         "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
     }) as sp, audit:
-        res = _walk_impl(*args, mesh=mesh, bias_init=bias_init)
+        res = _walk_impl(*args, mesh=mesh, bias_init=bias_init,
+                         initial_params=initial_params)
         sp.set_result(res.values)
     for name, delta in audit.deltas().items():
         obs_count("train/xla_compiles", delta, fn=name)
@@ -771,6 +784,7 @@ def _walk_impl(
     *,
     mesh=None,
     bias_init: tuple[float, ...] | None = None,
+    initial_params=None,
 ) -> BackwardResult:
     n_paths, n_knots = y_prices.shape[:2]
     n_dates = n_knots - 1
@@ -780,6 +794,21 @@ def _walk_impl(
     k1, k2, kfit = jax.random.split(key, 3)
     params1 = model.init(k1, bias_init=bias_init)
     params2 = params1 if cfg.dual_mode == "shared" else model.init(k2, bias_init=bias_init)
+    if initial_params is not None:
+        # warm start: inject the caller's params OVER the seeded draws (the
+        # draws still happen so the key stream — and therefore every fit's
+        # ka/kb — is identical to a cold run with the same cfg.seed)
+        w1, w2 = initial_params
+        ref1 = params1
+        params1 = jax.tree.map(
+            lambda ref, w: jnp.asarray(w, ref.dtype).reshape(ref.shape),
+            ref1, w1)
+        if cfg.dual_mode == "shared":
+            params2 = params1
+        elif w2 is not None:
+            params2 = jax.tree.map(
+                lambda ref, w: jnp.asarray(w, ref.dtype).reshape(ref.shape),
+                ref1, w2)
 
     q_loss = L.make_loss(cfg.quantile_loss, q=cfg.quantile)
     mse = L.make_loss("mse")
@@ -858,11 +887,17 @@ def _walk_impl(
         # GN config class defaults (LM damping, IRLS floor etc.) are training
         # policy that lives OUTSIDE BackwardConfig — folding the instance
         # reprs in makes any future default change auto-invalidate old dirs
+        # a warm start changes every fitted column, so its digest is part of
+        # the run identity: a warm-started directory must not resume a
+        # cold-started walk, nor one warm-started from different params
+        warm_tag = ("" if initial_params is None else
+                    " warm=" + ckpt.state_digest(
+                        {"p1": params1, "p2": params2})[:16])
         ckpt.check_fingerprint(
             cfg.checkpoint_dir,
             f"{fp_cfg} n_paths={n_paths} n_dates={n_dates} model={model} "
             f"gn={GNConfig(n_iters=0)} gnq={GNPinballConfig(n_iters=0)} "
-            "ckpt_format=increment-v9",
+            f"ckpt_format=increment-v9{warm_tag}",
         )
         # trust only steps whose integrity digest landed: a save killed
         # between orbax's commit and the digest write costs ONE recomputed

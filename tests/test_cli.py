@@ -88,6 +88,35 @@ def test_calibrate_csv(tmp_path, capsys):
     assert out["sigma0"] > 0
 
 
+def test_calibrate_prices_pilot_bands(tmp_path, capsys):
+    """`orp calibrate --prices CSV`: the pilot-grade form — CIRParams plus
+    RQMC-bootstrap CI bands — round-trips through --json as a
+    CalibrationWindow.to_meta() document, and the text form speaks both."""
+    from orp_tpu.serve.bench import _pilot_market
+
+    prices = _pilot_market(220, a=4.0, b=0.15, c=0.2, mu=0.08,
+                           sigma0=0.15, seed=7)
+    f = tmp_path / "prices.csv"
+    np.savetxt(f, prices, delimiter=",")
+    cli.main(["calibrate", "--prices", str(f), "--window", "40",
+              "--boot", "12", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert set(out) == {"fit", "ci", "n_boot", "n_failed", "start", "level"}
+    assert out["n_boot"] == 12
+    for k in ("a", "b", "c", "mu", "sigma0"):
+        lo, hi = out["ci"][k]
+        assert lo < hi and np.isfinite(lo) and np.isfinite(hi)
+        assert k in out["fit"]
+    # text form: params line + one band row per parameter
+    cli.main(["calibrate", "--prices", str(f), "--window", "40",
+              "--boot", "12"])
+    text = capsys.readouterr().out
+    assert "RQMC-bootstrap" in text and "sigma0" in text
+    # no source at all is flag-speak, not a stack trace
+    with pytest.raises(SystemExit, match="--prices"):
+        cli.main(["calibrate"])
+
+
 def test_greeks_json(capsys):
     cli.main(["greeks", "--paths", "16384", "--steps", "13", "--json"])
     out = json.loads(capsys.readouterr().out.strip())

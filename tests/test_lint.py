@@ -41,7 +41,7 @@ def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
                              "ORP014", "ORP015", "ORP016", "ORP017",
-                             "ORP018", "ORP019"})
+                             "ORP018", "ORP019", "ORP023"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1450,6 +1450,98 @@ def test_orp019_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/store/cas.py") == []
+
+
+# -- ORP023: pilot transition discipline -------------------------------------
+
+ORP023_POS = """
+    import threading
+
+    from orp_tpu.obs import count as obs_count
+
+    class Ctl:
+        _lock = threading.Lock()
+
+        def _enter_canary(self, candidate):
+            with self._lock:
+                # heavy call under the pilot-side lock: re-enters the
+                # host's own locking -> deadlock / head-of-line block
+                return self.host.reload_tenant("desk", candidate)
+
+        def _enter_training(self, window, warm):
+            with self._lock:
+                return self.train_fn(window, warm, None)
+
+        def advance(self, state):
+            if state == "idle":
+                return None                 # early return, no telemetry
+            obs_count("pilot/transition", state=state)
+            return state
+
+        def silent_transition(self, state):
+            return state                    # never emits at all
+"""
+
+ORP023_NEG = """
+    import threading
+
+    from orp_tpu.obs import count as obs_count
+
+    class Ctl:
+        _lock = threading.Lock()
+
+        def _enter_canary(self, candidate):
+            obs_count("pilot/transition", state="canary")
+            # the heavy call runs OUTSIDE the lock; only the pointer
+            # swap happens under it
+            verdict = self.host.reload_tenant("desk", candidate)
+            with self._lock:
+                self.current = candidate
+            return verdict
+
+        def _enter_training(self, window, warm):
+            obs_count("pilot/transition", state="training")
+            return self.train_fn(window, warm, None)
+
+        def advance(self, state):
+            obs_count("pilot/transition", state=state)
+            if state == "idle":
+                return None                 # emission already happened
+            return state
+
+        def run_cycle(self, x):
+            # unmatched name: drivers/helpers are out of scope
+            return x + 1
+"""
+
+
+def test_orp023_flags_transition_violations():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP023_POS),
+                                       path="orp_tpu/pilot/controller.py")]
+    # reload_tenant under lock, train_fn under lock, the missing-emission
+    # pair for each of those methods, the early return, the silent method
+    assert got.count("ORP023") == len(got) and len(got) == 6
+
+
+def test_orp023_clean_negative():
+    assert lint_source(textwrap.dedent(ORP023_NEG),
+                       path="orp_tpu/pilot/controller.py") == []
+
+
+def test_orp023_scoped_to_pilot():
+    # the same source outside pilot/ is out of scope: the rule enforces
+    # the control loop's discipline, not a repo-wide convention
+    assert lint_source(textwrap.dedent(ORP023_POS),
+                       path="orp_tpu/serve/host.py") == []
+
+
+def test_orp023_noqa_suppresses():
+    src = """
+        def bootstrap_transition(self):  # orp: noqa[ORP023] -- process startup; obs registry not built yet
+            return None
+    """
+    assert lint_source(textwrap.dedent(src),
+                       path="orp_tpu/pilot/controller.py") == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -1,0 +1,502 @@
+"""Closed-loop model CI/CD (orp_tpu/pilot/): rolling-window calibration with
+RQMC-bootstrap bands and the significance gate; the append-only orp-pilot-v1
+journal with perf-ledger torn-tail discipline; the debounced trigger hub with
+reject-escalated cooldown; and the controller's chaos bars — a clean promote
+cycle emits ZERO guard events, a NaN-poisoned retrain degrades down the
+trainer ladder without aborting the cycle, a SIGKILL mid-training resumes
+from the journal to a BITWISE-identical promoted policy, and a quality-band
+reject leaves the incumbent untouched while the cooldown escalates. All
+deterministic clocks — no sleeps."""
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import pathlib
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from orp_tpu import guard, obs
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.calib.cir import CalibrationFit, CIRParams
+from orp_tpu.guard import Cooldown, FaultPlan
+from orp_tpu.guard.inject import WalkKilled
+from orp_tpu.obs.manifest import chain_verify, read_chain
+from orp_tpu.pilot import (PilotConfig, PilotController, TriggerEvent,
+                           TriggerHub, bake_calibration, bootstrap_ci,
+                           calibrate_window, journal_append, last_cycle,
+                           read_calibration, read_journal, shift_significant,
+                           unconsumed_requests, warm_params)
+from orp_tpu.pilot import calibrate as _calibrate
+from orp_tpu.pilot import journal as _journal
+from orp_tpu.pilot.controller import _window_from_meta
+from orp_tpu.serve import ServeHost, export_bundle, load_bundle
+from orp_tpu.serve.bench import _pilot_market
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=256, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+FIRST = TrainConfig(dual_mode="mse_only", epochs_first=12, epochs_warm=6)
+RETRAIN = TrainConfig(dual_mode="mse_only", epochs_first=6, epochs_warm=3)
+
+# the synthetic market the drill calibrates: CIR vol mean-reverting to b
+CALM = dict(a=4.0, b=0.15, c=0.2, mu=0.08, sigma0=0.15)
+SHIFT = dict(a=4.0, b=0.45, c=0.3, mu=0.08, sigma0=0.4)
+
+
+@pytest.fixture(scope="module")
+def calm_prices():
+    return _pilot_market(240, seed=7, **CALM)
+
+
+@pytest.fixture(scope="module")
+def shifted_prices():
+    return _pilot_market(176, seed=8, **SHIFT)
+
+
+@pytest.fixture(scope="module")
+def calm_window(calm_prices):
+    return calibrate_window(calm_prices[-160:], vol_window=40, n_boot=12,
+                            seed=0)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, FIRST)
+
+
+@contextlib.contextmanager
+def _rig(trained, calm_window, tmp_path, *, retrain_cfg=None):
+    """One tenant's closed loop on a live host: incumbent exported with the
+    calm calibration baked, a fake-clock trigger hub (no sleeps), and the
+    drill's train_fn with a togglable sabotage flag (sign-flipped params —
+    the finite-but-wrong candidate only the quality band catches)."""
+    inc = tmp_path / "incumbent"
+    export_bundle(trained, inc)
+    bake_calibration(inc, calm_window)
+    cfg = PilotConfig(tenant="desk", workdir=str(tmp_path / "pilot"),
+                      calib_window=160, vol_window=40, n_boot=12,
+                      cooldown_s=60.0, backoff=2.0)
+    clk = [0.0]
+    hub = TriggerHub("desk", cooldown=Cooldown(
+        cooldown_s=60.0, backoff=2.0, clock=lambda: clk[0]))
+    sabotage = [False]
+    rc = RETRAIN if retrain_cfg is None else retrain_cfg
+
+    def train_fn(window, warm, ckpt_dir):
+        res = european_hedge(
+            dataclasses.replace(EURO, sigma=float(window.fit.sigma0)), SIM,
+            dataclasses.replace(rc, checkpoint_dir=ckpt_dir),
+            warm_start=warm)
+        if sabotage[0]:
+            bw = res.backward
+            res = dataclasses.replace(res, backward=dataclasses.replace(
+                bw, params1_by_date=jax.tree.map(
+                    lambda x: -x, bw.params1_by_date)))
+        return res
+
+    with ServeHost(promotion_chain=tmp_path / "promotions.jsonl") as host:
+        host.add_tenant("desk", inc)
+        ctl = PilotController(host, cfg, train_fn, hub=hub)
+        yield host, ctl, inc, clk, sabotage, train_fn
+
+
+def _tree_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _dir_digest(d: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    for p in sorted(d.rglob("*")):
+        if p.is_file():
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+# -- calibration: fit, bands, significance gate -------------------------------
+
+
+def test_calibrate_window_recovers_generator(calm_window):
+    """The rolling-window fit recovers the CIR generator it watched (loose
+    band — 160 prices is a serving-side probe, not an estimator paper) and
+    every parameter carries a finite, ordered bootstrap band."""
+    fit = calm_window.fit
+    assert 0.05 < fit.params.b < 0.30          # generator b = 0.15
+    assert fit.sigma0 > 0 and fit.params.a > 0
+    for k in ("a", "b", "c", "mu", "sigma0"):
+        lo, hi = calm_window.ci[k]
+        assert np.isfinite(lo) and np.isfinite(hi) and lo < hi
+    assert calm_window.n_failed < calm_window.n_boot // 2
+    # to_meta round-trips through the journal rebuild path
+    rebuilt = _window_from_meta(calm_window.to_meta())
+    assert rebuilt.fit.as_dict() == calm_window.fit.as_dict()
+    assert rebuilt.ci == {k: tuple(v) for k, v in
+                          calm_window.to_meta()["ci"].items()}
+
+
+def test_bootstrap_collapse_raises(monkeypatch, calm_prices):
+    """A window where most resamples fail to calibrate must refuse to hand
+    back a band rather than pretend to a confidence it lacks."""
+    monkeypatch.setattr(
+        _calibrate, "calibrate_prices",
+        lambda *a, **k: (_ for _ in ()).throw(ValueError("no reversion")))
+    with pytest.raises(ValueError, match="bootstrap collapsed"):
+        bootstrap_ci(calm_prices, vol_window=40, n_boot=8, seed=0)
+
+
+def test_shift_significance_gate():
+    """The churn gate: a point estimate INSIDE the baked band is noise (no
+    retrain), outside it is signal."""
+    fit = CalibrationFit(params=CIRParams(a=4.0, b=0.33, c=0.2), mu=0.08,
+                         sigma0=0.3, n_prices=160, vol_window=40)
+    baseline = {"ci": {"b": [0.10, 0.20]}}
+    fired, detail = shift_significant(fit, baseline)
+    assert fired and detail["b"]["outside"]
+    inside = dataclasses.replace(fit, params=CIRParams(a=4.0, b=0.15, c=0.2))
+    fired, detail = shift_significant(inside, baseline)
+    assert not fired and not detail["b"]["outside"]
+
+
+def test_bake_and_read_calibration_roundtrip(tmp_path, calm_window):
+    assert read_calibration(tmp_path) is None   # pre-pilot bundle
+    bake_calibration(tmp_path, calm_window)
+    assert read_calibration(tmp_path) == calm_window.to_meta()
+
+
+def test_check_calibration_gate_in_the_hub(calm_window):
+    """The significance gate runs in the hub: no baked band -> every
+    calibration trigger is significant; a wide band swallows the wobble."""
+    hub = TriggerHub("desk")
+    ev = hub.check_calibration(calm_window, None)
+    assert ev is not None and ev.source == "calibration"
+    point = calm_window.fit.as_dict()
+    wide = {"ci": {k: [point[k] - 1.0, point[k] + 1.0]
+                   for k in ("a", "b", "c", "mu", "sigma0")}}
+    assert hub.check_calibration(calm_window, wide) is None
+    narrow = {"ci": {"b": [point["b"] + 0.5, point["b"] + 0.6]}}
+    ev = hub.check_calibration(calm_window, narrow)
+    assert ev is not None and "b" in ev.reason
+
+
+# -- the orp-pilot-v1 journal -------------------------------------------------
+
+
+def test_journal_envelope_and_seq(tmp_path):
+    jp = tmp_path / "pilot.jsonl"
+    a = journal_append(jp, {"kind": "transition", "cycle": 0,
+                            "state": "calibrating"})
+    b = journal_append(jp, {"kind": "trigger_request", "source": "manual"})
+    assert a["schema"] == "orp-pilot-v1" and a["seq"] == 0
+    assert b["seq"] == 1 and "ts_unix" in b
+    records, problems = read_journal(jp)
+    assert problems == [] and [r["seq"] for r in records] == [0, 1]
+    # the envelope is the WRITER's: caller keys cannot override it
+    c = journal_append(jp, {"kind": "config", "schema": None, "seq": 99})
+    assert c["seq"] == 2 and c["schema"] == "orp-pilot-v1"
+
+
+def test_journal_validation_refuses_garbage(tmp_path):
+    jp = tmp_path / "pilot.jsonl"
+    with pytest.raises(ValueError, match="kind"):
+        journal_append(jp, {"kind": "nonsense"})
+    with pytest.raises(ValueError, match="cycle"):
+        journal_append(jp, {"kind": "transition", "state": "training"})
+    with pytest.raises(ValueError, match="state"):
+        journal_append(jp, {"kind": "transition", "cycle": 0,
+                            "state": "limbo"})
+    with pytest.raises(ValueError, match="source"):
+        journal_append(jp, {"kind": "trigger_request"})
+    assert not jp.exists()                      # nothing invalid landed
+
+
+def test_journal_torn_tail_tolerated_and_healed(tmp_path):
+    """A pilot killed mid-append leaves a torn LAST line: reads tolerate
+    it, the next append truncates it, and seq continues unbroken."""
+    jp = tmp_path / "pilot.jsonl"
+    journal_append(jp, {"kind": "transition", "cycle": 0,
+                        "state": "calibrating"})
+    with open(jp, "a") as f:
+        f.write('{"kind": "transition", "cycle": 0, "sta')   # torn, no \n
+    records, problems = read_journal(jp)
+    assert len(records) == 1 and len(problems) == 1
+    healed = journal_append(jp, {"kind": "transition", "cycle": 0,
+                                 "state": "training"})
+    assert healed["seq"] == 1
+    records, problems = read_journal(jp)
+    assert problems == [] and [r["state"] for r in records
+                               if r["kind"] == "transition"] \
+        == ["calibrating", "training"]
+
+
+def test_journal_torn_middle_raises(tmp_path):
+    jp = tmp_path / "pilot.jsonl"
+    journal_append(jp, {"kind": "transition", "cycle": 0,
+                        "state": "calibrating"})
+    text = jp.read_text()
+    jp.write_text("{broken\n" + text)
+    with pytest.raises(ValueError, match="not the torn tail"):
+        read_journal(jp)
+
+
+def test_unconsumed_requests_survive_restart(tmp_path):
+    """Manual requests are consumed by the calibrating transition that
+    records their seq — stateless, so a restarted controller neither drops
+    nor double-fires one."""
+    jp = tmp_path / "pilot.jsonl"
+    req = journal_append(jp, {"kind": "trigger_request", "source": "manual",
+                              "tenant": "desk"})
+    records, _ = read_journal(jp)
+    assert [r["seq"] for r in unconsumed_requests(records)] == [req["seq"]]
+    journal_append(jp, {"kind": "transition", "cycle": 0,
+                        "state": "calibrating", "trigger_seq": req["seq"]})
+    records, _ = read_journal(jp)
+    assert unconsumed_requests(records) == []
+
+
+# -- triggers: debounce, backoff, incremental drift ---------------------------
+
+
+def test_cooldown_backoff_escalates_and_resets():
+    clk = [0.0]
+    c = Cooldown(cooldown_s=10.0, backoff=2.0, max_backoff_s=35.0,
+                 clock=lambda: clk[0])
+    assert c.ready()
+    c.note_fire()
+    assert not c.ready() and c.remaining() == pytest.approx(10.0)
+    c.note_reject()                 # 10 -> 20, re-armed from now
+    assert c.snapshot()["window_s"] == pytest.approx(20.0)
+    c.note_reject()                 # 20 -> 40, capped at 35
+    snap = c.snapshot()
+    assert snap["window_s"] == pytest.approx(35.0)
+    assert snap["consecutive_rejects"] == 2
+    clk[0] += 35.0
+    assert c.ready()
+    c.note_promote()                # escalation resets to base
+    assert c.snapshot()["window_s"] == pytest.approx(10.0)
+
+
+def test_hub_debounce_is_the_one_door():
+    clk = [0.0]
+    hub = TriggerHub("desk", cooldown=Cooldown(cooldown_s=60.0,
+                                               clock=lambda: clk[0]))
+    ev = TriggerEvent(source="manual", tenant="desk", reason="test")
+    reg, sink = obs.Registry(), obs.ListSink()
+    with obs.active(reg, sink):
+        assert hub.accept(ev)
+        assert not hub.accept(ev)               # gate armed: debounced
+        clk[0] += 61.0
+        assert hub.accept(ev)
+    names = [e["name"] for e in sink.events if e["type"] == "counter"]
+    assert names.count("pilot/trigger") == 2
+    assert names.count("pilot/debounced") == 1
+
+
+def test_poll_drift_is_incremental():
+    """The hub consumes the flight ring incrementally: each trip fires at
+    most once, other tenants' trips never fire here."""
+    hub = TriggerHub("desk")
+    events = [{"kind": "drift_trip", "tenant": "desk", "score": 9.0,
+               "band": 3.0, "rows": 256},
+              {"kind": "drift_trip", "tenant": "other", "score": 9.0,
+               "band": 3.0, "rows": 256},
+              {"kind": "degrade", "tenant": "desk"}]
+    got = hub.poll_drift(events)
+    assert [e.source for e in got] == ["drift"]
+    assert got[0].payload["score"] == 9.0
+    assert hub.poll_drift(events) == []         # nothing new
+    events.append({"kind": "drift_trip", "tenant": "desk", "score": 11.0,
+                   "band": 3.0, "rows": 512})
+    assert len(hub.poll_drift(events)) == 1
+
+
+def test_warm_params_picks_first_visited_date(trained):
+    p1, p2 = warm_params(trained)
+    want = jax.tree.map(lambda x: np.asarray(x)[-1],
+                        trained.backward.params1_by_date)
+    assert _tree_equal(p1, want)
+    with pytest.raises(ValueError, match="warm-start"):
+        warm_params(dataclasses.replace(
+            trained, backward=dataclasses.replace(
+                trained.backward, params1_by_date=None)))
+
+
+# -- controller chaos bars ----------------------------------------------------
+
+
+def test_clean_promote_cycle_emits_zero_guard_events(
+        trained, calm_window, shifted_prices, tmp_path):
+    """The guard acceptance bar, one layer up: a clean retrain cycle walks
+    calibrating -> ... -> promoted, bumps the tenant version, lands a
+    chain-verified promote verdict — and emits NOTHING on guard/*."""
+    with _rig(trained, calm_window, tmp_path) as (host, ctl, inc, clk, _, _):
+        v0 = host.stats()["desk"]["version"]
+        reg, sink = obs.Registry(), obs.ListSink()
+        with obs.active(reg, sink):
+            out = ctl.run_cycle(TriggerEvent(source="manual", tenant="desk",
+                                             reason="test"), shifted_prices)
+        assert out["outcome"] == "promoted"
+        assert host.stats()["desk"]["version"] == v0 + 1
+        assert [e for e in sink.events
+                if e.get("name", "").startswith("guard/")] == []
+        records, problems = read_journal(ctl.journal_path)
+        assert problems == []
+        cid, recs = last_cycle(records)
+        assert cid == 0 and [r["state"] for r in recs] == [
+            "calibrating", "training", "exporting", "canary", "promoted"]
+        chain = tmp_path / "promotions.jsonl"
+        assert chain_verify(chain)["ok"]
+        assert "promote" in [r["action"] for r in read_chain(chain)]
+
+
+def test_reject_leaves_incumbent_bitwise_and_escalates(
+        trained, calm_window, shifted_prices, tmp_path):
+    """A quality-band reject: the incumbent keeps serving BITWISE-untouched
+    (same files, same version, same source), the reject verdict lands on
+    the chain, and the cooldown escalates — the candidate was evidence the
+    signal is wrong, so the next retry waits strictly longer."""
+    with _rig(trained, calm_window, tmp_path) as (
+            host, ctl, inc, clk, sabotage, _):
+        before = _dir_digest(inc)
+        v0 = host.stats()["desk"]["version"]
+        sabotage[0] = True
+        out = ctl.run_cycle(TriggerEvent(source="manual", tenant="desk",
+                                         reason="test"), shifted_prices)
+        assert out["outcome"] == "rejected" and "regression" in out["why"]
+        assert _dir_digest(inc) == before
+        assert host.stats()["desk"]["version"] == v0
+        assert str(host.tenant_source("desk")) == str(inc)
+        snap = ctl.hub.cooldown.snapshot()
+        assert snap["window_s"] == pytest.approx(120.0)   # 60 x backoff 2
+        assert snap["consecutive_rejects"] == 1 and snap["remaining_s"] > 0
+        assert "reject" in [r["action"] for r in
+                            read_chain(tmp_path / "promotions.jsonl")]
+        _, recs = last_cycle(read_journal(ctl.journal_path)[0])
+        assert recs[-1]["state"] == "rejected"
+        assert recs[-1]["cooldown"]["consecutive_rejects"] == 1
+
+
+def test_nan_poisoned_retrain_degrades_without_aborting(
+        trained, calm_window, shifted_prices, tmp_path, recwarn):
+    """Chaos: NaN-poisoned fit targets during the retrain trip the sentinel
+    and rung DOWN the trainer ladder at that date — the cycle still reaches
+    promoted, with the degradation visible on guard/*."""
+    with _rig(trained, calm_window, tmp_path,
+              retrain_cfg=dataclasses.replace(RETRAIN, nan_guard=True)) as (
+            host, ctl, inc, clk, _, _):
+        reg, sink = obs.Registry(), obs.ListSink()
+        with obs.active(reg, sink):
+            with guard.faults(FaultPlan(seed=3, nan_dates=frozenset({1}),
+                                        nan_frac=0.02)):
+                out = ctl.run_cycle(
+                    TriggerEvent(source="manual", tenant="desk",
+                                 reason="test"), shifted_prices)
+        assert out["outcome"] == "promoted"
+        names = [e["name"] for e in sink.events if e["type"] == "counter"]
+        assert "guard/nan_event" in names and "guard/degrade" in names
+        assert any("guard: non-finite" in str(w.message)
+                   for w in recwarn.list)
+        _, recs = last_cycle(read_journal(ctl.journal_path)[0])
+        assert recs[-1]["state"] == "promoted"
+
+
+def test_kill_mid_training_resumes_bitwise_from_journal(
+        trained, calm_window, shifted_prices, tmp_path):
+    """Chaos: a pilot killed mid-retrain parks the journal at 'training'; a
+    FRESH controller resumes the same cycle — the content-addressed
+    checkpoints replay the completed dates — and the promoted policy is
+    BITWISE what the uninterrupted run would have produced."""
+    with _rig(trained, calm_window, tmp_path) as (
+            host, ctl, inc, clk, _, train_fn):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")     # the kill warns by design
+            with guard.faults(FaultPlan(kill_after_step=1)):
+                with pytest.raises(WalkKilled):
+                    ctl.run_cycle(TriggerEvent(source="manual",
+                                               tenant="desk", reason="test"),
+                                  shifted_prices)
+        records, _ = read_journal(ctl.journal_path)
+        cid, recs = last_cycle(records)
+        assert recs[-1]["state"] == "training"  # parked mid-cycle
+        # a fresh process: new controller, same journal, same host
+        ctl2 = PilotController(host, ctl.cfg, train_fn, hub=ctl.hub)
+        out = ctl2.resume()
+        assert out is not None and out["outcome"] == "promoted"
+        assert out["cycle"] == cid              # SAME cycle, not a new one
+        assert ctl2.resume() is None            # nothing left to resume
+        # bitwise pin: an uninterrupted reference run from the journaled
+        # calibration + the ORIGINAL incumbent's warm start
+        train_rec = {r["state"]: r for r in
+                     last_cycle(read_journal(ctl.journal_path)[0])[1]
+                     }["training"]
+        window = _window_from_meta(train_rec["calibration"])
+        warm = warm_params(load_bundle(train_rec["incumbent"]))
+        ref = train_fn(window, warm, None)
+        promoted = load_bundle(host.tenant_source("desk"))
+        assert _tree_equal(ref.backward.params1_by_date,
+                           promoted.backward.params1_by_date)
+
+
+# -- doctor + bench surfaces --------------------------------------------------
+
+
+def test_doctor_pilot_probe(tmp_path):
+    """`orp doctor --pilot JOURNAL`: a parked cycle reads as resumable, a
+    terminal cycle with NO promotions chain is a FAIL in flag-speak, and a
+    torn-middle journal fails the parse probe."""
+    from orp_tpu.serve.health import doctor_report
+
+    jp = tmp_path / "pilot.jsonl"
+    journal_append(jp, {"kind": "transition", "cycle": 0,
+                        "state": "calibrating"})
+    rows = {c["check"]: c for c in doctor_report(pilot=jp)["checks"]
+            if c["check"].startswith("pilot_")}
+    assert rows["pilot_journal"]["ok"]
+    assert rows["pilot_cycle"]["ok"]
+    assert "resumable" in rows["pilot_cycle"]["detail"]
+    assert rows["pilot_triggers"]["ok"]         # no config: manual-only
+
+    journal_append(jp, {"kind": "transition", "cycle": 0,
+                        "state": "promoted", "chain": None})
+    rows = {c["check"]: c for c in doctor_report(pilot=jp)["checks"]
+            if c["check"].startswith("pilot_")}
+    assert not rows["pilot_cycle"]["ok"]
+    assert "promotion_chain" in rows["pilot_cycle"]["fix"]
+
+    text = jp.read_text()
+    jp.write_text("{broken\n" + text)
+    rows = {c["check"]: c for c in doctor_report(pilot=jp)["checks"]
+            if c["check"].startswith("pilot_")}
+    assert not rows["pilot_journal"]["ok"]
+
+
+def test_serve_bench_pilot_drill_smoke(trained):
+    """Satellite contract: `orp serve-bench --pilot --quick` runs the full
+    regime-shift drill — drift trip, forced reject, honest promote under
+    concurrent traffic, kill + journal resume — and the committed record
+    carries the contract fields (the bench phase RAISES if any is
+    violated, so reaching the asserts IS the drill passing)."""
+    from orp_tpu.serve.bench import serve_bench
+
+    rec = serve_bench(trained, n_requests=8, batch_sizes=(1,),
+                      batcher_requests=4, pilot=True, pilot_quick=True)
+    pl = rec["pilot"]
+    assert pl["rows_lost"] == 0 and pl["rows_served"] == pl["rows_submitted"]
+    assert rec["pilot_rows_lost"] == 0
+    assert rec["pilot_time_to_promote_s"] == pl["time_to_promote_s"] > 0
+    outcomes = [c["outcome"] for c in pl["cycles"]]
+    assert "rejected" in outcomes and "promoted" in outcomes
+    assert pl["drift_trips"] >= 1 and pl["debounced"] >= 1
+    assert pl["trigger_sources"] == ["drift", "calibration", "manual"]
+    assert pl["chain"]["ok"]
+    assert {"promote", "reject"} <= set(pl["chain"]["verdicts"])
+    assert pl["reject_left_incumbent"]
+    assert pl["resume"]["outcome"] == "promoted"
+    assert pl["resume"]["bits_equal"]
+    assert pl["journal_problems"] == 0
+    assert pl["baseline_b"] < pl["shifted_b"]   # the regime shift is real
