@@ -117,6 +117,13 @@ def _topo_entry(mesh) -> dict:
     return {"dir": topology_fingerprint(mesh), **desc}
 
 
+def _tier_key(topo_key: str, tier: str) -> str:
+    """Executable-set directory key: the bare topology fingerprint for f32
+    (back-compat with every existing bundle) and ``<topo>+<tier>`` for the
+    non-f32 precision tiers — per-tier executable sets side by side."""
+    return topo_key if tier == "f32" else f"{topo_key}+{tier}"
+
+
 def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
                          policy_fingerprint) -> dict:
     """Compile + serialize every bucket executable for ONE topology into
@@ -128,7 +135,7 @@ def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
 
     adir.mkdir(parents=True, exist_ok=True)
     sds = jax.ShapeDtypeStruct
-    dt = jnp.dtype(engine.model.dtype)
+    dt = jnp.dtype(engine._eval_dt)
     if mesh is None:
         aval = lambda x: sds(x.shape, x.dtype)
         row_aval = lambda shape: sds(shape, dt)
@@ -160,6 +167,7 @@ def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
             label=f"eval_core/{b}",
             dual_mode=engine.dual_mode,
             holdings_combine=engine.holdings_combine,
+            precision=engine.precision.tier,
         )
         # AotUnsupported propagates from either codec: an export that cannot
         # ship executables should fail loudly, not write a bundle that
@@ -219,6 +227,11 @@ def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
         "fingerprint": device_fingerprint(),
         "topology": _topo_entry(mesh),
         "policy_fingerprint": policy_fingerprint,
+        # the precision tier these executables were compiled for: the
+        # loader refuses a tier mismatch the same way it refuses a wrong
+        # device (a bf16 executable served to an f32 engine would silently
+        # change serving numerics — worse than a cold compile)
+        "precision": engine.precision.tier,
         "buckets": entries,
     }
     # atomic, and written LAST: the manifest is the load-side source of
@@ -229,7 +242,8 @@ def _export_one_topology(adir: pathlib.Path, engine, mesh, buckets,
 
 
 def export_aot(directory: str | pathlib.Path, policy, *,
-               buckets=DEFAULT_BUCKETS, meshes=(None,)) -> dict:
+               buckets=DEFAULT_BUCKETS, meshes=(None,),
+               precision="f32") -> dict:
     """Compile + serialize the serving executables for ``policy`` into
     ``<directory>/aot/<topo>/`` for every topology in ``meshes``; returns
     the written index manifest with the per-topology manifests inlined
@@ -242,6 +256,11 @@ def export_aot(directory: str | pathlib.Path, policy, *,
     topologies). ``meshes`` entries may be ``None`` (single device), ints,
     ``MeshSpec``s or built ``Mesh``es; exporting for a mesh requires that
     many devices visible in THIS process (the compile is real).
+
+    ``precision`` exports that serving tier's executable set
+    (serve/precision.py): non-f32 sets live under ``aot/<topo>+<tier>/``
+    next to the f32 set, and the tier is recorded in each manifest so
+    ``load_aot`` can refuse a mismatch.
     """
     from orp_tpu.parallel.mesh import as_mesh, topology_fingerprint
     from orp_tpu.serve.engine import HedgeEngine
@@ -252,7 +271,7 @@ def export_aot(directory: str | pathlib.Path, policy, *,
     # use_aot=False: only shapes/statics are needed here — a RE-export into
     # a dir holding a previous --aot artifact must not load (or warn about)
     # the very executables it is about to overwrite
-    engine = HedgeEngine(policy, use_aot=False)
+    engine = HedgeEngine(policy, use_aot=False, precision=precision)
     d = pathlib.Path(directory)
     adir = d / AOT_SUBDIR
     adir.mkdir(parents=True, exist_ok=True)
@@ -295,9 +314,9 @@ def export_aot(directory: str | pathlib.Path, policy, *,
             # fingerprint key) — normalise so it ships the raw-PJRT codec,
             # the fastest dispatch, whichever way the caller spelled it
             mesh = None
-        key = topology_fingerprint(mesh)
+        key = _tier_key(topology_fingerprint(mesh), engine.precision.tier)
         manifest = _export_one_topology(adir / key, engine, mesh, buckets, pf)
-        index["topologies"][key] = manifest["topology"]
+        index["topologies"][key] = {**manifest["topology"], "dir": key}
         out["topologies"][key] = manifest
     atomic_write_text(index_f, json.dumps(index, indent=1, sort_keys=True))
     return out
@@ -374,16 +393,19 @@ def _fallback(directory, reason: str) -> dict:
 
 def load_aot(directory: str | pathlib.Path, *,
              policy_fingerprint: str | None = None,
-             mesh=None) -> dict | None:
+             mesh=None, precision: str = "f32") -> dict | None:
     """Deserialize the bucket executables for THIS process's topology from
     ``<directory>/aot/``.
 
     ``mesh`` selects the topology (None = single device — the key
-    ``parallel.mesh.topology_fingerprint`` computes either way). Returns
-    None when the bundle ships no AOT artifacts at all (nothing to say),
-    ``{}`` after emitting ONE warning when they exist but cannot be used
-    here (topology not exported, wrong device/jaxlib, tampered manifest,
-    undeserializable blob), else ``{bucket: AotExecutable | AotCompiled}``.
+    ``parallel.mesh.topology_fingerprint`` computes either way);
+    ``precision`` selects the tier's executable set (``<topo>`` for f32,
+    ``<topo>+<tier>`` otherwise) and is verified against the manifest's
+    recorded tier. Returns None when the bundle ships no AOT artifacts at
+    all (nothing to say), ``{}`` after emitting ONE warning when they
+    exist but cannot be used here (topology or tier not exported, wrong
+    device/jaxlib, tampered manifest, undeserializable blob), else
+    ``{bucket: AotExecutable | AotCompiled}``.
     """
     from orp_tpu.parallel.mesh import as_mesh, topology_fingerprint
 
@@ -401,12 +423,12 @@ def load_aot(directory: str | pathlib.Path, *,
             f"format {index.get('format')!r} != {AOT_FORMAT} (a pre-topology "
             "v1 artifact refuses here — re-export with --aot)")
     mesh = as_mesh(mesh)
-    key = topology_fingerprint(mesh)
+    key = _tier_key(topology_fingerprint(mesh), precision)
     topos = index.get("topologies", {})
     if key not in topos:
         return _fallback(
             directory,
-            f"no executables for topology {key!r} "
+            f"no executables for topology+tier {key!r} "
             f"(bundle ships: {sorted(topos)})")
     tdir = adir / topos[key].get("dir", key)
     meta_f = tdir / AOT_META
@@ -437,6 +459,12 @@ def load_aot(directory: str | pathlib.Path, *,
             and manifest.get("policy_fingerprint") != policy_fingerprint):
         return _fallback(directory, "policy fingerprint mismatch (executables "
                          "were exported for a different policy)")
+    saved_tier = manifest.get("precision", "f32")
+    if saved_tier != precision:
+        return _fallback(
+            directory,
+            f"precision tier mismatch: executables were exported for "
+            f"{saved_tier!r}, this engine serves {precision!r}")
     out: dict = {}
     try:
         for b_str, entry in manifest.get("buckets", {}).items():

@@ -171,6 +171,11 @@ def aot_compile(jit_fn, *args, label: str, **static_kwargs):
         "backend_compile_s": round(mon.seconds, 3) if mon.supported else None,
         **cost_summary(compiled),
     }
+    if "precision" in static_kwargs:
+        # serving precision tier (serve/precision.py): stamped per compiled
+        # program so a bundle manifest's bucket rows name the tier their
+        # FLOPs/roofline numbers were measured under
+        meta["precision"] = static_kwargs["precision"]
     obs_count("aot/compiles", fn=label)
     for key in ("flops", "bytes_accessed"):
         if key in meta:

@@ -839,6 +839,9 @@ def cmd_serve_bench(args):
     fleet_blocks, fleet_rows = args.fleet_blocks, args.fleet_rows
     density_tenants = args.density_tenants
     density_max_live = args.density_max_live
+    precision_rows = args.precision_rows
+    megakernel_rows = 2048
+    ragged_counts = (520, 130, 17)
     repeats = args.repeats
     if args.quick:
         # the CI smoke shape: tiny block counts, same lanes, same pins —
@@ -858,6 +861,15 @@ def cmd_serve_bench(args):
         # compiles == 0) without thousand-tenant spend
         density_tenants = min(density_tenants, 2)
         density_max_live = 1
+        # the precision smoke keeps every gate (banded pins, bitwise
+        # megakernel, pad-waste collapse, the promotion drill) at tiny
+        # row counts — the CPU interpreter path makes this tier-1 safe
+        precision_rows = min(precision_rows, 256)
+        megakernel_rows = 64
+        # (272, 24) is the smallest mix where the planner's split actually
+        # pays: merged 296 -> split [256, 40] wastes 0 pad rows where the
+        # pow2 arm wastes 216 — so even the smoke proves a strict saving
+        ragged_counts = (272, 24)
         if args.fleet or args.density:
             repeats = 1
     if any(n < 1 for n in fleet_replicas):
@@ -904,6 +916,11 @@ def cmd_serve_bench(args):
         density_budget_ms=args.density_budget_ms,
         pilot=args.pilot,
         pilot_quick=args.quick,
+        precision=args.precision,
+        precision_rows=precision_rows,
+        precision_quality_band=args.precision_band,
+        megakernel_rows=megakernel_rows,
+        ragged_counts=ragged_counts,
         repeats=repeats,
         previous=previous,
     )
@@ -2179,6 +2196,22 @@ def build_parser():
     psb.add_argument("--density-budget-ms", type=float, default=500.0,
                      help="cold-activation p99 budget the tenants-within-"
                           "budget headline is scored against")
+    psb.add_argument("--precision", action="store_true",
+                     help="append the raw-speed matrix: the precision-tier "
+                          "sweep (f32/bf16/int8 rows/s with BANDED accuracy "
+                          "pins and the quality-banded reload_tenant "
+                          "promotion drill), the mixed-date megakernel A/B "
+                          "(fused single dispatch vs loop-of-buckets, f32 "
+                          "pinned BITWISE) and the ragged-vs-pow2 batching "
+                          "A/B (measured serve/pad_waste_rows collapse at "
+                          "bitwise-equal bits); the phases FAIL on any "
+                          "violated pin (--quick shrinks the row counts)")
+    psb.add_argument("--precision-rows", type=int, default=4096,
+                     help="rows per precision-tier timed evaluation")
+    psb.add_argument("--precision-band", type=float, default=0.05,
+                     help="relative hedge-error regression the tier "
+                          "promotion drill tolerates (the reload_tenant "
+                          "quality band)")
     psb.add_argument("--quick", action="store_true",
                      help="CI smoke shape: shrink the ingest sweep, the "
                           "gateway drill and the fleet phase to tiny "

@@ -150,6 +150,16 @@ ORP023  pilot transitions that skip telemetry or hold a lock across heavy
         head-of-line-blocks (or deadlocks) the serving plane the pilot
         exists to keep warm. Same swap-under-the-lock, work-outside-it
         discipline as ORP012, scoped to the control loop that automates it.
+ORP024  implicit dtype on the serve hot path: the precision tiers
+        (``serve/precision.py``) thread ONE eval dtype through
+        ``_eval_core`` / the megakernel — and a ``jnp.asarray``/``zeros``/
+        ``ones``/``full``/``array`` without an explicit dtype defaults to
+        f32 (or weak-promotes), silently upcasting a bf16 tier's
+        intermediates back to f32: the tier still *answers* correctly and
+        *bills* like f32, which no output check catches. Scoped to the
+        hot-path modules (``serve/engine.py``, ``serve/megakernel.py``,
+        ``serve/precision.py``); every construction there says its dtype
+        (the engine's ``self._eval_dt`` / the model's ``m.dtype``).
 ORP011  single-device assumptions in mesh-reachable code: ``jax.devices()[0]``
         (and any devices()/local_devices() subscript) silently pins work to
         one chip of a fleet, ``jax.device_put`` WITHOUT an explicit
@@ -1562,6 +1572,47 @@ def check_pilot_transition_discipline(ctx: FileContext) -> Iterator[Finding]:
                         "plane; do the work outside, swap state under the "
                         "lock",
                     )
+
+
+# -- ORP024 ------------------------------------------------------------------
+
+# the serve hot-path modules the precision tiers thread one eval dtype
+# through — the only files where an implicit construction dtype can undo
+# a tier without failing anything
+_ORP024_PATHS = ("serve/engine.py", "serve/megakernel.py",
+                 "serve/precision.py")
+# constructor -> index of the positional dtype argument (keyword dtype=
+# always accepted); jnp.full is (shape, fill_value, dtype)
+_ORP024_CONS = {"jnp.asarray": 1, "jnp.array": 1, "jnp.zeros": 1,
+                "jnp.ones": 1, "jnp.full": 2,
+                "jax.numpy.asarray": 1, "jax.numpy.array": 1,
+                "jax.numpy.zeros": 1, "jax.numpy.ones": 1,
+                "jax.numpy.full": 2}
+
+
+@rule("ORP024", "implicit dtype promotion on the serve hot path")
+def check_hot_path_dtype(ctx: FileContext) -> Iterator[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if not path.endswith(_ORP024_PATHS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        idx = _ORP024_CONS.get(d)
+        if idx is None:
+            continue
+        if len(node.args) > idx:
+            continue  # positional dtype (the hot path's house style)
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        yield ctx.finding(
+            node, "ORP024",
+            f"{d} without an explicit dtype on the serve hot path — the "
+            "default (f32 / weak promotion) silently upcasts a bf16/int8 "
+            "tier's intermediates back to f32: same answers, f32 bill. "
+            "Pass the engine's eval dtype (self._eval_dt / m.dtype)",
+        )
 
 
 @rule("ORP009", "except Exception that neither re-raises nor emits")

@@ -64,6 +64,16 @@ class HedgeMLP:
             return 1
         return self.n_hedge_assets + 1
 
+    def with_dtype(self, dtype) -> "HedgeMLP":
+        """The same architecture computing in ``dtype`` — the serving
+        precision tiers' hook (``serve/precision.py``): ``dtype`` drives
+        every ``astype`` in the shared forward, and the frozen dataclass
+        stays hashable, so the tier-replaced model rides jit static
+        arguments exactly like the original."""
+        if jnp.dtype(dtype) == jnp.dtype(self.dtype):
+            return self
+        return dataclasses.replace(self, dtype=dtype)
+
     def init(self, key: jax.Array, bias_init: tuple[float, ...] | None = None) -> Params:
         """Initialise params. ``bias_init`` warm-starts the output bias with a
         moneyness-informed allocation (the RP.py:158-166 trick): ``(phi0,

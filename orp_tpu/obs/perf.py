@@ -366,7 +366,19 @@ PEAK_TABLE: dict[str, dict] = {
                     "note": "918T bf16 / 6-pass f32 (v6e)"},
 }
 
+#: serving-tier throughput multipliers over the table's f32-equivalent
+#: base (serve/precision.py tiers): bf16 runs the MXU at its PUBLISHED
+#: peak — exactly the 6-pass factor the f32 base divided out — and int8
+#: (weight-only, f32 accumulate here, but the published int8 OPS ceiling
+#: is the honest roof) doubles it on every listed generation. The bytes/s
+#: roof is dtype-independent (HBM moves bytes, not elements).
+TIER_PEAK_FACTOR: dict[str, float] = {"f32": 1.0, "bf16": 6.0, "int8": 12.0}
+
 _MEASURED_PEAK: dict[str, float] = {}
+
+#: (device_kind, precision) pairs already warned about — the unknown-kind/
+#: unknown-tier fallback must be visible once, not once per roofline join
+_PEAK_WARNED: set = set()
 
 
 def measured_matmul_peak(n: int = 512, repeats: int = 5) -> float:
@@ -394,32 +406,66 @@ def measured_matmul_peak(n: int = 512, repeats: int = 5) -> float:
     return peak
 
 
-def peak_for(device_kind: str | None = None) -> tuple[dict, str]:
-    """``(peak_entry, source)`` for a device kind: the published table row
-    (``source="table"``) or the measured-matmul fallback
+def peak_for(device_kind: str | None = None,
+             precision: str = "f32") -> tuple[dict, str]:
+    """``(peak_entry, source)`` for a device kind at a serving precision
+    tier: the published table row scaled by :data:`TIER_PEAK_FACTOR`
+    (``source="table"``), or the measured-matmul fallback
     (``source="measured_matmul"``, bytes/s None — honest absence beats a
-    fabricated bandwidth). ``device_kind=None`` reads this process's."""
+    fabricated bandwidth). ``device_kind=None`` reads this process's.
+
+    Fallbacks WARN once per (kind, tier), never crash: an unknown tier
+    prices at the f32 peak (the fraction reads conservative), and an
+    unknown kind at a non-f32 tier keeps the measured F32 matmul peak —
+    there is no measured bf16/int8 probe, and scaling a measured number
+    by a published factor would fabricate a ceiling."""
+    import warnings
+
     if device_kind is None:
         import jax
 
         device_kind = jax.devices()[0].device_kind  # orp: noqa[ORP011] -- topology introspection: the kind is fleet-wide
+    factor = TIER_PEAK_FACTOR.get(str(precision))
+    if factor is None:
+        if (device_kind, precision) not in _PEAK_WARNED:
+            _PEAK_WARNED.add((device_kind, precision))
+            warnings.warn(
+                f"precision tier {precision!r} not in TIER_PEAK_FACTOR "
+                f"({sorted(TIER_PEAK_FACTOR)}) — pricing against the f32 "
+                "peak (fractions-of-peak will read conservative)",
+                stacklevel=2)
+        factor = 1.0
+        precision = "f32"
     entry = PEAK_TABLE.get(str(device_kind))
     if entry is not None:
-        return dict(entry), "table"
+        out = dict(entry)
+        if factor != 1.0:
+            out["flops_per_s"] = entry["flops_per_s"] * factor
+            out["note"] = (f"{entry['note']}; x{factor:g} {precision} tier")
+        return out, "table"
+    if factor != 1.0 and (device_kind, precision) not in _PEAK_WARNED:
+        _PEAK_WARNED.add((device_kind, precision))
+        warnings.warn(
+            f"device kind {device_kind!r} not in PEAK_TABLE: no published "
+            f"{precision} peak — using the measured f32 matmul peak, so "
+            f"the {precision} fraction-of-peak will read conservative",
+            stacklevel=2)
     return {"flops_per_s": measured_matmul_peak(), "bytes_per_s": None,
             "note": f"measured f32 matmul peak ({device_kind!r} not in "
                     "PEAK_TABLE)"}, "measured_matmul"
 
 
 def roofline(flops: float | None, bytes_accessed: float | None,
-             wall_s: float, *, device_kind: str | None = None) -> dict:
+             wall_s: float, *, device_kind: str | None = None,
+             precision: str = "f32") -> dict:
     """Join a program's cost_analysis FLOPs/bytes with a measured execute
     wall: achieved FLOP/s, bytes/s and fraction-of-peak. Fields are None
     when the corresponding cost or peak is unavailable — a roofline that
-    fabricates a denominator is worse than none."""
+    fabricates a denominator is worse than none. ``precision`` prices the
+    ceiling at the serving tier's throughput (:data:`TIER_PEAK_FACTOR`)."""
     if wall_s <= 0:
         raise ValueError(f"roofline: wall_s={wall_s} must be > 0")
-    peak, source = peak_for(device_kind)
+    peak, source = peak_for(device_kind, precision)
     out: dict = {"wall_s": round(float(wall_s), 9), "peak_source": source,
                  "peak_flops_per_s": peak["flops_per_s"],
                  "peak_bytes_per_s": peak["bytes_per_s"]}

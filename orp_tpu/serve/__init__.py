@@ -64,12 +64,17 @@ from orp_tpu.serve.host import (CanaryRejected, ServeHost, SloPolicy,
 from orp_tpu.serve.ingest import (SERVED, SHED_DEADLINE, SHED_QUOTA,
                                   SHED_WATERMARK, STATUS_NAMES, BlockResult,
                                   concat_results)
+from orp_tpu.serve.megakernel import loop_of_buckets, mixed_head_forward
 from orp_tpu.serve.metrics import ServingMetrics
+from orp_tpu.serve.precision import (TIERS, PrecisionPolicy,
+                                     normalize_precision)
+from orp_tpu.serve.ragged import BucketPlanner
 from orp_tpu.serve.scrape import (MetricsServer, parse_prometheus,
                                   render_top, top_snapshot)
 
 __all__ = [
     "BlockResult",
+    "BucketPlanner",
     "CanaryRejected",
     "DispatchWatchdog",
     "FrameStall",
@@ -80,6 +85,7 @@ __all__ = [
     "MicroBatcher",
     "PendingEval",
     "PolicyBundle",
+    "PrecisionPolicy",
     "ResilientGatewayClient",
     "SERVED",
     "SHED_DEADLINE",
@@ -90,11 +96,15 @@ __all__ = [
     "ServeHost",
     "ServingMetrics",
     "SloPolicy",
+    "TIERS",
     "burn_rate",
     "concat_results",
     "doctor_report",
     "export_bundle",
     "load_bundle",
+    "loop_of_buckets",
+    "mixed_head_forward",
+    "normalize_precision",
     "parse_prometheus",
     "render_top",
     "serve_bench",

@@ -209,6 +209,10 @@ class _Group:
     prices: object = None
     pending: object = None        # engine handle with .result()
     error: Exception | None = None
+    # mixed-date lane (megakernel): per-row int32 date column when the
+    # group spans dates — the block-time retry must re-dispatch through
+    # the same fused path, so the column is kept alongside feats/prices
+    dates: object = None
     # columnar lane: a LONE Block rides its OWN group (its rows are already
     # one contiguous device-shaped batch — zero concatenates clean-path) and
     # resolves through its single future with the per-row status column
@@ -247,6 +251,13 @@ class MicroBatcher:
     module docstring. With a deadline in force, a future may resolve to a
     :class:`~orp_tpu.guard.Rejection` instead of ``(phi, psi, value)``;
     check ``guard.is_rejection(result)`` before unpacking.
+
+    ``ragged=True`` (optionally with a shared ``planner``) turns on
+    pad-waste-aware dispatch planning (:mod:`orp_tpu.serve.ragged`);
+    ``mixed_dates=True`` fuses requests at different rebalance dates into
+    one megakernel dispatch (:mod:`orp_tpu.serve.megakernel`). Both are
+    opt-in: default-off keeps the per-date always-merge dispatch shape
+    existing tests and benches pin.
     """
 
     def __init__(self, engine, *, max_batch: int = 1024,
@@ -255,7 +266,10 @@ class MicroBatcher:
                  policy: GuardPolicy | None = None,
                  max_inflight: int = 2,
                  min_fill: int | None = None,
-                 coalesce_blocks: bool = True):
+                 coalesce_blocks: bool = True,
+                 ragged: bool = False,
+                 planner=None,
+                 mixed_dates: bool = False):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         if max_inflight < 1:
@@ -283,6 +297,27 @@ class MicroBatcher:
         # one-block-one-dispatch shape (the A/B the fleet bench pins bits
         # against).
         self.coalesce_blocks = bool(coalesce_blocks)
+        # ragged batching (serve/ragged.py), opt-in: a pad-waste-aware
+        # BucketPlanner partitions coalesced blocks into dispatch groups
+        # (merge vs keep-separate) and shatters an over-padded batch into
+        # exact-bucket chunks when its cost model says the extra launches
+        # undercut the padding. `False` keeps the always-merge pow2 shape
+        # (the A/B the ragged bench phase pins against). Pass `planner`
+        # to share a profile-fed instance; `ragged=True` alone builds a
+        # proxy-cost default.
+        self.planner = planner
+        if ragged and self.planner is None:
+            from orp_tpu.serve.ragged import BucketPlanner
+
+            self.planner = BucketPlanner()
+        # mixed-date lane (serve/megakernel.py), opt-in: per-request
+        # admission stops keying groups on date_idx — rows at DIFFERENT
+        # rebalance dates concatenate into one fused megakernel dispatch
+        # (engine.evaluate_mixed_async) instead of one launch per date.
+        # Default False: the per-date grouping is the shape the existing
+        # dispatch-count pins (tests/test_serve.py) are written against,
+        # and the fused path needs a single-device engine.
+        self.mixed_dates = bool(mixed_dates)
         self.metrics = metrics
         self.policy = policy
         # stuck-dispatch watchdog (serve/health.py), opt-in via the policy's
@@ -615,10 +650,30 @@ class MicroBatcher:
                        None if req.prices is None else req.prices.shape[1])
                 block_groups.setdefault(key, []).append(req)
                 continue
-            key = (req.date_idx, req.features.shape[1],
+            # mixed-date lane: drop the date from the key — requests at
+            # different rebalance dates fuse into one megakernel dispatch
+            key = ((None if self.mixed_dates else req.date_idx),
+                   req.features.shape[1],
                    None if req.prices is None else req.prices.shape[1])
             groups.setdefault(key, []).append(req)
         for (date_idx, _, pwidth), blks in block_groups.items():
+            if (len(blks) > 1 and self.coalesce_blocks
+                    and self.planner is not None):
+                # ragged: the planner's DP picks merge vs keep-separate
+                # per run of admitted blocks instead of always-merge; the
+                # groups are consecutive in admission order, so every
+                # origin's reply still slices out contiguously
+                parts = self.planner.plan([b.n_live for b in blks])
+                if len(parts) > 1:
+                    obs_count("serve/ragged_plans")
+                for lo, hi in parts:
+                    part = blks[lo:hi]
+                    if len(part) == 1:
+                        out.append(self._dispatch_block(part[0]))
+                    else:
+                        out.append(self._dispatch_coalesced(
+                            date_idx, pwidth, part))
+                continue
             if len(blks) == 1 or not self.coalesce_blocks:
                 for blk in blks:
                     out.append(self._dispatch_block(blk))
@@ -628,14 +683,25 @@ class MicroBatcher:
             has_prices = pwidth is not None
             g = _Group(reqs=reqs, has_prices=has_prices,
                        rows=sum(r.features.shape[0] for r in reqs),
-                       date_idx=date_idx)
+                       date_idx=(reqs[0].date_idx if date_idx is None
+                                 else date_idx))
             out.append(g)
             try:
                 g.feats = np.concatenate([r.features for r in reqs], axis=0)
                 g.prices = (np.concatenate([r.prices for r in reqs], axis=0)
                             if has_prices else None)
-                g.pending = self._dispatch_engine(g.date_idx, g.feats,
-                                                  g.prices)
+                if (date_idx is None
+                        and len({r.date_idx for r in reqs}) > 1):
+                    # genuinely mixed dates: one fused megakernel dispatch
+                    # instead of one launch per distinct date
+                    g.dates = np.concatenate(
+                        [np.full(r.rows, r.date_idx, np.int32)
+                         for r in reqs])
+                    g.pending = self._dispatch_engine(
+                        g.date_idx, g.feats, g.prices, dates=g.dates)
+                else:
+                    g.pending = self._dispatch_planned(g.date_idx, g.feats,
+                                                       g.prices)
             except Exception as e:  # orp: noqa[ORP009] -- delivered to every future in the group by _resolve
                 g.error = e
                 continue
@@ -659,7 +725,7 @@ class MicroBatcher:
                    block=blk)
         try:
             g.feats, g.prices = feats, prices
-            g.pending = self._dispatch_engine(g.date_idx, feats, prices)
+            g.pending = self._dispatch_planned(g.date_idx, feats, prices)
         except Exception as e:  # orp: noqa[ORP009] -- delivered to the block's future by _resolve
             g.error = e
             return g
@@ -698,7 +764,7 @@ class MicroBatcher:
             g.feats = np.concatenate(feat_cols, axis=0)
             g.prices = (np.concatenate(price_cols, axis=0)
                         if has_prices else None)
-            g.pending = self._dispatch_engine(date_idx, g.feats, g.prices)
+            g.pending = self._dispatch_planned(date_idx, g.feats, g.prices)
         except Exception as e:  # orp: noqa[ORP009] -- delivered to every block future by _resolve
             g.error = e
             return g
@@ -716,17 +782,24 @@ class MicroBatcher:
             self.metrics.record_dispatch(len(blks), g.rows, cap)
         return g
 
-    def _dispatch_engine(self, date_idx: int, feats, pr):
+    def _dispatch_engine(self, date_idx: int, feats, pr, dates=None):
         """One non-blocking engine dispatch, with the policy's bounded
         retry-with-backoff for transient failures (a deterministic error
         propagates on attempt one — retrying it only repeats it with
         latency). The backoff waits on the close-interrupt Event, not
-        ``time.sleep``: bounded, small by policy, and breakable."""
-        submit = getattr(self.engine, "evaluate_async", None)
-        if submit is None:
-            # a plain-evaluate engine still works behind the batcher: its
-            # blocking result is wrapped to look already-resolved
-            submit = lambda d, f, p: _Resolved(self.engine.evaluate(d, f, p))
+        ``time.sleep``: bounded, small by policy, and breakable.
+        ``dates`` (per-row int32 column) routes through the fused
+        mixed-date megakernel lane instead of the single-date bucket."""
+        if dates is not None:
+            submit = lambda d, f, p: self.engine.evaluate_mixed_async(
+                dates, f, p)
+        else:
+            submit = getattr(self.engine, "evaluate_async", None)
+            if submit is None:
+                # a plain-evaluate engine still works behind the batcher:
+                # its blocking result is wrapped to look already-resolved
+                submit = lambda d, f, p: _Resolved(
+                    self.engine.evaluate(d, f, p))
         pol = self.policy
         attempts = 1 + (pol.max_retries if pol is not None else 0)
         for attempt in range(1, attempts + 1):
@@ -738,6 +811,26 @@ class MicroBatcher:
                 obs_count("guard/retry", site="serve/dispatch",
                           attempt=str(attempt))
                 self._interrupt.wait(pol.backoff_s(attempt))
+
+    def _dispatch_planned(self, date_idx: int, feats, pr):
+        """Engine dispatch with the ragged planner's split decision
+        applied: an over-padded batch shatters into exact-bucket chunks
+        (each its own engine dispatch; XLA queues them back to back) and
+        resolves through one concatenating handle. Without a planner —
+        or when its cost model keeps the batch whole — this IS
+        ``_dispatch_engine``."""
+        if self.planner is not None:
+            chunks = self.planner.split_rows(int(feats.shape[0]))
+            if chunks is not None:
+                obs_count("serve/ragged_splits")
+                pends, off = [], 0
+                for c in chunks:
+                    pends.append(self._dispatch_engine(
+                        date_idx, feats[off:off + c],
+                        None if pr is None else pr[off:off + c]))
+                    off += c
+                return _SplitPending(pends)
+        return self._dispatch_engine(date_idx, feats, pr)
 
     def _blocked(self, pending):
         """The ONE block point on a dispatched batch: straight through
@@ -768,7 +861,8 @@ class MicroBatcher:
             obs_count("guard/retry", site="serve/block", attempt="1")
             self._interrupt.wait(pol.backoff_s(1))
             return self._blocked(
-                self._dispatch_engine(g.date_idx, g.feats, g.prices))
+                self._dispatch_engine(g.date_idx, g.feats, g.prices,
+                                      dates=g.dates))
 
     def _resolve(self, groups: list[_Group]) -> None:
         """Block on the oldest in-flight batch and resolve every future in
@@ -882,3 +976,25 @@ class _Resolved:
 
     def result(self):
         return self._out
+
+
+class _SplitPending:
+    """A ragged split's in-flight chunks wearing ONE ``PendingEval``
+    interface: ``result()`` blocks each chunk in dispatch order and
+    concatenates the unpadded rows back — bitwise the unsplit dispatch's
+    rows (the forward is per-row and XLA row results are batch-size
+    invariant; the ragged bench phase pins it). Every existing resolve
+    path then works unchanged on a split group."""
+
+    __slots__ = ("_pends",)
+
+    def __init__(self, pends):
+        self._pends = pends
+
+    def result(self):
+        outs = [p.result() for p in self._pends]
+        phi = np.concatenate([o[0] for o in outs], axis=0)
+        psi = np.concatenate([o[1] for o in outs], axis=0)
+        value = (np.concatenate([o[2] for o in outs], axis=0)
+                 if outs[0][2] is not None else None)
+        return phi, psi, value

@@ -1805,6 +1805,359 @@ def _pilot_phase(*, quick: bool, seed: int) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+#: banded (NOT bitwise) accuracy pins per reduced-precision tier: the max
+#: |Δφ|/|Δψ| a tier may show against the f32 reference on the benched rows.
+#: bf16 runs the WHOLE forward at ~8 mantissa bits, so rounding compounds
+#: through the layers — measured ~5e-3 on the committed full-shape policy
+#: (13 dates), ~8e-4 on the tiny CI one; 2e-2 is the guard band that still
+#: catches a broken cast path (those diverge at O(0.1-1)). int8 is
+#: weight-only with f32 accumulate — measured ~5e-5, banded 5e-3. The
+#: PR 13 paired quality gate, not this tripwire, is the hedging arbiter.
+PRECISION_BANDS = {"f32": 0.0, "bf16": 2e-2, "int8": 5e-3}
+
+
+def _precision_phase(policy, *, rows: int, repeats: int, seed: int,
+                     quality_band: float = 0.05) -> dict:
+    """The precision-tier sweep (CLI ``serve-bench --precision``): the SAME
+    feature rows through one engine per serving tier (f32 / bf16 / int8 —
+    ``serve/precision.py``), each prewarmed then timed on ``repeats``
+    big-batch evaluations, with two gates a committed record must pass:
+
+    - **banded accuracy** — each tier's served φ/ψ against the f32
+      engine's, pinned within :data:`PRECISION_BANDS` (banded, NOT
+      bitwise: a reduced-precision tier produces different bits by
+      construction — REPRODUCE.md spells out why); f32 itself must stay
+      bitwise (band 0.0). The phase RAISES outside the band.
+    - **the promotion drill** — every non-f32 tier goes through the PR 13
+      quality-banded ``reload_tenant`` route against the f32 incumbent:
+      first the ``require_same_bits=True`` refusal (a tier change can
+      never pass a bitwise canary — the refusal must be LOUD, not a
+      confusing canary failure), then the guarded promotion
+      (``require_same_bits=False`` + ``quality_band``) whose paired-RQMC
+      hedge-error regression the record commits. The phase RAISES if the
+      refusal does not fire.
+
+    Each tier also carries its roofline join priced at the TIER's peak
+    (``obs.perf.peak_for(..., precision=tier)``) so the record can call
+    out the fraction-of-peak delta the tier bought."""
+    from orp_tpu.serve.host import CanaryRejected, ServeHost
+    from orp_tpu.serve.precision import TIERS
+
+    rng = np.random.default_rng(seed)
+    tiers = []
+    ref_phi = ref_psi = None
+    feats = None
+    for tier in TIERS:
+        engine = HedgeEngine(policy, precision=tier)
+        if feats is None:
+            nf = engine.model.n_features
+            feats = (1.0 + 0.1 * rng.standard_normal((rows, nf))
+                     ).astype(np.float32)
+        bucket = engine.bucket_for(rows)
+        engine.prewarm([bucket])
+        phi, psi, _ = engine.evaluate(0, feats)
+        if tier == "f32":
+            ref_phi, ref_psi = phi, psi
+            dphi = dpsi = 0.0
+            bitwise = True
+        else:
+            dphi = float(np.max(np.abs(phi - ref_phi)))
+            dpsi = float(np.max(np.abs(psi - ref_psi)))
+            bitwise = bool(np.array_equal(phi, ref_phi)
+                           and np.array_equal(psi, ref_psi))
+        band = PRECISION_BANDS[tier]
+        if max(dphi, dpsi) > band or (tier == "f32" and not bitwise):
+            obs.count("quality/gate_trip", gate="precision_band")
+            raise RuntimeError(
+                f"precision band violated: tier {tier!r} served "
+                f"max|dphi|={dphi:.3g} max|dpsi|={dpsi:.3g} against the "
+                f"f32 reference (band {band:g}) — the tier's quantisation "
+                "path is broken, not merely imprecise; do not commit this "
+                "record")
+        rates = []
+        with _devprof.profiling() as prof:
+            for r in range(max(1, int(repeats))):
+                t0 = time.perf_counter()
+                engine.evaluate(r % engine.n_dates, feats)
+                rates.append(rows / (time.perf_counter() - t0))
+            dev_stats = prof.bucket_stats()
+        rps = _perf.summarize_repeats(rates)
+        level = {
+            "tier": tier,
+            "rows": int(rows),
+            "bucket": int(bucket),
+            "repeats": rps["repeats"],
+            "rows_per_s": round(rps["median"], 1),
+            "rows_per_s_iqr": round(rps["iqr"], 1),
+            "max_abs_dphi_vs_f32": dphi,
+            "max_abs_dpsi_vs_f32": dpsi,
+            "band": band,
+            "bitwise_equal_to_f32": bitwise,
+        }
+        # the tier-priced roofline: same measured device seconds, peak
+        # scaled by the tier's throughput factor — the fraction-of-peak
+        # DELTA (did the tier buy real throughput or just a lower roof?)
+        # is the headline the record calls out
+        try:
+            cost = engine.program_cost(rows)
+            med = dev_stats.get(str(cost["bucket"]),
+                                {}).get("device_s_median")
+            if med and cost.get("flops"):
+                level["roofline"] = _perf.roofline(
+                    cost["flops"], cost.get("bytes_accessed"), med,
+                    precision=tier)
+        except Exception as e:  # orp: noqa[ORP009] -- degradation recorded: the error lands in the record's roofline field
+            level["roofline"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        tiers.append(level)
+
+    # -- the promotion drill: tiers promote through the quality band ------
+    spec = getattr(policy, "validation", None)
+    drill = []
+    probe = feats[:64]
+    with ServeHost(max_live_engines=2) as host:
+        host.add_tenant("bench", policy)
+        host.evaluate("bench", 0, probe)  # activate the f32 incumbent
+        for tier in [t for t in TIERS if t != "f32"]:
+            # 1) the bitwise route must REFUSE a tier change outright
+            try:
+                host.reload_tenant("bench", precision=tier)
+                obs.count("quality/gate_trip", gate="precision_refusal")
+                raise RuntimeError(
+                    f"tier promotion to {tier!r} passed under "
+                    "require_same_bits=True — different bits by "
+                    "construction should make that impossible; the "
+                    "refusal gate regressed, do not commit this record")
+            except ValueError:
+                pass  # the documented refusal — the supported route below
+            # 2) the guarded route: paired-RQMC quality band vs the f32
+            #    incumbent (skipped only when the bundle bakes no
+            #    validation set — recorded, never silent)
+            if spec is None:
+                drill.append({"tier": tier, "outcome": "skipped",
+                              "why": "policy bakes no validation set",
+                              "refused_under_bitwise": True})
+                continue
+            try:
+                out = host.reload_tenant(
+                    "bench", require_same_bits=False,
+                    quality_band=quality_band, precision=tier)
+                drill.append({
+                    "tier": tier, "outcome": "promoted",
+                    "refused_under_bitwise": True,
+                    "version": out["version"],
+                    "quality_band": quality_band,
+                    "regression": out["quality"]["regression"],
+                })
+            except CanaryRejected as e:
+                # a reject is a legitimate drill verdict — the band did
+                # its job; the record carries it instead of hiding it
+                drill.append({"tier": tier, "outcome": "rejected",
+                              "refused_under_bitwise": True,
+                              "quality_band": quality_band,
+                              "why": str(e)[:200]})
+                continue
+            # demote back so the NEXT tier is judged against the f32
+            # incumbent, not the previous tier's candidate
+            host.reload_tenant("bench", require_same_bits=False,
+                               quality_band=quality_band, precision="f32")
+    f32 = next(lv for lv in tiers if lv["tier"] == "f32")
+    return {
+        "rows": int(rows),
+        "quality_band": float(quality_band),
+        "tiers": tiers,
+        "speedup_vs_f32": {
+            lv["tier"]: round(lv["rows_per_s"]
+                              / max(f32["rows_per_s"], 1e-9), 2)
+            for lv in tiers if lv["tier"] != "f32"
+        },
+        "promotion_drill": drill,
+    }
+
+
+def _megakernel_phase(policy, *, rows: int, repeats: int, seed: int) -> dict:
+    """The mixed-date megakernel A/B (rides ``--precision``): one block of
+    ``rows`` rows whose rebalance dates cycle the whole walk, served by
+    both arms —
+
+    - **off** — :func:`orp_tpu.serve.megakernel.loop_of_buckets`: one
+      bucketed engine dispatch per DISTINCT date, rows scattered back (the
+      fragmentation baseline the kernel replaces);
+    - **on**  — ``engine.evaluate_mixed_async``: the whole block in ONE
+      device program (per-row per-date head-parameter gather inside the
+      kernel).
+
+    The f32 arms are pinned BITWISE against each other (the lowering-
+    equivalence contract tests/test_megakernel.py pins per-op; the phase
+    RAISES on a flipped bit), and the record carries the dispatch-count
+    collapse (n_dates -> 1) next to the measured speedup."""
+    from orp_tpu.serve.megakernel import loop_of_buckets
+
+    engine = HedgeEngine(policy)
+    nf = engine.model.n_features
+    rng = np.random.default_rng(seed)
+    feats = (1.0 + 0.1 * rng.standard_normal((rows, nf))
+             ).astype(np.float32)
+    dates = (np.arange(rows, dtype=np.int32) % engine.n_dates)
+    rng.shuffle(dates)
+    bucket = engine.bucket_for(rows)
+    engine.prewarm([bucket])
+    # untimed first touches: the off arm's per-date buckets are already
+    # prewarmed; the on arm compiles its mixed bucket here
+    off_phi, off_psi, _ = loop_of_buckets(engine, dates, feats)
+    on_phi, on_psi, _ = engine.evaluate_mixed_async(dates, feats).result()
+    bitwise = bool(np.array_equal(on_phi, off_phi)
+                   and np.array_equal(on_psi, off_psi))
+    if not bitwise:
+        obs.count("quality/gate_trip", gate="megakernel_bitwise")
+        raise RuntimeError(
+            "megakernel served different BITS than the loop-of-buckets "
+            "path at f32 — the fused arm must be a pure fusion, not a "
+            "reassociation; do not commit this record")
+    off_rates, on_rates = [], []
+    for _ in range(max(1, int(repeats))):
+        t0 = time.perf_counter()
+        loop_of_buckets(engine, dates, feats)
+        t1 = time.perf_counter()
+        engine.evaluate_mixed_async(dates, feats).result()
+        t2 = time.perf_counter()
+        off_rates.append(rows / (t1 - t0))
+        on_rates.append(rows / (t2 - t1))
+    off = _perf.summarize_repeats(off_rates)
+    on = _perf.summarize_repeats(on_rates)
+    return {
+        "rows": int(rows),
+        "distinct_dates": int(len(np.unique(dates))),
+        "repeats": on["repeats"],
+        "off_rows_per_s": round(off["median"], 1),
+        "off_rows_per_s_iqr": round(off["iqr"], 1),
+        "on_rows_per_s": round(on["median"], 1),
+        "on_rows_per_s_iqr": round(on["iqr"], 1),
+        "dispatches_off": int(len(np.unique(dates))),
+        "dispatches_on": 1,
+        "speedup": round(on["median"] / max(off["median"], 1e-9), 2),
+        "bitwise_equal": True,  # the gate above raised otherwise
+    }
+
+
+def _ragged_phase(policy, *, repeats: int, seed: int,
+                  counts=(520, 130, 17), max_wait_us: float = 2000.0) -> dict:
+    """The ragged-vs-pow2 batching A/B (rides ``--precision``): the same
+    burst of coalescible blocks (``counts`` rows each, one date) through
+    two batchers —
+
+    - **pow2**   — the default planner-less batcher: coalesced runs
+      dispatch at the next power-of-two bucket, padding billed in full;
+    - **ragged** — ``MicroBatcher(ragged=True)``: the pad-waste-aware
+      ``BucketPlanner`` partitions coalesced runs and splits oversize
+      blocks when the measured (or proxied) cost says padding loses.
+
+    Bits are pinned BITWISE across the arms per block (splitting a
+    dispatch must never change a row's answer), the pad-waste collapse is
+    read from the ``serve/pad_waste_rows`` counter each arm actually
+    billed (the ``orp top`` metric, not a model of it), and the wall-clock
+    medians ride alongside — the planner's decisions are judged on the
+    metric it optimises."""
+    from orp_tpu.obs.sink import ListSink
+
+    engine = HedgeEngine(policy)
+    nf = engine.model.n_features
+    rng = np.random.default_rng(seed)
+    blocks = [(1.0 + 0.1 * rng.standard_normal((int(c), nf)))
+              .astype(np.float32) for c in counts]
+    total = int(sum(counts))
+    # prewarm every bucket either arm can reach: the pow2 run's coalesced
+    # bucket down to the planner's smallest split chunk
+    sizes, b = [], engine.min_bucket
+    while b <= engine.bucket_for(total):
+        sizes.append(b)
+        b *= 2
+    engine.prewarm(sizes)
+    ref = [engine.evaluate(0, blk) for blk in blocks]
+
+    def run_arm(ragged: bool) -> dict:
+        rates, waste = [], None
+        for _ in range(max(1, int(repeats))):
+            with obs.suspended(), obs.active(sink=ListSink()):
+                with MicroBatcher(engine, max_batch=1 << 14,
+                                  max_wait_us=max_wait_us,
+                                  coalesce_blocks=True,
+                                  ragged=ragged) as mb:
+                    t0 = time.perf_counter()
+                    futures = [mb.submit_block(0, blk) for blk in blocks]
+                    results = [f.result(timeout=120) for f in futures]
+                    wall = time.perf_counter() - t0
+                # every draw bills the identical pad rows (the schedule is
+                # deterministic, the session registry fresh per draw):
+                # read THIS draw's counter, the rows the engine actually
+                # billed — not a model of them
+                waste = int(obs.state().registry.counter(
+                    "serve/pad_waste_rows").value)
+            rates.append(total / wall)
+            for r, (pphi, ppsi, _pv) in zip(results, ref):
+                if not (np.array_equal(r.phi, pphi)
+                        and np.array_equal(r.psi, ppsi)):
+                    obs.count("quality/gate_trip", gate="ragged_bitwise")
+                    raise RuntimeError(
+                        f"{'ragged' if ragged else 'pow2'} arm served "
+                        "different BITS than a direct engine evaluation "
+                        "— splitting a dispatch changed an answer; do "
+                        "not commit this record")
+        s = _perf.summarize_repeats(rates)
+        return {"rows_per_s": round(s["median"], 1),
+                "rows_per_s_iqr": round(s["iqr"], 1),
+                "repeats": s["repeats"],
+                "pad_waste_rows": waste}
+
+    pow2 = run_arm(False)
+    ragged = run_arm(True)
+    if ragged["pad_waste_rows"] > pow2["pad_waste_rows"]:
+        obs.count("quality/gate_trip", gate="ragged_pad_waste")
+        raise RuntimeError(
+            f"ragged planner INCREASED pad waste: "
+            f"{ragged['pad_waste_rows']} rows vs the pow2 baseline's "
+            f"{pow2['pad_waste_rows']} — the planner optimises the metric "
+            "it just regressed; do not commit this record")
+    return {
+        "counts": [int(c) for c in counts],
+        "rows": total,
+        "pow2": pow2,
+        "ragged": ragged,
+        "pad_waste_saved_rows": (pow2["pad_waste_rows"]
+                                 - ragged["pad_waste_rows"]),
+        "speedup": round(ragged["rows_per_s"]
+                         / max(pow2["rows_per_s"], 1e-9), 2),
+        "bitwise_equal": True,  # the per-block pin raised otherwise
+    }
+
+
+# Phase evidence is sticky across re-runs. A serve-bench invocation only
+# re-measures the phases it was asked to run (``--ingest``, ``--fleet``,
+# ``--precision``, ...), so any block absent from THIS run — and its
+# derived headline scalars — is carried forward from ``previous`` instead
+# of silently vanishing from the committed record. Same discipline as the
+# sticky ``batcher_before``: a re-run overwrites only what it regenerated.
+STICKY_PHASES: dict[str, tuple[str, ...]] = {
+    "ingest": ("ingest_rows_per_s", "submit_ns_per_row",
+               "shm_ns_per_row", "shm_rows_per_s"),
+    "fleet": ("fleet_rows_per_s", "fleet_p99_ms", "fleet_mttr_ms"),
+    "gateway_drill": ("mttr_ms",),
+    "density": ("density_tenants", "density_cold_p99_ms",
+                "density_warm_activation_ms", "density_dedup_ratio",
+                "density_tenants_within_budget"),
+    "pilot": ("pilot_rows_lost", "pilot_time_to_promote_s"),
+    "degrade": ("mttr_ms",),
+    "mesh_sweep": (),
+    "quality": (),
+    "trace_overhead_pct": (),
+    "drift_overhead_pct": (),
+    "profile_overhead_pct": (),
+    "precision_tiers": ("precision_rows_per_s", "precision_fraction_of_peak",
+                        "precision_fraction_of_peak_delta"),
+    "megakernel": ("megakernel_speedup",),
+    "ragged": ("pad_waste_saved_rows",),
+}
+
+
 def serve_bench(
     policy,
     *,
@@ -1844,6 +2197,11 @@ def serve_bench(
     density_budget_ms: float = 500.0,
     pilot: bool = False,
     pilot_quick: bool = False,
+    precision: bool = False,
+    precision_rows: int = 4096,
+    precision_quality_band: float = 0.05,
+    megakernel_rows: int = 2048,
+    ragged_counts: tuple[int, ...] = (520, 130, 17),
     repeats: int = DEFAULT_REPEATS,
     previous: dict | None = None,
 ) -> dict:
@@ -1905,8 +2263,22 @@ def serve_bench(
     any of those contracts is violated. ``pilot_quick`` shrinks the drill
     to tier-1 smoke size. Headlines ``pilot_time_to_promote_s`` /
     ``pilot_rows_lost`` ride first-class.
+    ``precision=True`` (CLI ``--precision``) appends the raw-speed matrix
+    of this serving tier's three attacks: the precision-tier sweep
+    (:func:`_precision_phase` — per-tier rows/s with BANDED accuracy pins
+    and the quality-banded ``reload_tenant`` promotion drill), the
+    mixed-date megakernel A/B (:func:`_megakernel_phase` — fused single
+    dispatch vs loop-of-buckets, f32 pinned BITWISE), and the
+    ragged-vs-pow2 batching A/B (:func:`_ragged_phase` — measured
+    ``serve/pad_waste_rows`` collapse at bitwise-equal served bits).
+    Headlines ``megakernel_speedup`` / ``pad_waste_saved_rows`` /
+    ``precision_rows_per_s`` ride first-class; every phase RAISES on a
+    violated pin, so the record cannot lie.
     ``previous`` (the last record, CLI-loaded from ``--out``) carries the
-    synchronous-tier baseline forward as ``batcher_before``."""
+    synchronous-tier baseline forward as ``batcher_before``, and any phase
+    block this invocation did not re-measure (:data:`STICKY_PHASES`)
+    forward verbatim — a re-run only overwrites the evidence it
+    regenerates, never silently drops another round's."""
     engine = HedgeEngine(policy, mesh=mesh)
     n_features = engine.model.n_features
     rng = np.random.default_rng(seed)
@@ -2119,6 +2491,35 @@ def serve_bench(
                 f"resume_bits_equal={pl['resume']['bits_equal']} "
                 f"drift_trips={pl['drift_trips']} — the closed loop "
                 "regressed; do not commit this record")
+    if precision:
+        pr = _precision_phase(policy, rows=precision_rows, repeats=repeats,
+                              seed=seed,
+                              quality_band=precision_quality_band)
+        record["precision_tiers"] = pr
+        mk = _megakernel_phase(policy, rows=megakernel_rows,
+                               repeats=repeats, seed=seed)
+        record["megakernel"] = mk
+        rg = _ragged_phase(policy, repeats=repeats, seed=seed,
+                           counts=ragged_counts)
+        record["ragged"] = rg
+        # the raw-speed headlines, first-class like p99/mttr: per-tier
+        # rows/s, the fused-dispatch speedup, and the padding rows the
+        # ragged planner stopped billing — with the roofline fraction
+        # delta each tier bought (priced at the TIER's peak, so a tier
+        # that only lowered the roof reads honestly)
+        record["precision_rows_per_s"] = {
+            lv["tier"]: lv["rows_per_s"] for lv in pr["tiers"]}
+        fracs = {lv["tier"]: lv["roofline"].get("frac_peak_flops")
+                 for lv in pr["tiers"]
+                 if isinstance(lv.get("roofline"), dict)
+                 and "error" not in lv["roofline"]}
+        if "f32" in fracs and fracs["f32"]:
+            record["precision_fraction_of_peak"] = fracs
+            record["precision_fraction_of_peak_delta"] = {
+                t: round(f - fracs["f32"], 4)
+                for t, f in fracs.items() if t != "f32" and f is not None}
+        record["megakernel_speedup"] = mk["speedup"]
+        record["pad_waste_saved_rows"] = rg["pad_waste_saved_rows"]
     if ingest:
         ing = _ingest_phase(policy, rows=ingest_rows,
                             block_sizes=ingest_block_sizes, seed=seed,
@@ -2199,6 +2600,17 @@ def serve_bench(
             if prev_rps and sweep:
                 record["batcher_speedup_vs_sync"] = round(
                     best["requests_per_s"] / prev_rps, 2)
+        # phase blocks this run did not re-measure stay on the record —
+        # a --precision re-run must not erase the ingest/fleet/density/...
+        # evidence an earlier round committed (and vice versa)
+        for block, derived in STICKY_PHASES.items():
+            if block in record or block not in previous:
+                continue
+            record[block] = previous[block]
+            record.setdefault("carried_forward", []).append(block)
+            for k in derived:
+                if k in previous and k not in record:
+                    record[k] = previous[k]
     import jax
 
     record["platform"] = jax.default_backend()
@@ -2224,8 +2636,16 @@ def ledger_records(record: dict) -> list[dict]:
     headline phase that carries a repeats/median/IQR triple (sweep
     sustained req/s, ingest submit ns/row + rows/s, drill MTTR). The
     fingerprint binds each row to the benched configuration, so
-    ``orp perf-gate`` only ever compares like with like."""
+    ``orp perf-gate`` only ever compares like with like. Phase blocks the
+    record merely carried forward from a previous run (``carried_forward``)
+    seed NOTHING — their rows already exist in the ledger at the wall time
+    they were actually measured."""
     out: list[dict] = []
+    carried = set(record.get("carried_forward", ()))
+
+    def fresh(name: str):
+        return None if name in carried else record.get(name)
+
     cfg = {"n_dates": record.get("n_dates"),
            "mesh_devices": record.get("mesh_devices"),
            "policy": record.get("policy")}
@@ -2253,7 +2673,7 @@ def ledger_records(record: dict) -> list[dict]:
                     # this fingerprint exists to close
                     "requests": max(r["requests"] for r in sweep)},
                 extra={"winning_concurrency": best["concurrency"]}))
-    ing = record.get("ingest")
+    ing = fresh("ingest")
     if ing:
         best = max(ing["columnar"], key=lambda c: c["block"])
         fp = {**cfg, "rows": ing["rows"], "block": best["block"]}
@@ -2268,7 +2688,7 @@ def ledger_records(record: dict) -> list[dict]:
                 repeats=best["repeats"], median=best["ingest_rows_per_s"],
                 iqr=best.get("ingest_rows_per_s_iqr", 0.0), unit="rows/s",
                 direction="higher", fingerprint_extra=fp))
-    fl = record.get("fleet")
+    fl = fresh("fleet")
     if fl:
         fp_fleet = {**cfg,
                     "replica_counts": fl["replica_counts"],
@@ -2308,7 +2728,7 @@ def ledger_records(record: dict) -> list[dict]:
             fingerprint_extra={**cfg, "rows": ing["rows"],
                                "block": shm_best["block"],
                                "lane": "shm"}))
-    dn = record.get("density")
+    dn = fresh("density")
     if dn:
         fp_density = {**cfg, "tenants": dn["tenants"], "rows": dn["rows"],
                       "max_live": dn["max_live_engines"]}
@@ -2331,7 +2751,7 @@ def ledger_records(record: dict) -> list[dict]:
                 fingerprint_extra=fp_density,
                 extra={"p99_ms": cold["p99_ms"],
                        "dedup_ratio": dn["dedup_ratio"]}))
-    pl = record.get("pilot")
+    pl = fresh("pilot")
     if pl:
         # one promote cycle per record: the history accumulates the
         # repeats, the fingerprint binds the drill shape (quick and full
@@ -2347,7 +2767,47 @@ def ledger_records(record: dict) -> list[dict]:
             extra={"rows_lost": pl["rows_lost"],
                    "resume_wall_s": pl["resume"]["wall_s"],
                    "drift_trips": pl["drift_trips"]}))
-    drill = record.get("gateway_drill")
+    pr = fresh("precision_tiers")
+    if pr:
+        # one row per tier, the tier IN the fingerprint: f32 and bf16
+        # histories must never pool (a tier is a different experiment,
+        # not a noisy draw of the same one)
+        for lv in pr["tiers"]:
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", "precision_rows_per_s",
+                repeats=lv["repeats"], median=lv["rows_per_s"],
+                iqr=lv.get("rows_per_s_iqr", 0.0), unit="rows/s",
+                direction="higher",
+                fingerprint_extra={**cfg, "rows": lv["rows"],
+                                   "tier": lv["tier"]},
+                extra={"max_abs_dphi_vs_f32": lv["max_abs_dphi_vs_f32"],
+                       "band": lv["band"]}))
+    mk = fresh("megakernel")
+    if mk:
+        # BOTH arms bind to the same swept-experiment fingerprint (the
+        # sweep-phase lesson): a regression that flips which arm wins
+        # lands in one history and trips the gate
+        fp_mk = {**cfg, "rows": mk["rows"],
+                 "distinct_dates": mk["distinct_dates"]}
+        for arm in ("on", "off"):
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", f"megakernel_{arm}_rows_per_s",
+                repeats=mk["repeats"], median=mk[f"{arm}_rows_per_s"],
+                iqr=mk.get(f"{arm}_rows_per_s_iqr", 0.0), unit="rows/s",
+                direction="higher", fingerprint_extra=fp_mk,
+                extra={"speedup": mk["speedup"],
+                       "bitwise_equal": mk["bitwise_equal"]}))
+    rg = fresh("ragged")
+    if rg:
+        fp_rg = {**cfg, "counts": rg["counts"]}
+        for arm in ("ragged", "pow2"):
+            out.append(_perf.make_record_from_summary(
+                "serve_bench", f"ragged_{arm}_rows_per_s",
+                repeats=rg[arm]["repeats"], median=rg[arm]["rows_per_s"],
+                iqr=rg[arm].get("rows_per_s_iqr", 0.0), unit="rows/s",
+                direction="higher", fingerprint_extra={**fp_rg, "arm": arm},
+                extra={"pad_waste_rows": rg[arm]["pad_waste_rows"]}))
+    drill = fresh("gateway_drill")
     if drill and drill.get("mttr_ms") is not None and drill.get("mttr_runs"):
         out.append(_perf.make_record_from_summary(
             "serve_bench", "gateway_drill_mttr_ms",

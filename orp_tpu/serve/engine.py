@@ -40,6 +40,12 @@ from orp_tpu.obs import count as obs_count
 from orp_tpu.obs import devprof as _devprof
 from orp_tpu.obs import enabled as obs_enabled
 from orp_tpu.obs import span as obs_span
+from orp_tpu.serve.precision import (
+    dequantize_params,
+    eval_model,
+    normalize_precision,
+    prepare_params,
+)
 from orp_tpu.train.backward import _date_outputs_core, _split_holdings
 from orp_tpu.utils.profiling import trace
 
@@ -50,35 +56,57 @@ def span(name, attrs=None):
     return obs_span(name, attrs) if obs_enabled() else trace(name)
 
 
-@functools.partial(jax.jit, static_argnames=("model", "dual_mode", "holdings_combine"))
+@functools.partial(jax.jit, static_argnames=("model", "dual_mode",
+                                             "holdings_combine", "precision"))
 def _eval_core(model, p1_all, p2_all, date_idx, feats, prices,
-               cost_of_capital, *, dual_mode, holdings_combine):
+               cost_of_capital, *, dual_mode, holdings_combine,
+               precision="f32"):
     """One bucket-shaped executable: gather the date's params, run the
     training walk's fused per-date outputs. ``date_idx`` is traced — one
-    compile covers every rebalance date at this bucket size."""
+    compile covers every rebalance date at this bucket size.
+
+    ``precision`` (static) selects the serving tier (serve/precision.py):
+    ``f32`` traces exactly the historical program, ``int8`` dequantizes
+    the gathered weights back to f32 before the (f32-accumulate) forward,
+    ``bf16`` runs the tier-replaced model end to end and casts the
+    outputs back to f32 at the boundary — the serve API dtype is
+    tier-invariant."""
     p1 = jax.tree.map(lambda x: x[date_idx], p1_all)
     p2 = jax.tree.map(lambda x: x[date_idx], p2_all)
+    if precision == "int8":
+        p1 = dequantize_params(p1)
+        p2 = dequantize_params(p2)
+    m = eval_model(model, precision)
     # shared-mode g_pre collapses to the stored (post-quantile) weights'
     # value — the replay semantics (train/replay.py docstring), the only
     # ones reconstructible from per-date snapshots
     g_pre = (
-        model.value(p1, feats, prices)
-        if dual_mode == "shared" else jnp.zeros((), model.dtype)
+        m.value(p1, feats, prices)
+        if dual_mode == "shared" else jnp.zeros((), m.dtype)
     )
     v, comb, _ = _date_outputs_core(
-        model, p1, p2, feats, prices,
-        jnp.zeros_like(prices), jnp.zeros(feats.shape[:1], model.dtype),
+        m, p1, p2, feats, prices,
+        jnp.zeros_like(prices), jnp.zeros(feats.shape[:1], m.dtype),
         cost_of_capital, g_pre,
         dual_mode=dual_mode, holdings_combine=holdings_combine,
     )
     phi, psi = _split_holdings(comb)
+    if precision == "bf16":
+        phi = phi.astype(jnp.float32)
+        psi = psi.astype(jnp.float32)
+        v = v.astype(jnp.float32)
     return phi, psi, v
 
 
 def next_bucket(n: int, *, min_bucket: int = 8) -> int:
-    """Smallest power-of-two >= n, floored at ``min_bucket``."""
+    """Smallest power-of-two >= n, floored at ``min_bucket``. Empty
+    batches never reach bucketing: ``HedgeEngine.evaluate_async``
+    short-circuits ``n == 0`` before dispatch (an all-padding bucket
+    would bill a full device execute for zero rows)."""
     if n < 1:
-        raise ValueError(f"batch of {n} rows")
+        raise ValueError(
+            f"batch of {n} rows never dispatches — empty requests "
+            "short-circuit in evaluate_async before bucketing")
     return max(min_bucket, 1 << (n - 1).bit_length())
 
 
@@ -168,7 +196,7 @@ class HedgeEngine:
 
     def __init__(self, policy, *, min_bucket: int = 8, max_bucket: int = 1 << 20,
                  use_aot: bool = True, aot_failure_threshold: int = 3,
-                 mesh=None):
+                 mesh=None, precision="f32"):
         model = getattr(policy, "model", None)
         if model is None:
             raise ValueError(
@@ -193,16 +221,27 @@ class HedgeEngine:
             self._rep = replicated_sharding(self.mesh)
         else:
             self._rows = self._rep = None
+        # precision tier (serve/precision.py): f32 prepared params are the
+        # historical asarray(model.dtype) cast — byte-identical serving;
+        # bf16/int8 transform the stacks ONCE here, off the hot path
+        self.precision = normalize_precision(precision)
+        self._eval_dt = self.precision.eval_dtype(model)
+        self._np_dt = np.dtype(jnp.dtype(self._eval_dt))
         put = (
-            (lambda x: jnp.asarray(x, model.dtype)) if self.mesh is None
+            (lambda x: x) if self.mesh is None
             # replicate the per-date params across the mesh ONCE here — the
             # sharded eval program reads them collective-free on every shard
-            else (lambda x: jax.device_put(jnp.asarray(x, model.dtype),
-                                           self._rep))
+            # (tier-preserving: the prepared leaves already carry their
+            # tier's dtype, int8 included)
+            else (lambda x: jax.device_put(x, self._rep))
         )
+        tier = self.precision.tier
         # device-resident once; every request indexes into these
-        self._p1 = jax.tree.map(put, bw.params1_by_date)
-        p2 = bw.params2_by_date
+        self._p1 = jax.tree.map(
+            put, prepare_params(bw.params1_by_date, tier,
+                                model_dtype=model.dtype))
+        p2 = prepare_params(bw.params2_by_date, tier,
+                            model_dtype=model.dtype)
         self._p2 = self._p1 if p2 is None else jax.tree.map(put, p2)
         self.n_dates = int(jax.tree.leaves(self._p1)[0].shape[0])
         # price legs per request row (risky legs then bond) — the one
@@ -213,6 +252,9 @@ class HedgeEngine:
         self.misses = 0
         self.aot_hits = 0
         self._buckets: set[int] = set()
+        # mixed-date megakernel executables key the same bucket sizes but
+        # are distinct programs — separate first-touch accounting
+        self._mixed_buckets: set[int] = set()
         # deserialized per-bucket executables from an --aot bundle: requests
         # in these buckets never touch the jit cache (load_aot returns {} —
         # after ONE warning — when the artifacts don't fit this process)
@@ -235,13 +277,14 @@ class HedgeEngine:
                 aot_dir,
                 policy_fingerprint=getattr(policy, "fingerprint", None),
                 mesh=self.mesh,
+                precision=self.precision.tier,
             ) or {}
         # constants of the AOT calling convention, hoisted off the hot path:
         # the flat (p1, p2) leaves (tuple flatten = concatenated child
         # flattens, so appending the per-request arrays reproduces the full
         # jit argument order) and the cost-of-capital scalar
         self._flat_params = jax.tree.leaves((self._p1, self._p2))
-        self._coc = jnp.asarray(self.cost_of_capital, model.dtype)
+        self._coc = jnp.asarray(self.cost_of_capital, self._eval_dt)
         if self.mesh is not None:
             self._coc = jax.device_put(self._coc, self._rep)
         # XLA-compile baseline for THIS engine: `_eval_core`'s executable
@@ -276,6 +319,7 @@ class HedgeEngine:
         return {
             "hits": self.hits,
             "misses": self.misses,
+            "precision": self.precision.tier,
             "mesh_devices": 1 if self.mesh is None else int(self.mesh.devices.size),
             "buckets": sorted(self._buckets),
             "aot_buckets": sorted(self._aot),
@@ -297,7 +341,7 @@ class HedgeEngine:
         from orp_tpu.aot.compile import cost_summary
 
         b = self.bucket_for(n_rows)
-        dt = self.model.dtype
+        dt = self._eval_dt
         sds = jax.ShapeDtypeStruct
         lowered = _eval_core.lower(
             self.model, self._p1, self._p2, sds((), jnp.int32),
@@ -305,6 +349,7 @@ class HedgeEngine:
             sds((b, self.n_instruments), dt), self._coc,
             dual_mode=self.dual_mode,
             holdings_combine=self.holdings_combine,
+            precision=self.precision.tier,
         )
         return {"bucket": b, **cost_summary(lowered.compile())}
 
@@ -381,6 +426,12 @@ class HedgeEngine:
                     f"prices shape {prices.shape} != {(n, k)} "
                     "(risky legs then bond, one row per state)"
                 )
+        if n == 0:
+            # empty request: short-circuit BEFORE bucketing — an
+            # all-padding bucket would bill a full device execute (and a
+            # possible compile) for zero rows. No counters move: nothing
+            # was dispatched.
+            return self._empty_pending(has_prices)
         b = self.bucket_for(n)
         aot_ex = self._aot.get(b)
         # categorize now, RECORD after the dispatch succeeds: a failed
@@ -389,7 +440,7 @@ class HedgeEngine:
         # overstate traffic by one per retry)
         bucket_kind = ("hit" if b in self._buckets
                        else "aot_warm" if aot_ex is not None else "miss")
-        dt = np.dtype(jnp.dtype(self.model.dtype).name)
+        dt = self._np_dt
         with span("serve/pad"):
             # block-shaped fast path: a request already AT its bucket size
             # in the serve dtype (the columnar ingest lane's usual shape —
@@ -447,6 +498,11 @@ class HedgeEngine:
             self._buckets.add(b)
             obs_count("serve/bucket_misses", bucket=str(b))
         obs_count("serve/rows", n, sink_event=False)
+        if b > n:
+            # first-class pad-waste accounting: the rows this dispatch
+            # billed the device for but carried no request data (orp top's
+            # pad-waste column; the ragged planner's objective)
+            obs_count("serve/pad_waste_rows", b - n, sink_event=False)
         prof = _devprof.active()
         if prof is None:
             return PendingEval(phi, psi, v, n, has_prices, b)
@@ -455,14 +511,112 @@ class HedgeEngine:
         return PendingEval(phi, psi, v, n, has_prices, b, prof,
                            time.perf_counter())
 
+    @staticmethod
+    def _empty_pending(has_prices: bool) -> PendingEval:
+        """The n=0 result: zero-row host arrays, bucket 0, no dispatch.
+        ``PendingEval.result`` passes numpy through ``block_until_ready``
+        unchanged, so the empty pending walks the normal result path."""
+        z = np.zeros(0, np.float32)
+        return PendingEval(z, z, z, 0, has_prices, 0)
+
+    def evaluate_mixed_async(self, dates, states, prices=None) -> PendingEval:
+        """Mixed-date dispatch: ``dates`` is one int per ROW, and the whole
+        block executes as ONE device program (the Pallas mixed-date
+        megakernel, serve/megakernel.py) instead of fragmenting into one
+        bucketed dispatch per distinct date. f32 results are bitwise the
+        loop-of-buckets path's (pinned in tests); counters mirror
+        ``evaluate_async`` plus ``serve/megakernel_dispatches``."""
+        if self.mesh is not None:
+            raise ValueError(
+                "mixed-date megakernel serves single-device engines; "
+                "mesh engines keep the per-date bucketed path")
+        states = np.asarray(states)
+        if states.ndim == 1:
+            states = states[None, :]
+        n, f = states.shape
+        if f != self.model.n_features:
+            raise ValueError(
+                f"states have {f} features; this policy was trained on "
+                f"{self.model.n_features}"
+            )
+        dates = np.asarray(dates, np.int32).reshape(-1)
+        if dates.shape[0] != n:
+            raise ValueError(
+                f"dates has {dates.shape[0]} entries for {n} rows "
+                "(one rebalance-date index per row)")
+        if n and not ((-self.n_dates <= dates) & (dates < self.n_dates)).all():
+            raise IndexError(
+                f"date indices out of range for {self.n_dates} dates")
+        dates = dates % self.n_dates if n else dates
+        has_prices = prices is not None
+        k = self.n_instruments
+        if has_prices:
+            prices = np.asarray(prices)
+            if prices.ndim == 1:
+                prices = prices[None, :]
+            if prices.shape != (n, k):
+                raise ValueError(
+                    f"prices shape {prices.shape} != {(n, k)} "
+                    "(risky legs then bond, one row per state)"
+                )
+        if n == 0:
+            return self._empty_pending(has_prices)
+        b = self.bucket_for(n)
+        hit = b in self._mixed_buckets
+        dt = self._np_dt
+        with span("serve/pad"):
+            feats = np.zeros((b, f), dt)
+            feats[:n] = states
+            pr = np.zeros((b, k), dt)
+            if has_prices:
+                pr[:n] = prices
+            dcol = np.zeros(b, np.int32)
+            dcol[:n] = dates  # padded rows gather date 0: discarded at unpad
+        with span("serve/dispatch", attrs={"bucket": b, "mixed": True}):
+            phi, psi, v = self._mixed_eval(dcol, feats, pr)
+        if hit:
+            self.hits += 1
+            obs_count("serve/bucket_hits", sink_event=False)
+        else:
+            self.misses += 1
+            self._mixed_buckets.add(b)
+            obs_count("serve/bucket_misses", bucket=str(b), mixed="1")
+        obs_count("serve/rows", n, sink_event=False)
+        obs_count("serve/megakernel_dispatches", sink_event=False)
+        if b > n:
+            obs_count("serve/pad_waste_rows", b - n, sink_event=False)
+        prof = _devprof.active()
+        if prof is None:
+            return PendingEval(phi, psi, v, n, has_prices, b)
+        return PendingEval(phi, psi, v, n, has_prices, b, prof,
+                           time.perf_counter())
+
+    def _mixed_eval(self, dates, feats, pr):
+        """One fused mixed-date dispatch (lazy import: the megakernel pulls
+        jax.experimental.pallas, which bucketed-only servers never pay)."""
+        from orp_tpu.serve.megakernel import _eval_core_mixed, use_interpret
+
+        return _eval_core_mixed(
+            self.model, self._p1, self._p2,
+            jnp.asarray(dates, jnp.int32),
+            jnp.asarray(feats, self._eval_dt),
+            jnp.asarray(pr, self._eval_dt), self._coc,
+            dual_mode=self.dual_mode,
+            holdings_combine=self.holdings_combine,
+            precision=self.precision.tier,
+            interpret=use_interpret(),
+        )
+
     def _jit_eval(self, idx: int, feats, pr):
         """The always-correct jit path: one bucket-shaped ``_eval_core``
         dispatch (compiles on the bucket's first jit touch)."""
         return _eval_core(
             self.model, self._p1, self._p2, jnp.asarray(idx, jnp.int32),
-            jnp.asarray(feats), jnp.asarray(pr), self._coc,
+            jnp.asarray(feats, self._eval_dt),
+            jnp.asarray(pr, self._eval_dt), self._coc,
             dual_mode=self.dual_mode,
             holdings_combine=self.holdings_combine,
+            precision=self.precision.tier,
         )
 
     def _dispatch_aot(self, aot_ex, b: int, idx: int, feats, pr, inj):
@@ -480,7 +634,8 @@ class HedgeEngine:
                 # the same program the jit path would compile, minus the
                 # compile
                 flat = [*self._flat_params, jnp.asarray(idx, jnp.int32),
-                        jnp.asarray(feats), jnp.asarray(pr), self._coc]
+                        jnp.asarray(feats, self._eval_dt),
+                        jnp.asarray(pr, self._eval_dt), self._coc]
                 out = aot_ex.call_flat(flat)
             else:
                 # pickle codec (mesh topologies): a sharding-aware Compiled
@@ -488,7 +643,8 @@ class HedgeEngine:
                 # _jit_eval would pass them
                 out = aot_ex.compiled(
                     self._p1, self._p2, jnp.asarray(idx, jnp.int32),
-                    jnp.asarray(feats), jnp.asarray(pr), self._coc)
+                    jnp.asarray(feats, self._eval_dt),
+                    jnp.asarray(pr, self._eval_dt), self._coc)
         except Exception as e:  # noqa: BLE001 — counted, breakered, fallen back
             obs_count("guard/aot_exec_failure", bucket=str(b))
             if self._breaker.record_failure(b):
@@ -541,7 +697,7 @@ class HedgeEngine:
         engine this is a cheap executable shakeout. Returns ``cache_info()``
         — after a prewarm covering the traffic's sizes, ``misses`` stops
         moving for good."""
-        dt = np.dtype(jnp.dtype(self.model.dtype).name)
+        dt = self._np_dt
         # dedupe by TARGET bucket but evaluate the requested row count: on a
         # non-power-of-two mesh the padded bucket is itself not a bucket
         # boundary (bucket_for(18) == 33 on a 3-mesh), so evaluating b rows

@@ -120,9 +120,10 @@ class _Tenant:
     __slots__ = ("name", "source", "policy", "max_pending", "slo",
                  "engine", "batcher", "metrics", "pending", "activations",
                  "last_used", "build_lock", "in_submit", "version",
-                 "drift", "drift_band", "warm")
+                 "drift", "drift_band", "warm", "precision")
 
-    def __init__(self, name, source, policy, max_pending, slo, drift_band):
+    def __init__(self, name, source, policy, max_pending, slo, drift_band,
+                 precision=None):
         self.name = name
         self.source = source          # bundle dir (str/Path) or policy object
         self.warm = None              # warm tier: the DESERIALIZED policy,
@@ -145,6 +146,10 @@ class _Tenant:
         # traffic, not one engine incarnation
         self.drift = None
         self.drift_band = drift_band
+        # serving precision tier (serve/precision.py): None = the host
+        # engine_kwargs' default (f32). Survives eviction — a tenant
+        # promoted to bf16 through the quality band re-activates at bf16
+        self.precision = precision
         # serializes THIS tenant's engine build without the host lock: a
         # cold start (bundle load + engine construction + possible jit
         # compiles) must never head-of-line-block other tenants' submits
@@ -208,7 +213,8 @@ class ServeHost:
                    policy: GuardPolicy | None = None,
                    max_pending: int | None = None,
                    slo: SloPolicy | None = None,
-                   drift_band: float | None = None) -> None:
+                   drift_band: float | None = None,
+                   precision: str | None = None) -> None:
         """Register a tenant. ``source`` is a bundle directory (loaded
         lazily on first use, reloaded after an eviction) or an in-memory
         policy (``PolicyBundle`` / trained ``PipelineResult`` — retained,
@@ -216,7 +222,11 @@ class ServeHost:
         built until the first submit. ``drift_band`` overrides the default
         feature-drift trip band (``obs.quality.DEFAULT_DRIFT_BAND``) for a
         policy whose bundle bakes a feature sketch; monitoring is skipped
-        entirely for policies without one."""
+        entirely for policies without one. ``precision`` pins the tenant's
+        serving tier (serve/precision.py; None = the engine default, f32)
+        — registering a tenant straight onto a non-f32 tier is the
+        operator's call; the guarded route is registering at f32 and
+        promoting through ``reload_tenant``'s quality band."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending={max_pending} must be >= 1")
         if drift_band is not None and drift_band <= 0:
@@ -227,7 +237,7 @@ class ServeHost:
             if name in self._tenants:
                 raise ValueError(f"tenant {name!r} already registered")
             self._tenants[name] = _Tenant(name, source, policy, max_pending,
-                                          slo, drift_band)
+                                          slo, drift_band, precision)
 
     def prefetch(self, names) -> list:
         """Predictively warm tenants WITHOUT building engines: each cold
@@ -270,6 +280,15 @@ class ServeHost:
                 obs_count("store/prefetch", tenant=name)
             warmed.append(name)
         return warmed
+
+    def _engine_kwargs_for(self, t) -> dict:
+        """Host-wide engine kwargs plus the tenant's pinned serving tier
+        (``serve/precision.py``). ``t.precision is None`` means the host
+        default — usually f32 — so the dict is returned untouched and an
+        old-style host behaves bit-for-bit as before."""
+        if t.precision is None:
+            return self.engine_kwargs
+        return {**self.engine_kwargs, "precision": t.precision}
 
     def _activate(self, name: str):
         """Touch ``name`` in the LRU, building its engine/batcher if cold.
@@ -318,7 +337,7 @@ class ServeHost:
 
                         tier = "cold"
                         source = load_bundle(source)
-                engine = HedgeEngine(source, **self.engine_kwargs)
+                engine = HedgeEngine(source, **self._engine_kwargs_for(t))
                 metrics = ServingMetrics(registry=self.registry,
                                          labels={"tenant": t.name})
                 drift = t.drift
@@ -577,7 +596,8 @@ class ServeHost:
     def reload_tenant(self, name: str, source=None, *, canary_rows: int = 8,
                       require_same_bits: bool = True,
                       quality_band: float | None = None,
-                      validation=None) -> dict:
+                      validation=None,
+                      precision: str | None = None) -> dict:
         """Versioned hot bundle swap with a canary gate; the tenant never
         stops serving.
 
@@ -619,6 +639,17 @@ class ServeHost:
         else the active telemetry session's bundle dir), so the serving
         history is an auditable hash-linked ledger.
 
+        ``precision`` — promote the tenant to a serving tier
+        (``serve/precision.py``: "f32" | "bf16" | "int8"; None = keep the
+        tenant's current tier). A tier change produces DIFFERENT bits by
+        construction, so it is refused under ``require_same_bits=True``:
+        the supported route is ``require_same_bits=False`` with a
+        ``quality_band``, which replays the pinned validation set on the
+        f32-equivalent INCUMBENT versus the reduced-precision candidate —
+        paired scrambles, so the measured regression is the tier's
+        quantisation error, not Monte-Carlo noise. On promotion the tier
+        is pinned on the tenant and survives eviction/re-activation.
+
         On a pass: the new batcher is installed atomically (the swap waits
         for in-flight submit claims, so no request lands on a dead
         batcher), the old one drains OUTSIDE every lock — queued requests
@@ -637,6 +668,10 @@ class ServeHost:
                 "validation set is only consumed by the quality gate; pass "
                 "quality_band=<max relative hedge-error regression> to arm "
                 "it")
+        if precision is not None:
+            from orp_tpu.serve.precision import normalize_precision
+
+            normalize_precision(precision)  # unknown tier: fail before work
         with self._lock:
             if name not in self._tenants:
                 raise KeyError(f"unknown tenant {name!r}; registered: "
@@ -697,6 +732,14 @@ class ServeHost:
                 t.in_submit -= 1
                 if t.in_submit == 0:
                     self._swap_cv.notify_all()
+        if (precision is not None and require_same_bits
+                and precision != old_engine.precision.tier):
+            raise ValueError(
+                f"tenant {name!r}: precision={precision!r} changes the "
+                f"serving tier (incumbent {old_engine.precision.tier!r}) — "
+                "different bits by construction, so the bitwise canary can "
+                "never pass. Promote tiers with require_same_bits=False and "
+                "a quality_band (the paired hedge-error gate)")
         # load + build the candidate OUTSIDE every host lock (a reload must
         # never head-of-line-block serving; the ORP012 discipline)
         new_source = t.source if source is None else source
@@ -728,8 +771,11 @@ class ServeHost:
             # — the bytes passed every on-disk digest, the in-memory object
             # is wrong; the canary below is the only gate left
             policy = inj.corrupt_policy(policy)
+        cand_kwargs = self._engine_kwargs_for(t)
+        if precision is not None:
+            cand_kwargs = {**cand_kwargs, "precision": precision}
         with t.build_lock:  # orp: noqa[ORP012] -- build_lock is the per-tenant BUILD serializer (vs a racing activation), not a batcher/host lock; nothing drains or serves under it
-            engine = HedgeEngine(policy, **self.engine_kwargs)
+            engine = HedgeEngine(policy, **cand_kwargs)
             for d, (pphi, ppsi, _pv) in zip(dates, pinned):
                 phi, psi, _v = engine.evaluate(d, probe)
                 if not (np.isfinite(phi).all() and np.isfinite(psi).all()):
@@ -834,6 +880,10 @@ class ServeHost:
                     t.warm = policy  # the retained warm policy must track
                     # the swap — a later warm re-activation serves the NEW
                     # bundle's bits, never a stale pre-swap policy
+                    if precision is not None:
+                        # tier pin survives eviction: a warm re-activation
+                        # rebuilds at the PROMOTED tier, not the default
+                        t.precision = precision
                     if new_drift is not None:
                         t.drift = new_drift
                     t.version += 1
@@ -862,6 +912,7 @@ class ServeHost:
         self._chain_verdict(name, action="promote", version=version,
                             require_same_bits=bool(require_same_bits),
                             source=str(new_source),
+                            precision=engine.precision.tier,
                             **({"quality": quality} if quality else {}))
         for victim in (*evicted2, *(() if old_batcher is None
                                     else (old_batcher,))):
@@ -871,7 +922,8 @@ class ServeHost:
             victim.close()
         out = {"tenant": name, "version": version, "swapped": True,
                "canary_rows": int(canary_rows), "canary_dates": dates,
-               "require_same_bits": bool(require_same_bits)}
+               "require_same_bits": bool(require_same_bits),
+               "precision": engine.precision.tier}
         if quality is not None:
             out["quality"] = quality
         return out

@@ -1,0 +1,194 @@
+"""Mixed-date megakernel: one fused dispatch for a block spanning dates.
+
+The bucketed engine keys every executable on ONE traced ``date_idx``, so a
+block whose rows sit at different rebalance dates fragments into one
+dispatch per distinct date — at the serve forward's measured ~1% roofline
+fraction the device idles while Python pays that per-date dispatch tax.
+This Pallas kernel runs the WHOLE mixed-date block in one program: the
+grid walks the date axis, each step runs the full ~122-param MLP forward
+for that date's parameters over the block and commits the rows whose
+per-row date index matches.
+
+Bitwise contract (the lowering-equivalence pin in tests/test_serve.py):
+each grid step's layer matmul is the SAME 2-D ``dot`` (HIGHEST precision,
+matching ``utils/precision.highest_matmul_precision`` on the bucketed
+path) over the full block that the bucketed executable runs, and XLA row
+results are batch-size-invariant, so selecting rows by date mask
+reproduces the loop-of-buckets path exactly in f32. The masked-select
+formulation is also why the kernel stays Mosaic-friendly: 2-D dots and
+elementwise selects only — no gathers, no batched ``dot_general``.
+
+Backend conditional exactly like ``qmc/pallas_mf.heston_qe_pallas``:
+``interpret=None`` resolves to the Pallas interpreter off-TPU (the CPU
+tier-1 suite exercises that path), compiled Mosaic on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from orp_tpu.serve.precision import dequantize_params, eval_model
+from orp_tpu.train.backward import _split_holdings
+
+
+def use_interpret(interpret: bool | None = None) -> bool:
+    """Backend-conditional interpreter flag (the ``heston_qe_pallas``
+    registry pattern): explicit wins, else interpret everywhere but TPU."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def _head_kernel(dates_ref, feats_ref, *refs, n_layers, slope):
+    """One grid step = date ``d``: full MLP forward of the block under
+    date ``d``'s parameters, rows committed where ``dates == d``. The
+    output block is revisited by every step (sequential grid), so the
+    running select accumulates the per-row gather without one."""
+    out_ref = refs[-1]
+    wrefs = refs[:-1]
+    d = pl.program_id(0)
+    x = feats_ref[...]
+    for i in range(n_layers):
+        w = wrefs[2 * i][0]       # (f_i, h_i) — this date's layer weights
+        b = wrefs[2 * i + 1][0]   # (h_i,)
+        x = jnp.dot(x, w, precision=jax.lax.Precision.HIGHEST) + b
+        if i < n_layers - 1:
+            x = jnp.where(x >= 0, x, slope * x)  # LeakyReLU (mlp.py)
+    mask = dates_ref[...] == d    # (B, 1) broadcasts over the head width
+
+    @pl.when(d == 0)
+    def _init():
+        out_ref[...] = jnp.where(mask, x, jnp.zeros_like(x))
+
+    @pl.when(d != 0)
+    def _select():
+        out_ref[...] = jnp.where(mask, x, out_ref[...])
+
+
+def _wmap(d):
+    return (d, 0, 0)
+
+
+def _bmap(d):
+    return (d, 0)
+
+
+def _rowmap(d):
+    return (0, 0)
+
+
+def mixed_head_forward(model, params_by_date, dates2d, feats, *,
+                       interpret: bool):
+    """Raw head outputs ``(B, n_outputs)`` of ``model`` where row ``r``
+    uses ``params_by_date[..][dates2d[r, 0]]`` — the whole mixed-date
+    block in ONE dispatch. ``feats`` must already be in ``model.dtype``;
+    constraint head / value / dual-mode combines happen in the (jit)
+    wrapper, not here."""
+    n_layers = len(model.hidden) + 1
+    n_dates = int(params_by_date["w0"].shape[0])
+    rows = feats.shape[0]
+    args, specs = [], []
+    for i in range(n_layers):
+        w = params_by_date[f"w{i}"]
+        b = params_by_date[f"b{i}"]
+        args += [w, b]
+        specs += [pl.BlockSpec((1, *w.shape[1:]), _wmap),
+                  pl.BlockSpec((1, *b.shape[1:]), _bmap)]
+    kernel = functools.partial(_head_kernel, n_layers=n_layers,
+                               slope=model.negative_slope)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_dates,),
+        in_specs=[pl.BlockSpec((rows, 1), _rowmap),
+                  pl.BlockSpec(feats.shape, _rowmap),
+                  *specs],
+        out_specs=pl.BlockSpec((rows, model.n_outputs), _rowmap),
+        out_shape=jax.ShapeDtypeStruct((rows, model.n_outputs),
+                                       feats.dtype),
+        interpret=interpret,
+    )(dates2d, feats, *args)
+
+
+def _constrain(model, x):
+    """``HedgeMLP.holdings``' head tail, applied to the kernel's raw
+    outputs: identical ops, so bits match the bucketed path."""
+    if model.constrain_self_financing:
+        phi = x[..., 0]
+        return jnp.stack([phi, 1.0 - phi], axis=-1)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("model", "dual_mode",
+                                             "holdings_combine",
+                                             "precision", "interpret"))
+def _eval_core_mixed(model, p1_all, p2_all, dates, feats, prices,
+                     cost_of_capital, *, dual_mode, holdings_combine,
+                     precision="f32", interpret=True):
+    """The mixed-date twin of ``serve/engine._eval_core``: per-ROW date
+    indices, one fused dispatch. Same tier semantics (int8 dequantizes to
+    f32 before the forward, bf16 runs the tier-replaced model and casts
+    outputs back to f32); same dual-mode combines as the serve-side
+    ``_date_outputs_core`` call (``prices_t1 = 0`` ⇒ the var-residual leg
+    vanishes, so only value + holdings survive)."""
+    if precision == "int8":
+        p1_all = dequantize_params(p1_all)
+        p2_all = dequantize_params(p2_all)
+    m = eval_model(model, precision)
+    feats = feats.astype(m.dtype)
+    d2 = dates[:, None]
+    raw1 = mixed_head_forward(m, p1_all, d2, feats, interpret=interpret)
+    h1 = _constrain(m, raw1)
+    p = prices.astype(m.dtype)
+    if dual_mode == "mse_only":
+        comb = h1
+        v = jnp.sum(h1 * p, axis=-1)
+    else:
+        raw2 = mixed_head_forward(m, p2_all, d2, feats,
+                                  interpret=interpret)
+        h2 = _constrain(m, raw2)
+        g = jnp.sum(h1 * p, axis=-1)   # value under params1 (g_pre/g_t)
+        h = jnp.sum(h2 * p, axis=-1)   # value under params2
+        v = g + cost_of_capital * (h - g)
+        if dual_mode == "shared":
+            # serve-side shared semantics (engine._eval_core): g_pre is
+            # the stored params1 value, ledger holdings read params2
+            comb = h2
+        elif holdings_combine == "py":
+            comb = h1 + cost_of_capital * (h1 - h2)  # RP.py:114 sign quirk
+        else:
+            comb = h1 + cost_of_capital * (h2 - h1)  # Single#18
+    phi, psi = _split_holdings(comb)
+    if precision == "bf16":
+        phi = phi.astype(jnp.float32)
+        psi = psi.astype(jnp.float32)
+        v = v.astype(jnp.float32)
+    return phi, psi, v
+
+
+def loop_of_buckets(engine, dates, states, prices=None):
+    """The fragmentation baseline the megakernel replaces: one bucketed
+    engine dispatch per DISTINCT date, rows scattered back. The bench's
+    "megakernel off" arm and the bitwise-equivalence test's reference."""
+    dates = np.asarray(dates, np.int64).reshape(-1)
+    states = np.asarray(states)
+    n = states.shape[0]
+    phi = psi = v = None
+    for d in np.unique(dates):
+        m = dates == d
+        p_, s_, v_ = engine.evaluate(
+            int(d), states[m], None if prices is None else prices[m])
+        if phi is None:
+            phi = np.zeros((n, *p_.shape[1:]), p_.dtype)
+            psi = np.zeros((n, *s_.shape[1:]), s_.dtype)
+            v = (np.zeros((n, *v_.shape[1:]), v_.dtype)
+                 if v_ is not None else None)
+        phi[m] = p_
+        psi[m] = s_
+        if v is not None:
+            v[m] = v_
+    return phi, psi, v

@@ -1,0 +1,140 @@
+"""Serving precision tiers: f32 (exact), bf16, int8-weight/f32-accum.
+
+The serve forward is ~122 params of 8-wide matmuls — at that size the
+device is bandwidth/dispatch bound, not FLOP bound, so the win from a
+lower tier is the smaller parameter/activation traffic and the cheaper
+matmul issue, not arithmetic throughput. The tier contract:
+
+``f32``
+    The historical path, byte-identical to what every ``*_oos`` ledger
+    pin asserts. ``prepare_params``/``eval_model`` are exact identities
+    (modulo the same ``asarray(model.dtype)`` cast the engine always
+    applied), so nothing bitwise can move.
+``bf16``
+    Params, features, prices and the whole forward run in bfloat16 (the
+    model is tier-replaced via :meth:`HedgeMLP.with_dtype`, so the SAME
+    ``_date_outputs_core`` the training walk uses runs the bf16 trace).
+    Outputs are cast back to f32 at the executable boundary — the serve
+    API dtype is tier-invariant.
+``int8``
+    Weight-only quantization: per-date, per-tensor symmetric absmax
+    int8 weights with an f32 scale, dequantized AFTER the date gather
+    inside the executable; the forward then runs in full f32
+    ("int8-weight/f32-accum"). Biases stay f32 (quantizing an 8-wide
+    bias buys nothing and costs accuracy).
+
+Non-f32 tiers are NOT bitwise and must never be promoted on bits:
+tenant promotion goes through ``ServeHost.reload_tenant``'s paired-RQMC
+quality band with the f32 incumbent as baseline (``require_same_bits=
+False``, ``quality_band=...``) — see ``serve/bench.py``'s ``--precision``
+drill, which commits the banded pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+#: the valid tiers, in decreasing precision order
+TIERS = ("f32", "bf16", "int8")
+
+#: quantized-leaf marker keys (a dict pytree node, so the date gather
+#: ``x[date_idx]`` walks into it for free)
+_QKEYS = frozenset({"q", "scale"})
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One serving precision tier. Hashable + frozen so it can ride jit
+    static arguments and engine fingerprints."""
+
+    tier: str = "f32"
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(
+                f"precision tier {self.tier!r} not in {TIERS}")
+
+    @property
+    def is_f32(self) -> bool:
+        return self.tier == "f32"
+
+    def eval_dtype(self, model) -> Any:
+        """The dtype request rows are padded/dispatched in."""
+        return jnp.bfloat16 if self.tier == "bf16" else model.dtype
+
+
+def normalize_precision(precision) -> PrecisionPolicy:
+    """Accept a tier string or a :class:`PrecisionPolicy`."""
+    if isinstance(precision, PrecisionPolicy):
+        return precision
+    return PrecisionPolicy(str(precision))
+
+
+def eval_model(model, tier: str):
+    """The model the executable actually runs: tier-replaced to bf16 for
+    the bf16 tier (its ``dtype`` field drives every ``astype`` inside the
+    shared forward), unchanged otherwise (int8 dequantizes to f32 and
+    runs the f32 model)."""
+    if tier == "bf16":
+        return model.with_dtype(jnp.bfloat16)
+    return model
+
+
+def _is_quantized_leaf(node) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == _QKEYS
+
+
+def quantize_tensor(x, *, accum_dtype=jnp.float32) -> dict:
+    """Per-date, per-tensor symmetric absmax int8 quantization of a
+    date-stacked ``(D, ...)`` weight. Returns ``{"q": int8, "scale":
+    accum_dtype}`` with the scale broadcastable over the date axis."""
+    x = jnp.asarray(x, accum_dtype)
+    axes = tuple(range(1, x.ndim))
+    absmax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0,
+                      jnp.ones_like(absmax))
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(accum_dtype)}
+
+
+def dequantize_params(tree):
+    """Inverse of the weight quantization in :func:`prepare_params`:
+    every ``{"q", "scale"}`` node becomes ``q * scale`` in the scale's
+    dtype (f32 — the accumulate dtype), other leaves pass through."""
+    return jax.tree.map(
+        lambda t: (t["q"].astype(t["scale"].dtype) * t["scale"]
+                   if _is_quantized_leaf(t) else t),
+        tree, is_leaf=_is_quantized_leaf)
+
+
+def prepare_params(params_by_date, tier: str, *, model_dtype=jnp.float32):
+    """Tier-transform a date-stacked params pytree for device residency.
+
+    ``f32``: the engine's historical ``asarray(model.dtype)`` cast —
+    bitwise what it always served. ``bf16``: cast every leaf to bf16.
+    ``int8``: weight leaves (dict key ``w*``) quantize per date/tensor;
+    bias leaves stay ``model_dtype``.
+    """
+    if params_by_date is None:
+        return None
+    if tier == "f32":
+        return jax.tree.map(lambda x: jnp.asarray(x, model_dtype),
+                            params_by_date)
+    if tier == "bf16":
+        return jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16),
+                            params_by_date)
+    if tier != "int8":
+        raise ValueError(f"precision tier {tier!r} not in {TIERS}")
+
+    def prep(path, x):
+        key = path[-1]
+        name = getattr(key, "key", None)
+        if isinstance(name, str) and name.startswith("w"):
+            return quantize_tensor(x, accum_dtype=model_dtype)
+        return jnp.asarray(x, model_dtype)
+
+    return jax.tree_util.tree_map_with_path(prep, params_by_date)

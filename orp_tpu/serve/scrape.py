@@ -176,6 +176,10 @@ def top_snapshot(text: str, *, previous: dict | None = None,
     snap = {
         "requests": _series_sum(series, "serve_requests_total"),
         "rows": _series_sum(series, "serve_rows_total"),
+        # bucket-padding rows billed but never requested (the ragged
+        # planner's target): first-class next to the served rows, so the
+        # waste fraction is one division on the same screen
+        "pad_waste": _series_sum(series, "serve_pad_waste_rows_total"),
         "gateway_rows": _series_sum(series, "serve_gateway_rows"),
         "shed": _series_sum(series, "guard_shed"),
         "busy": _series_sum(series, "serve_gateway_busy"),
@@ -200,7 +204,8 @@ def top_snapshot(text: str, *, previous: dict | None = None,
             tenants.setdefault(name, {})["live"] = info.get("live")
     rates = {}
     if previous is not None and interval_s and interval_s > 0:
-        for field in ("requests", "rows", "gateway_rows", "shed", "busy"):
+        for field in ("requests", "rows", "pad_waste", "gateway_rows",
+                      "shed", "busy"):
             prev = previous.get(field)
             if prev is not None:
                 rates[field + "_per_s"] = round(
@@ -221,6 +226,7 @@ def render_top(snap: dict, *, target: str = "") -> str:
             + ("  [DRAINING]" if snap.get("draining") else "")]
     head.append(
         f"req {rate('requests')}  gw-rows {rate('gateway_rows')}  "
+        f"pad-waste {rate('pad_waste')}  "
         f"shed {rate('shed')}  busy {rate('busy')}  "
         f"errors {snap['errors']:,.0f}  "
         f"queue-age p99 "

@@ -41,7 +41,7 @@ def test_rule_registry_complete():
     assert set(RULES) == ({f"ORP00{i}" for i in range(1, 10)}
                           | {"ORP010", "ORP011", "ORP012", "ORP013",
                              "ORP014", "ORP015", "ORP016", "ORP017",
-                             "ORP018", "ORP019", "ORP023"})
+                             "ORP018", "ORP019", "ORP023", "ORP024"})
 
 
 # -- ORP001: x64 drift -------------------------------------------------------
@@ -1542,6 +1542,50 @@ def test_orp023_noqa_suppresses():
     """
     assert lint_source(textwrap.dedent(src),
                        path="orp_tpu/pilot/controller.py") == []
+
+
+# -- ORP024: implicit dtype on the serve hot path ----------------------------
+
+ORP024_POS = """
+    import jax.numpy as jnp
+
+    def _eval_core(feats, pr):
+        feats = jnp.asarray(feats)          # default dtype -> weak f32
+        pad = jnp.zeros((8, 2))             # f32 padding into a bf16 trace
+        fill = jnp.full((4,), 1.0)          # same
+        return feats, pad, fill
+"""
+
+ORP024_NEG = """
+    import jax.numpy as jnp
+
+    def _eval_core(feats, pr, dt):
+        feats = jnp.asarray(feats, dt)          # positional dtype
+        pad = jnp.zeros((8, 2), dtype=dt)       # keyword dtype
+        idx = jnp.asarray(pr, jnp.int32)
+        like = jnp.zeros_like(feats)            # inherits dtype by design
+        return feats, pad, idx, like
+"""
+
+
+def test_orp024_flags_implicit_dtype_on_hot_path():
+    got = [f.rule for f in lint_source(textwrap.dedent(ORP024_POS),
+                                       path="orp_tpu/serve/engine.py")]
+    assert got == ["ORP024"] * 3
+
+
+def test_orp024_clean_negative():
+    assert lint_source(textwrap.dedent(ORP024_NEG),
+                       path="orp_tpu/serve/megakernel.py") == []
+
+
+def test_orp024_scoped_to_hot_path_modules():
+    # the same constructions off the hot path are fine: the default dtype
+    # only breaks the tier contract where the tiers thread one eval dtype
+    assert lint_source(textwrap.dedent(ORP024_POS),
+                       path="orp_tpu/serve/batcher.py") == []
+    assert lint_source(textwrap.dedent(ORP024_POS),
+                       path="orp_tpu/train/backward.py") == []
 
 
 # -- suppressions ------------------------------------------------------------

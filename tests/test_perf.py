@@ -351,6 +351,36 @@ def test_roofline_unknown_device_uses_measured_fallback():
     assert out["frac_peak_bytes"] is None
 
 
+def test_peak_for_scales_table_by_tier_and_falls_back_for_unknown_kind():
+    """Satellite pin for the per-tier peak table: a known device kind
+    prices bf16/int8 at the published factor over the f32 row; an unknown
+    kind at a non-f32 tier warns ONCE and keeps the measured f32 matmul
+    peak (scaling a measured number by a published factor would fabricate
+    a ceiling); an unknown TIER prices at f32 with its own warning."""
+    f32, src = perf.peak_for("TPU v5e", "f32")
+    assert src == "table"
+    for tier in ("bf16", "int8"):
+        scaled, src = perf.peak_for("TPU v5e", tier)
+        assert src == "table"
+        assert scaled["flops_per_s"] == pytest.approx(
+            f32["flops_per_s"] * perf.TIER_PEAK_FACTOR[tier])
+        assert tier in scaled["note"]
+    perf._PEAK_WARNED.discard(("weird-chip", "bf16"))
+    with pytest.warns(UserWarning, match="no published bf16 peak"):
+        ent, src = perf.peak_for("weird-chip", "bf16")
+    assert src == "measured_matmul" and ent["bytes_per_s"] is None
+    # warn-once: the second join on the same (kind, tier) is silent
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        perf.peak_for("weird-chip", "bf16")
+    perf._PEAK_WARNED.discard(("TPU v5e", "fp4"))
+    with pytest.warns(UserWarning, match="not in TIER_PEAK_FACTOR"):
+        ent, _ = perf.peak_for("TPU v5e", "fp4")
+    assert ent["flops_per_s"] == f32["flops_per_s"]  # conservative: f32
+
+
 def test_program_cost_feeds_roofline(trained):
     engine = HedgeEngine(trained)
     cost = engine.program_cost(16)

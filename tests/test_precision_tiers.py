@@ -1,0 +1,246 @@
+"""Serving precision-tier + megakernel oracles (orp_tpu/serve/precision,
+orp_tpu/serve/megakernel, the AOT tier keying and the host promotion route):
+the f32 tier is BITWISE the historical engine, bf16/int8 stay inside the
+serve-bench quality bands, int8 quantization honours its closed-form error
+bound, the mixed-date megakernel is bitwise the loop-of-buckets baseline at
+f32, per-tier AOT executable sets refuse tier mismatches, and a tenant can
+only change tier through the quality-banded (never the bitwise) canary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orp_tpu.aot import export_aot, load_aot
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.parallel.mesh import make_mesh
+from orp_tpu.serve import (
+    TIERS,
+    HedgeEngine,
+    PrecisionPolicy,
+    ServeHost,
+    export_bundle,
+    load_bundle,
+    loop_of_buckets,
+    normalize_precision,
+)
+from orp_tpu.serve.bench import PRECISION_BANDS
+from orp_tpu.serve.precision import (dequantize_params, prepare_params,
+                                     quantize_tensor)
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+def _states(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return (1.0 + 0.05 * rng.standard_normal((n, 1))).astype(np.float32)
+
+
+def _prices(states):
+    n = states.shape[0]
+    return np.stack([states[:, 0], np.full(n, 0.97, np.float32)], axis=1)
+
+
+# -- tier plumbing ------------------------------------------------------------
+
+
+def test_precision_policy_validation():
+    assert TIERS == ("f32", "bf16", "int8")
+    assert PrecisionPolicy().is_f32
+    assert normalize_precision("bf16").tier == "bf16"
+    p = PrecisionPolicy("int8")
+    assert normalize_precision(p) is p
+    with pytest.raises(ValueError, match="tier"):
+        PrecisionPolicy("fp4")
+    with pytest.raises(ValueError, match="tier"):
+        normalize_precision("f64")
+
+
+def test_quantize_roundtrip_error_bound():
+    """Symmetric absmax int8: per-date scale = absmax/127, so the
+    round-trip error is bounded by scale/2 elementwise — the closed form
+    the tier's quality band budgets against."""
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((4, 8, 3)).astype(np.float32)  # (D, in, out)
+    q = quantize_tensor(w)
+    assert q["q"].dtype == jnp.int8 and q["scale"].dtype == jnp.float32
+    assert q["scale"].shape == (4, 1, 1)  # per-date, broadcastable
+    deq = np.asarray(dequantize_params(q))
+    bound = np.asarray(q["scale"]) / 2 + 1e-7
+    assert (np.abs(deq - w) <= bound).all()
+    # an all-zero date must not divide by zero (scale clamps to 1)
+    z = quantize_tensor(np.zeros((2, 3), np.float32))
+    assert np.asarray(dequantize_params(z)).max() == 0.0
+
+
+def test_prepare_params_f32_identity_bf16_cast_int8_weights_only(trained):
+    p1 = trained.backward.params1_by_date
+    f32 = prepare_params(p1, "f32")
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(f32)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    bf16 = prepare_params(p1, "bf16")
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(bf16))
+    int8 = prepare_params(p1, "int8")
+    for i in range(len(trained.model.hidden) + 1):
+        assert int8[f"w{i}"]["q"].dtype == jnp.int8  # weights quantize
+        assert int8[f"b{i}"].dtype == jnp.float32    # biases stay f32
+    with pytest.raises(ValueError, match="tier"):
+        prepare_params(p1, "fp4")
+
+
+# -- engine tiers -------------------------------------------------------------
+
+
+def test_f32_tier_serves_the_historical_bits(trained):
+    """precision="f32" is the default engine, bit for bit — nothing about
+    the tier plumbing may move the pinned serving program."""
+    base = HedgeEngine(trained)
+    f32 = HedgeEngine(trained, precision="f32")
+    assert f32.cache_info()["precision"] == "f32"
+    states = _states(33)
+    prices = _prices(states)
+    for d in range(base.n_dates):
+        a = base.evaluate(d, states, prices)
+        b = f32.evaluate(d, states, prices)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_reduced_tiers_stay_inside_their_quality_band(trained):
+    """bf16 and int8 serve DIFFERENT bits (that is the point) but the
+    divergence from f32 stays inside PRECISION_BANDS — the same banded
+    pin the serve-bench precision phase gates on — and the output dtype
+    stays f32 (the serve API is tier-invariant)."""
+    f32 = HedgeEngine(trained)
+    states = _states(128)
+    prices = _prices(states)
+    for tier in ("bf16", "int8"):
+        eng = HedgeEngine(trained, precision=tier)
+        assert eng.cache_info()["precision"] == tier
+        worst = 0.0
+        for d in range(f32.n_dates):
+            phi0, psi0, v0 = f32.evaluate(d, states, prices)
+            phi1, psi1, v1 = eng.evaluate(d, states, prices)
+            assert phi1.dtype == np.float32 and v1.dtype == np.float32
+            worst = max(worst,
+                        np.abs(phi1 - phi0).max(),
+                        np.abs(psi1 - psi0).max())
+        assert 0.0 < worst <= PRECISION_BANDS[tier], \
+            f"{tier}: max divergence {worst} outside band"
+
+
+# -- mixed-date megakernel ----------------------------------------------------
+
+
+def test_megakernel_bitwise_equals_loop_of_buckets(trained):
+    """THE lowering-equivalence pin: a shuffled mixed-date block through
+    the single-dispatch megakernel returns bitwise what one bucketed
+    dispatch per distinct date returns — phi, psi AND value."""
+    engine = HedgeEngine(trained)
+    rng = np.random.default_rng(9)
+    n = 50
+    states = _states(n)
+    prices = _prices(states)
+    dates = rng.permutation(np.arange(n) % engine.n_dates).astype(np.int32)
+    ref = loop_of_buckets(engine, dates, states, prices)
+    got = engine.evaluate_mixed_async(dates, states, prices).result()
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+    # without prices: value is None on both paths
+    ref_np = loop_of_buckets(engine, dates, states)
+    got_np = engine.evaluate_mixed_async(dates, states).result()
+    assert ref_np[2] is None and got_np[2] is None
+    np.testing.assert_array_equal(ref_np[0], got_np[0])
+
+
+def test_megakernel_input_validation(trained):
+    engine = HedgeEngine(trained)
+    states = _states(4)
+    with pytest.raises(ValueError, match="one rebalance-date index"):
+        engine.evaluate_mixed_async(np.zeros(3, np.int32), states)
+    with pytest.raises(IndexError, match="out of range"):
+        engine.evaluate_mixed_async(np.full(4, 99, np.int32), states)
+    # negative per-row indices count from the end, numpy-style
+    last = engine.evaluate_mixed_async(
+        np.full(4, -1, np.int32), states).result()
+    pin = engine.evaluate(engine.n_dates - 1, states)
+    np.testing.assert_array_equal(last[0], pin[0])
+
+
+def test_megakernel_refuses_mesh_engines(trained):
+    eng = HedgeEngine(trained, mesh=make_mesh(8))
+    with pytest.raises(ValueError, match="single-device"):
+        eng.evaluate_mixed_async(np.zeros(4, np.int32), _states(4))
+
+
+# -- per-tier AOT executable sets ---------------------------------------------
+
+
+def test_aot_tier_keying_and_mismatch_refusal(tmp_path, trained):
+    """Non-f32 AOT sets live under ``aot/<topo>+<tier>/`` next to the f32
+    set; the loader refuses a tier it has no set for (one warning, jit
+    fallback) and each tier's engine resolves exactly its own set."""
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    export_aot(bdir, load_bundle(bdir), buckets=(8,))
+    export_aot(bdir, load_bundle(bdir), buckets=(8,), precision="bf16")
+    bundle = load_bundle(bdir)  # aot_dir resolves at load time
+    assert bundle.aot_dir == bdir
+    assert sorted(load_aot(bdir, precision="f32")) == [8]
+    assert sorted(load_aot(bdir, precision="bf16")) == [8]
+    # int8 was never exported: warn once, fall back to {} (jit path)
+    with pytest.warns(UserWarning, match="topology\\+tier"):
+        assert load_aot(bdir, precision="int8") == {}
+    # each tier's engine sees its own executables — and an int8 engine on
+    # this bundle still serves correctly through jit
+    assert HedgeEngine(bundle, precision="bf16").cache_info()[
+        "aot_buckets"] == [8]
+    with pytest.warns(UserWarning):
+        eng = HedgeEngine(bundle, precision="int8")
+    assert eng.cache_info()["aot_buckets"] == []
+    phi, _, _ = eng.evaluate(0, _states(4))
+    assert np.isfinite(phi).all()
+
+
+# -- host promotion route -----------------------------------------------------
+
+
+def test_host_tier_promotion_only_through_quality_band(trained):
+    """A tier change is different bits by construction: refused under the
+    bitwise canary, promoted only through the paired quality band vs the
+    f32 incumbent — and the pinned tier survives on the tenant."""
+    with ServeHost(max_live_engines=2) as host:
+        host.add_tenant("t", trained)
+        probe = _states(8)
+        host.evaluate("t", 0, probe)  # activate the f32 incumbent
+        assert host._tenants["t"].engine.precision.tier == "f32"
+        with pytest.raises(ValueError, match="precision"):
+            host.reload_tenant("t", precision="bf16")  # bitwise gate: refuse
+        with pytest.raises(ValueError, match="tier"):
+            host.reload_tenant("t", require_same_bits=False,
+                               quality_band=0.05, precision="fp4")
+        out = host.reload_tenant("t", require_same_bits=False,
+                                 quality_band=0.05, precision="bf16")
+        assert out["swapped"] is True and out["precision"] == "bf16"
+        q = out["quality"]
+        assert q["regression"] <= 0.05  # the banded verdict, paired RQMC
+        assert host._tenants["t"].precision == "bf16"
+        assert host._tenants["t"].engine.precision.tier == "bf16"
+        # serving continues on the promoted tier, within its band of f32
+        ref, _, _ = HedgeEngine(trained).evaluate(0, probe)
+        phi, _, _ = host.evaluate("t", 0, probe)
+        assert np.abs(phi - ref).max() <= PRECISION_BANDS["bf16"]
+
+
+def test_host_add_tenant_precision_pin(trained):
+    with ServeHost() as host:
+        host.add_tenant("lo", trained, precision="int8")
+        host.evaluate("lo", 0, _states(4))
+        assert host._tenants["lo"].engine.precision.tier == "int8"

@@ -1,0 +1,139 @@
+"""Ragged-batching oracles (orp_tpu/serve/ragged): the BucketPlanner's
+pad-waste accounting matches the closed form for synthetic block mixes, the
+split/merge decisions follow the cost model exactly (proxy AND measured
+pricing), and the MicroBatcher's ragged mode bills the `serve/pad_waste_rows`
+counter at precisely the planner's closed-form number while serving bits
+identical to the power-of-two path."""
+
+import numpy as np
+import pytest
+
+from orp_tpu import obs
+from orp_tpu.api import EuropeanConfig, SimConfig, TrainConfig, european_hedge
+from orp_tpu.obs.sink import ListSink
+from orp_tpu.serve import BucketPlanner, HedgeEngine, MicroBatcher
+
+EURO = EuropeanConfig()
+SIM = SimConfig(n_paths=512, T=1.0, dt=1 / 8, rebalance_every=2)  # 4 dates
+TRAIN = TrainConfig(dual_mode="mse_only", epochs_first=20, epochs_warm=10)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return european_hedge(EURO, SIM, TRAIN)
+
+
+# -- closed-form accounting ---------------------------------------------------
+
+
+def test_pad_fraction_and_waste_closed_form():
+    p = BucketPlanner()
+    assert p.bucket_for(1) == 8 and p.bucket_for(9) == 16
+    assert p.pad_fraction(1040) == (2048 - 1040) / 2048
+    assert p.pad_fraction(1024) == 0.0
+    # per-count dispatch (the no-coalesce baseline): 520->1024, 130->256,
+    # 17->32, so waste = 504 + 126 + 15
+    counts = [520, 130, 17]
+    assert p.pad_waste_rows(counts) == 504 + 126 + 15
+    # one merged dispatch: 667 rows -> bucket 1024 -> 357 padding rows
+    assert p.pad_waste_rows(counts, [(0, 3)]) == 1024 - 667
+    assert p.pad_waste_rows([], []) == 0
+
+
+def test_split_rows_decisions_proxy_mode():
+    """The greedy power-of-two decomposition triggers only past the pad
+    threshold AND only when the modelled launch cost undercuts the padding
+    — all three outcomes pinned on the affine proxy (overhead 64 + bucket
+    row-equivalents)."""
+    p = BucketPlanner()
+    # 1040 rows pad 49% of bucket 2048; [1024, 16] costs
+    # (64+1024)+(64+16) = 1168 < 64+2048 = 2112 -> split
+    assert p.split_rows(1040) == [1024, 16]
+    # 1000 rows pad only 2.3% of 1024 — below threshold, keep one dispatch
+    assert p.split_rows(1000) is None
+    # 296 rows: [256, 32, 8] wastes ZERO pad rows (the serve-bench quick
+    # mix 272+24 lands here after the DP merges the two blocks)
+    assert p.split_rows(296) == [256, 32, 8]
+    # at or below min_bucket nothing can be split off
+    assert p.split_rows(6) is None
+    # max_splits bounds the shatter: three pow2 chunks then the tail in
+    # its own bucket (667 -> [512, 128, 16, 11], 11 pads to 16 -> 5 rows
+    # of waste total — the full-shape serve-bench number)
+    assert p.split_rows(667) == [512, 128, 16, 11]
+
+
+def test_plan_merges_and_keeps_separate():
+    """The DP subsumes both decisions: small blocks that fill one bucket
+    merge (one launch beats two), a merge that steps the bucket up past
+    what a second launch costs stays split."""
+    p = BucketPlanner()
+    # two 4-row blocks: merged 8 costs 72, separate costs 144 -> merge
+    assert p.plan([4, 4]) == [(0, 2)]
+    # 512 + 8: merged 520 steps up to bucket 1024 (cost 1088); separate
+    # costs 576 + 72 = 648 -> keep apart
+    assert p.plan([512, 8]) == [(0, 1), (1, 2)]
+    assert p.plan([]) == []
+    assert p.plan([7]) == [(0, 1)]
+
+
+def test_plan_uses_measured_costs_when_fed():
+    """Measured device-seconds flip the proxy's keep-separate verdict:
+    with a FLAT measured cost curve (launch-dominated device), merging
+    [512, 8] halves the bill and the DP must see that."""
+    p = BucketPlanner()
+    assert p.plan([512, 8]) == [(0, 1), (1, 2)]  # proxy: keep apart
+    for _ in range(3):
+        p.feed(8, 1.0)
+        p.feed(1024, 1.0)
+    assert p.cost(8) == 1.0  # measured median, not the proxy
+    assert p.plan([512, 8]) == [(0, 2)]  # flat curve: one launch wins
+    # feed_profile ingests an obs/devprof bucket_stats table the same way
+    q = BucketPlanner()
+    q.feed_profile({8: {"device_s_median": 1.0},
+                    1024: {"device_s_median": 1.0}})
+    assert q.plan([512, 8]) == [(0, 2)]
+
+
+def test_planner_validates_construction():
+    with pytest.raises(ValueError, match="pad_waste_threshold"):
+        BucketPlanner(pad_waste_threshold=1.0)
+    with pytest.raises(ValueError, match="max_splits"):
+        BucketPlanner(max_splits=1)
+
+
+# -- batcher integration ------------------------------------------------------
+
+
+def _run_blocks(engine, counts, *, ragged):
+    """Submit `counts`-row blocks pre-coalesced through the batcher and
+    return (per-block results, pad_waste_rows billed)."""
+    rng = np.random.default_rng(11)
+    blocks = [(1.0 + 0.05 * rng.standard_normal((n, 1))).astype(np.float32)
+              for n in counts]
+    with obs.active(sink=ListSink()):
+        with MicroBatcher(engine, max_batch=1 << 14, max_wait_us=50_000.0,
+                          coalesce_blocks=True, ragged=ragged) as mb:
+            futs = [mb.submit_block(0, blk) for blk in blocks]
+            got = [f.result(timeout=30) for f in futs]
+        waste = int(obs.state().registry.counter(
+            "serve/pad_waste_rows").value)
+    return blocks, got, waste
+
+
+def test_ragged_batcher_bills_closed_form_pad_waste(trained):
+    """Synthetic mix (272, 24): the pow2 arm coalesces to one 296-row
+    dispatch at bucket 512 (216 padding rows); the ragged arm's plan+split
+    dispatches [256, 32, 8] (zero padding). The counter must equal the
+    closed form on BOTH arms, and the served bits must not move."""
+    engine = HedgeEngine(trained)
+    counts = (272, 24)
+    planner = BucketPlanner()
+    blocks, pow2_got, pow2_waste = _run_blocks(engine, counts, ragged=False)
+    assert pow2_waste == planner.pad_waste_rows(list(counts), [(0, 2)]) == 216
+    _, ragged_got, ragged_waste = _run_blocks(engine, counts, ragged=True)
+    assert ragged_waste == 0  # 296 -> [256, 32, 8] pads nothing
+    for blk, a, b in zip(blocks, pow2_got, ragged_got):
+        ref_phi, ref_psi, _ = engine.evaluate(0, blk)
+        for res in (a, b):
+            np.testing.assert_array_equal(res.phi, ref_phi)
+            np.testing.assert_array_equal(res.psi, ref_psi)

@@ -158,6 +158,37 @@ def test_engine_input_validation(trained):
     np.testing.assert_array_equal(phi_last, phi_3)
 
 
+def test_empty_request_short_circuits_before_dispatch(trained):
+    """n=0 regression: an empty batch returns zero-row arrays WITHOUT
+    bucketing or dispatching — no counters move, no compile is paid, and
+    `next_bucket` itself refuses 0 (an all-padding bucket would bill a
+    full device execute for zero rows)."""
+    from orp_tpu.serve.engine import next_bucket
+
+    with pytest.raises(ValueError, match="never dispatches"):
+        next_bucket(0)
+    engine = HedgeEngine(trained)
+    before = engine.cache_info()
+    empty = np.zeros((0, 1), np.float32)
+    phi, psi, value = engine.evaluate(0, empty)
+    assert phi.shape == (0,) and psi.shape == (0,) and value is None
+    # with prices, value comes back as a zero-row array, not None
+    _, _, v = engine.evaluate(0, empty, np.zeros((0, 2), np.float32))
+    assert v is not None and v.shape == (0,)
+    # the mixed-date path short-circuits identically
+    phi_m, _, _ = engine.evaluate_mixed_async(
+        np.zeros(0, np.int32), empty).result()
+    assert phi_m.shape == (0,)
+    after = engine.cache_info()
+    assert after["hits"] == before["hits"]
+    assert after["misses"] == before["misses"]
+    assert after["buckets"] == before["buckets"]
+    # validation still runs BEFORE the short-circuit: a bad feature width
+    # fails loudly even at zero rows
+    with pytest.raises(ValueError, match="features"):
+        engine.evaluate(0, np.zeros((0, 3), np.float32))
+
+
 def test_bundle_refuses_tampering_and_mismatch(tmp_path, trained):
     bdir = tmp_path / "bundle"
     export_bundle(trained, bdir)
@@ -495,6 +526,10 @@ def test_cli_export_and_serve_bench_smoke(tmp_path, capsys):
         "metric": "serve_requests_per_sec",
         "batcher_requests_per_s": 1000.0, "batcher_p99_ms": 19.0,
         "batcher_dispatches": 26, "batcher_requests": 256,
+        # phase evidence from an earlier round: a re-run that does not
+        # re-measure these must carry them forward, not drop them
+        "ingest": {"rows": 4096}, "ingest_rows_per_s": 123.0,
+        "megakernel": {"speedup": 2.0}, "megakernel_speedup": 2.0,
     }))
     cli.main([
         "serve-bench", "--bundle", bdir, "--requests", "12",
@@ -514,6 +549,10 @@ def test_cli_export_and_serve_bench_smoke(tmp_path, capsys):
     assert rec["batcher_sustained_requests_per_s"] > 0
     assert rec["batcher_before"]["batcher_requests_per_s"] == 1000.0
     assert "batcher_speedup_vs_sync" in rec
+    # unmeasured phase blocks (and their headline scalars) are sticky
+    assert rec["ingest"] == {"rows": 4096}
+    assert rec["ingest_rows_per_s"] == 123.0
+    assert rec["megakernel_speedup"] == 2.0
     # a re-run over the now-async record keeps the ORIGINAL sync before
     # (sticky) — it must never "compare" async vs async
     cli.main([
@@ -524,6 +563,50 @@ def test_cli_export_and_serve_bench_smoke(tmp_path, capsys):
     rec2 = json.loads(bench_file.read_text())
     capsys.readouterr()
     assert rec2["batcher_before"]["batcher_requests_per_s"] == 1000.0
+    assert rec2["ingest_rows_per_s"] == 123.0  # still sticky on round 2
+
+
+def test_cli_serve_bench_precision_quick_smoke(tmp_path, capsys, trained):
+    """The CI satellite: `serve-bench --precision --quick` runs all three
+    raw-speed phases at tiny sizes on the CPU interpreter path, and every
+    correctness gate (banded precision pins, the megakernel's bitwise pin,
+    the ragged arm's pad-waste collapse, the quality-banded promotion
+    drill) must HOLD for the command to print a record at all."""
+    from orp_tpu import cli
+
+    bdir = tmp_path / "bundle"
+    export_bundle(trained, bdir)
+    cli.main([
+        "serve-bench", "--bundle", str(bdir), "--requests", "8",
+        "--batcher-requests", "8", "--sweep-concurrency", "",
+        "--precision", "--quick", "--out", "",
+    ])
+    rec = json.loads(capsys.readouterr().out.strip())
+    tiers = {t["tier"]: t for t in rec["precision_tiers"]["tiers"]}
+    assert set(tiers) == {"f32", "bf16", "int8"}
+    assert tiers["f32"]["bitwise_equal_to_f32"] is True
+    for tier in ("bf16", "int8"):
+        t = tiers[tier]
+        assert 0.0 < t["max_abs_dphi_vs_f32"] <= t["band"]
+    # the promotion drill: each non-f32 tier was refused under the bitwise
+    # canary, then judged by the paired quality band vs the f32 incumbent
+    drill = {d["tier"]: d for d in rec["precision_tiers"]["promotion_drill"]}
+    for tier in ("bf16", "int8"):
+        assert drill[tier]["refused_under_bitwise"] is True
+        assert drill[tier]["outcome"] in ("promoted", "rejected")
+        if drill[tier]["outcome"] == "promoted":
+            assert abs(drill[tier]["regression"]) <= \
+                rec["precision_tiers"]["quality_band"]
+    assert rec["megakernel"]["bitwise_equal"] is True
+    assert rec["megakernel"]["dispatches_on"] == 1
+    assert rec["megakernel"]["dispatches_off"] == \
+        rec["megakernel"]["distinct_dates"] > 1
+    rg = rec["ragged"]
+    assert rg["bitwise_equal"] is True
+    assert rg["ragged"]["pad_waste_rows"] <= rg["pow2"]["pad_waste_rows"]
+    # the quick mix (272, 24) is chosen so the planner's split STRICTLY
+    # pays — the smoke proves a saving, not just non-regression
+    assert rec["pad_waste_saved_rows"] > 0
 
 
 @pytest.mark.slow
